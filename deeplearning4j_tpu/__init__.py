@@ -1,0 +1,33 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA re-design with the capability surface of early
+Deeplearning4j (reference: reversemind/deeplearning4j, see SURVEY.md):
+
+- ``ops``       : tensor-op substrate (named activations/losses/updaters) —
+                  the role ND4J's executioner/op-factory plays below the
+                  reference's Java API.
+- ``nn``        : configuration builders, layers (Dense/RBM/AutoEncoder/
+                  Conv/LSTM/Output), and ``MultiLayerNetwork``.
+- ``optimize``  : Solver/ConvexOptimizer equivalents — jit-compiled SGD,
+                  conjugate gradient, LBFGS, line search, Hessian-free.
+- ``datasets``  : DataSet pytree, iterator SPI, fetchers (MNIST/Iris/CSV).
+- ``eval``      : Evaluation / ConfusionMatrix.
+- ``models``    : flagship model families (LeNet, BERT, ResNet).
+- ``parallel``  : device-mesh data/tensor/sequence parallelism over XLA
+                  collectives (replaces Akka/Hazelcast/Spark/YARN runtimes).
+- ``nlp``       : Word2Vec/GloVe/ParagraphVectors/TF-IDF + text infra.
+- ``plot``      : t-SNE and rendering helpers.
+- ``clustering``: KMeans + spatial trees.
+- ``utils``     : serialization, math helpers.
+
+Design rules (TPU-first, not a port):
+- compute is pure functions under ``jax.jit`` — static shapes, ``lax``
+  control flow, bfloat16-friendly matmuls for the MXU;
+- distribution is ``jax.sharding.Mesh`` + collectives over ICI/DCN, not a
+  parameter server;
+- randomness is explicit ``jax.random`` key threading.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: F401

@@ -1,0 +1,4 @@
+"""Data pipeline: DataSet pytree, iterator SPI, fetchers (replaces the
+reference's org.nd4j.linalg.dataset.DataSet + Canova RecordReader bridge)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: F401

@@ -1,0 +1,104 @@
+"""DataSet — (features, labels) pair, registered as a JAX pytree.
+
+Reference parity: ``org.nd4j.linalg.dataset.DataSet`` (65 uses across the
+reference per SURVEY.md §2.8) — getFeatureMatrix/getLabels, splitTestAndTrain,
+batchBy, shuffle, normalization helpers.  TPU-native: an immutable pytree so
+it can cross jit/shard_map boundaries and be device-put with shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+class DataSet:
+    """Immutable (features, labels) pair. labels are one-hot for classifiers."""
+
+    def __init__(self, features, labels=None):
+        self.features = features
+        self.labels = labels if labels is not None else features
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.features, self.labels), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- accessors ---------------------------------------------------------
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def num_inputs(self) -> int:
+        return int(self.features.shape[-1])
+
+    def num_outcomes(self) -> int:
+        return int(self.labels.shape[-1])
+
+    def __len__(self) -> int:
+        return self.num_examples()
+
+    def __repr__(self) -> str:
+        return (f"DataSet(features{tuple(self.features.shape)}, "
+                f"labels{tuple(self.labels.shape)})")
+
+    # -- transformations (host-side, return new DataSet) -------------------
+    def shuffle(self, seed: int = 0) -> "DataSet":
+        perm = np.random.default_rng(seed).permutation(self.num_examples())
+        return DataSet(jnp.asarray(self.features)[perm], jnp.asarray(self.labels)[perm])
+
+    def split_test_and_train(self, num_train: int) -> Tuple["DataSet", "DataSet"]:
+        """Parity: nd4j ``SplitTestAndTrain``."""
+        return (
+            DataSet(self.features[:num_train], self.labels[:num_train]),
+            DataSet(self.features[num_train:], self.labels[num_train:]),
+        )
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        return [
+            DataSet(self.features[i:i + batch_size], self.labels[i:i + batch_size])
+            for i in range(0, n, batch_size)
+        ]
+
+    def iterate_batches(self, batch_size: int, drop_last: bool = False
+                        ) -> Iterator["DataSet"]:
+        n = self.num_examples()
+        end = (n // batch_size) * batch_size if drop_last else n
+        for i in range(0, end, batch_size):
+            yield DataSet(self.features[i:i + batch_size], self.labels[i:i + batch_size])
+
+    def normalize_zero_mean_unit_variance(self) -> "DataSet":
+        f = jnp.asarray(self.features, dtype=jnp.float32)
+        mean = f.mean(axis=0, keepdims=True)
+        std = f.std(axis=0, keepdims=True) + 1e-8
+        return DataSet((f - mean) / std, self.labels)
+
+    def scale_0_1(self) -> "DataSet":
+        f = jnp.asarray(self.features, dtype=jnp.float32)
+        lo = f.min(axis=0, keepdims=True)
+        hi = f.max(axis=0, keepdims=True)
+        return DataSet((f - lo) / (hi - lo + 1e-8), self.labels)
+
+    @staticmethod
+    def merge(datasets: List["DataSet"]) -> "DataSet":
+        """Parity: ``DataSet.merge`` used by the Spark runtime
+        (IterativeReduceFlatMap.java:54)."""
+        return DataSet(
+            jnp.concatenate([d.features for d in datasets], axis=0),
+            jnp.concatenate([d.labels for d in datasets], axis=0),
+        )
+
+
+def one_hot(indices, num_classes: int) -> Array:
+    """Parity: nd4j ``FeatureUtil.toOutcomeMatrix`` (17 uses in reference)."""
+    return jax.nn.one_hot(jnp.asarray(indices).astype(jnp.int32), num_classes,
+                          dtype=jnp.float32)

@@ -1,0 +1,217 @@
+"""Dataset fetchers — parity with ``datasets/fetchers/`` + ``base/``.
+
+``DataSetFetcher`` SPI (datasets/iterator/DataSetFetcher.java): cursor over
+a source, ``fetch(numExamples)`` materializes the next chunk, ``next()``
+returns it as a DataSet.
+
+Zero-egress build: fetchers read local files when present and fall back to
+deterministic synthetic data (clearly flagged) — the reference's downloaders
+(base/MnistFetcher.java, LFWLoader.java) have no network to use here.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, one_hot
+from deeplearning4j_tpu.datasets import mnist as mnist_io
+
+
+class DataSetFetcher:
+    """Cursor-based fetcher SPI (BaseDataFetcher parity)."""
+
+    def __init__(self):
+        self.cursor = 0
+        self.total = 0
+        self._current: Optional[DataSet] = None
+
+    def has_more(self) -> bool:
+        return self.cursor < self.total
+
+    def fetch(self, num_examples: int) -> None:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        assert self._current is not None, "call fetch() first"
+        return self._current
+
+    def reset(self) -> None:
+        self.cursor = 0
+
+    def input_columns(self) -> int:
+        raise NotImplementedError
+
+    def total_outcomes(self) -> int:
+        raise NotImplementedError
+
+
+class ArrayFetcher(DataSetFetcher):
+    """Fetcher over in-memory arrays — the base for all below."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray):
+        super().__init__()
+        self.features = np.asarray(features, dtype=np.float32)
+        self.labels = np.asarray(labels, dtype=np.float32)
+        self.total = len(self.features)
+
+    def fetch(self, num_examples: int) -> None:
+        end = min(self.cursor + num_examples, self.total)
+        self._current = DataSet(jnp.asarray(self.features[self.cursor:end]),
+                                jnp.asarray(self.labels[self.cursor:end]))
+        self.cursor = end
+
+    def input_columns(self) -> int:
+        return int(np.prod(self.features.shape[1:]))
+
+    def total_outcomes(self) -> int:
+        return int(self.labels.shape[-1])
+
+
+class MnistDataFetcher(ArrayFetcher):
+    """MNIST (datasets/fetchers/MnistDataFetcher.java:37 parity): flattened
+    [N, 784] in [0,1], optionally binarized; one-hot labels.  Reads idx
+    files from ``data_dir`` (or auto-discovers); synthetic surrogate
+    otherwise."""
+
+    NUM_EXAMPLES = 60000
+
+    def __init__(self, binarize: bool = True, train: bool = True,
+                 data_dir: Optional[str] = None,
+                 synthetic_n: int = 2048, flatten: bool = True):
+        data_dir = data_dir or mnist_io.find_mnist_dir()
+        if data_dir is not None:
+            images, labels = mnist_io.load_mnist(data_dir, train=train)
+            self.synthetic = False
+        else:
+            images, labels = mnist_io.synthetic_mnist(
+                n=synthetic_n, seed=0 if train else 1)
+            self.synthetic = True
+        x = images.astype(np.float32) / 255.0
+        if binarize:
+            # reference binarizes at >30/255 (MnistDataFetcher.java)
+            x = (x > 30.0 / 255.0).astype(np.float32)
+        x = x.reshape(len(x), -1) if flatten else x[..., None]
+        super().__init__(x, np.asarray(one_hot(labels, 10)))
+
+
+class IrisDataFetcher(ArrayFetcher):
+    """Iris (datasets/fetchers/IrisDataFetcher.java parity): 4 features,
+    3 classes.  Reads a local iris.csv if given; otherwise a deterministic
+    3-cluster Gaussian surrogate with iris-like statistics (zero egress)."""
+
+    def __init__(self, csv_path: Optional[str] = None, n_per_class: int = 50,
+                 seed: int = 7):
+        if csv_path and os.path.exists(csv_path):
+            feats, labels = _read_labeled_csv(csv_path, label_last=True)
+            x, y = feats, one_hot(labels, int(labels.max()) + 1)
+        else:
+            rng = np.random.default_rng(seed)
+            means = np.array([[5.0, 3.4, 1.5, 0.2],
+                              [5.9, 2.8, 4.3, 1.3],
+                              [6.6, 3.0, 5.6, 2.0]], dtype=np.float32)
+            stds = np.array([[0.35, 0.38, 0.17, 0.10],
+                             [0.52, 0.31, 0.47, 0.20],
+                             [0.64, 0.32, 0.55, 0.27]], dtype=np.float32)
+            xs, ys = [], []
+            for c in range(3):
+                xs.append(rng.normal(means[c], stds[c],
+                                     size=(n_per_class, 4)).astype(np.float32))
+                ys.append(np.full(n_per_class, c))
+            x = np.concatenate(xs)
+            y = one_hot(np.concatenate(ys), 3)
+            perm = rng.permutation(len(x))
+            x, y = x[perm], np.asarray(y)[perm]
+        super().__init__(x, np.asarray(y))
+
+
+class CSVDataFetcher(ArrayFetcher):
+    """CSV (datasets/fetchers/CSVDataFetcher.java parity): numeric CSV with
+    an integer label column."""
+
+    def __init__(self, path: str, label_column: int = -1,
+                 skip_header: bool = False, num_classes: Optional[int] = None):
+        feats, labels = _read_labeled_csv(path, label_last=(label_column == -1),
+                                          label_column=label_column,
+                                          skip_header=skip_header)
+        k = num_classes or int(labels.max()) + 1
+        super().__init__(feats, np.asarray(one_hot(labels, k)))
+
+
+class CurvesDataFetcher(ArrayFetcher):
+    """Curves (datasets/fetchers/CurvesDataFetcher.java parity): the
+    deep-autoencoder benchmark — synthetic smooth 1-D curves rendered to a
+    fixed grid; unsupervised (labels == features)."""
+
+    def __init__(self, n: int = 1024, dim: int = 784, seed: int = 3):
+        rng = np.random.default_rng(seed)
+        t = np.linspace(0, 1, dim, dtype=np.float32)
+        freqs = rng.uniform(1.0, 6.0, size=(n, 3)).astype(np.float32)
+        phases = rng.uniform(0, 2 * np.pi, size=(n, 3)).astype(np.float32)
+        amps = rng.uniform(0.2, 1.0, size=(n, 3)).astype(np.float32)
+        x = np.zeros((n, dim), dtype=np.float32)
+        for k in range(3):
+            x += amps[:, k:k + 1] * np.sin(
+                2 * np.pi * freqs[:, k:k + 1] * t[None, :] + phases[:, k:k + 1])
+        x = (x - x.min(axis=1, keepdims=True))
+        x = x / (x.max(axis=1, keepdims=True) + 1e-8)
+        super().__init__(x, x)
+
+    def total_outcomes(self) -> int:
+        return self.features.shape[-1]
+
+
+class LFWDataFetcher(ArrayFetcher):
+    """LFW faces (datasets/fetchers/LFWDataFetcher.java parity): reads a
+    directory of per-person subdirectories of images via the image loader;
+    synthetic face-like blobs otherwise."""
+
+    def __init__(self, image_dir: Optional[str] = None, image_size: int = 28,
+                 n: int = 256, num_people: int = 8, seed: int = 5):
+        if image_dir and os.path.isdir(image_dir):
+            from deeplearning4j_tpu.utils.image import load_image_directory
+            x, labels, _names = load_image_directory(image_dir, image_size)
+            y = one_hot(labels, int(labels.max()) + 1)
+        else:
+            rng = np.random.default_rng(seed)
+            labels = rng.integers(0, num_people, size=n)
+            yy, xx = np.mgrid[0:image_size, 0:image_size].astype(np.float32)
+            c = image_size / 2.0
+            x = np.empty((n, image_size * image_size), dtype=np.float32)
+            for i, lbl in enumerate(labels):
+                face = np.exp(-((yy - c) ** 2 + (xx - c) ** 2) / (2 * (c * 0.7) ** 2))
+                eye_dx = 3 + (lbl % 4)
+                for s in (-1, 1):
+                    face += 0.8 * np.exp(-((yy - c + 4) ** 2 +
+                                           (xx - c + s * eye_dx) ** 2) / 4.0)
+                face += rng.normal(0, 0.05, face.shape)
+                x[i] = face.ravel()
+            y = one_hot(labels, num_people)
+        super().__init__(x, np.asarray(y))
+
+
+def _read_labeled_csv(path: str, label_last: bool = True,
+                      label_column: int = -1, skip_header: bool = False
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    rows: List[List[str]] = []
+    with open(path, newline="") as f:
+        reader = _csv.reader(f)
+        for i, row in enumerate(reader):
+            if skip_header and i == 0:
+                continue
+            if row:
+                rows.append(row)
+    arr = np.asarray(rows)
+    lc = label_column if label_column >= 0 else arr.shape[1] - 1
+    labels_raw = arr[:, lc]
+    feats = np.delete(arr, lc, axis=1).astype(np.float32)
+    try:
+        labels = labels_raw.astype(np.float32).astype(np.int64)
+    except ValueError:
+        uniq = {v: i for i, v in enumerate(sorted(set(labels_raw)))}
+        labels = np.asarray([uniq[v] for v in labels_raw], dtype=np.int64)
+    return feats, labels

@@ -1,0 +1,318 @@
+"""DataSetIterator SPI + implementations.
+
+Reference parity: ``datasets/iterator/DataSetIterator.java``
+(next(num)/batch/cursor/reset/preProcessor), ``BaseDatasetIterator``,
+``SamplingDataSetIterator``, ``MultipleEpochsIterator``,
+``ListDataSetIterator``, ``ReconstructionDataSetIterator``, plus concrete
+iterators in ``datasets/iterator/impl/``.
+
+TPU-native addition: ``PrefetchIterator`` overlaps host batch prep with
+device compute (double-buffered device_put) — the host->HBM pipeline the
+reference never needed (JVM heap was the device).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Callable, Iterator as PyIterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import (
+    DataSetFetcher, IrisDataFetcher, MnistDataFetcher,
+)
+
+
+class DataSetIterator:
+    """Iterator SPI. Also iterable in the Python sense."""
+
+    def __init__(self, batch_size: int):
+        self.batch = batch_size
+        self.pre_processor: Optional[Callable[[DataSet], DataSet]] = None
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+    def input_columns(self) -> int:
+        raise NotImplementedError
+
+    def total_outcomes(self) -> int:
+        raise NotImplementedError
+
+    def set_pre_processor(self, fn: Callable[[DataSet], DataSet]) -> None:
+        """DataSetPreProcessor hook parity."""
+        self.pre_processor = fn
+
+    def _post(self, ds: DataSet) -> DataSet:
+        return self.pre_processor(ds) if self.pre_processor else ds
+
+    def __iter__(self) -> PyIterator[DataSet]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class BaseDatasetIterator(DataSetIterator):
+    """Fetcher-backed iterator (BaseDatasetIterator.java parity)."""
+
+    def __init__(self, batch_size: int, num_examples: int,
+                 fetcher: DataSetFetcher):
+        super().__init__(batch_size)
+        self.fetcher = fetcher
+        self.num_examples = (num_examples if num_examples > 0
+                             else fetcher.total)
+
+    def has_next(self) -> bool:
+        return self.fetcher.cursor < min(self.num_examples, self.fetcher.total)
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        remaining = self.total_examples() - self.fetcher.cursor
+        self.fetcher.fetch(min(num or self.batch, remaining))
+        return self._post(self.fetcher.next())
+
+    def reset(self) -> None:
+        self.fetcher.reset()
+
+    def total_examples(self) -> int:
+        return min(self.num_examples, self.fetcher.total)
+
+    def input_columns(self) -> int:
+        return self.fetcher.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.fetcher.total_outcomes()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Over a pre-materialized list (ListDataSetIterator.java parity)."""
+
+    def __init__(self, batches: Sequence[DataSet], batch_size: int = 0):
+        super().__init__(batch_size)
+        self._batches = list(batches)
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._batches)
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        ds = self._batches[self._i]
+        self._i += 1
+        return self._post(ds)
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def total_examples(self) -> int:
+        return sum(b.num_examples() for b in self._batches)
+
+    def input_columns(self) -> int:
+        return self._batches[0].num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self._batches[0].num_outcomes()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random with-replacement sampling from a source DataSet
+    (SamplingDataSetIterator.java parity)."""
+
+    def __init__(self, source: DataSet, batch_size: int,
+                 total_samples: int, seed: int = 0):
+        super().__init__(batch_size)
+        self.source = source
+        self.total_samples = total_samples
+        self._seed = seed
+        self._drawn = 0
+        self._rng = np.random.default_rng(seed)
+
+    def has_next(self) -> bool:
+        return self._drawn < self.total_samples
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num or self.batch
+        idx = self._rng.integers(0, self.source.num_examples(), size=n)
+        self._drawn += n
+        return self._post(DataSet(jnp.asarray(self.source.features)[idx],
+                                  jnp.asarray(self.source.labels)[idx]))
+
+    def reset(self) -> None:
+        self._drawn = 0
+        self._rng = np.random.default_rng(self._seed)
+
+    def total_examples(self) -> int:
+        return self.total_samples
+
+    def input_columns(self) -> int:
+        return self.source.num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self.source.num_outcomes()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Wraps an iterator for N epochs (MultipleEpochsIterator.java parity)."""
+
+    def __init__(self, num_epochs: int, inner: DataSetIterator):
+        super().__init__(inner.batch)
+        self.inner = inner
+        self.num_epochs = num_epochs
+        self._epoch = 0
+
+    def has_next(self) -> bool:
+        if self.inner.has_next():
+            return True
+        if self._epoch + 1 < self.num_epochs:
+            self._epoch += 1
+            self.inner.reset()
+            return self.inner.has_next()
+        return False
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        return self.inner.next(num)
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self.inner.reset()
+
+    def total_examples(self) -> int:
+        return self.inner.total_examples() * self.num_epochs
+
+    def input_columns(self) -> int:
+        return self.inner.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.inner.total_outcomes()
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """labels := features (ReconstructionDataSetIterator.java parity)."""
+
+    def __init__(self, inner: DataSetIterator):
+        super().__init__(inner.batch)
+        self.inner = inner
+
+    def has_next(self) -> bool:
+        return self.inner.has_next()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        ds = self.inner.next(num)
+        return self._post(DataSet(ds.features, ds.features))
+
+    def reset(self) -> None:
+        self.inner.reset()
+
+    def total_examples(self) -> int:
+        return self.inner.total_examples()
+
+    def input_columns(self) -> int:
+        return self.inner.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.inner.input_columns()
+
+
+class PrefetchIterator(DataSetIterator):
+    """Background-thread prefetch + async device_put: a producer thread
+    pulls batches from the inner iterator and stages them (optionally onto a
+    device — ``device_put`` is async, so the H2D DMA overlaps compute) into
+    a bounded queue, keeping the TPU fed while the host prepares data."""
+
+    _STOP = object()
+
+    def __init__(self, inner: DataSetIterator, depth: int = 2,
+                 device: Optional[jax.Device] = None):
+        super().__init__(inner.batch)
+        self.inner = inner
+        self.depth = depth
+        self.device = device
+        self._queue: Optional["queue.Queue"] = None
+        self._thread: Optional[threading.Thread] = None
+        self._peeked: Optional[DataSet] = None
+        self._done = False
+
+    def _producer(self, q) -> None:
+        try:
+            while self.inner.has_next():
+                ds = self.inner.next()
+                if self.device is not None:
+                    ds = DataSet(jax.device_put(ds.features, self.device),
+                                 jax.device_put(ds.labels, self.device))
+                q.put(ds)
+        finally:
+            q.put(self._STOP)
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            import queue as _queue
+            self._queue = _queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._producer, args=(self._queue,), daemon=True)
+            self._thread.start()
+
+    def has_next(self) -> bool:
+        if self._peeked is not None:
+            return True
+        if self._done:
+            return False
+        self._ensure_started()
+        item = self._queue.get()
+        if item is self._STOP:
+            self._done = True
+            return False
+        self._peeked = item
+        return True
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds, self._peeked = self._peeked, None
+        return self._post(ds)
+
+    def reset(self) -> None:
+        if self._thread is not None:
+            # drain so the producer can exit, then drop it
+            while not self._done and self._queue.get() is not self._STOP:
+                pass
+            self._thread.join(timeout=5)
+        self._thread = None
+        self._queue = None
+        self._peeked = None
+        self._done = False
+        self.inner.reset()
+
+    def total_examples(self) -> int:
+        return self.inner.total_examples()
+
+    def input_columns(self) -> int:
+        return self.inner.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.inner.total_outcomes()
+
+
+# -- concrete iterators (datasets/iterator/impl parity) ---------------------
+
+class MnistDataSetIterator(BaseDatasetIterator):
+    def __init__(self, batch: int, num_examples: int = 0, binarize: bool = True,
+                 train: bool = True, **kw):
+        super().__init__(batch, num_examples,
+                         MnistDataFetcher(binarize=binarize, train=train, **kw))
+
+
+class IrisDataSetIterator(BaseDatasetIterator):
+    def __init__(self, batch: int, num_examples: int = 0, **kw):
+        super().__init__(batch, num_examples, IrisDataFetcher(**kw))

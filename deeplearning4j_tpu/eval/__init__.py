@@ -1,0 +1,3 @@
+"""Evaluation: multiclass metrics (eval/Evaluation.java parity)."""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix  # noqa: F401

@@ -1,0 +1,128 @@
+"""Evaluation / ConfusionMatrix — parity with ``eval/Evaluation.java:29`` and
+``eval/ConfusionMatrix.java``.
+
+``eval(real, guess)`` fills the confusion matrix and TP/FP/TN/FN counters
+(:46); metrics: ``accuracy:208``, ``f1:219``, ``recall:252``,
+``precision:263``, report ``stats():97``.
+
+The count accumulation is one device-side matmul (one-hot ⊤ · one-hot) so
+evaluating a large eval set never leaves the TPU until the final counts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class ConfusionMatrix:
+    """Generic count matrix: rows = actual, cols = predicted."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.counts = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1) -> None:
+        self.counts[actual, predicted] += count
+
+    def add_matrix(self, counts: np.ndarray) -> None:
+        self.counts += counts.astype(np.int64)
+
+    def count(self, actual: int, predicted: int) -> int:
+        return int(self.counts[actual, predicted])
+
+    def actual_total(self, actual: int) -> int:
+        return int(self.counts[actual].sum())
+
+    def predicted_total(self, predicted: int) -> int:
+        return int(self.counts[:, predicted].sum())
+
+    def total(self) -> int:
+        return int(self.counts.sum())
+
+    def __repr__(self):
+        return f"ConfusionMatrix({self.num_classes} classes, n={self.total()})"
+
+
+@jax.jit
+def _confusion_counts(labels_1hot: Array, preds_1hot: Array) -> Array:
+    return labels_1hot.astype(jnp.float32).T @ preds_1hot.astype(jnp.float32)
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None):
+        self.num_classes = num_classes
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n: int) -> ConfusionMatrix:
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+        return self.confusion
+
+    # -- accumulation (eval:46 parity) -------------------------------------
+    def eval(self, real_outcomes: Array, guesses: Array) -> None:
+        """real_outcomes: one-hot [N, C] (or int labels [N]);
+        guesses: probabilities/one-hot [N, C]."""
+        real = jnp.asarray(real_outcomes)
+        guess = jnp.asarray(guesses)
+        if real.ndim == 1:
+            real = jax.nn.one_hot(real.astype(jnp.int32), guess.shape[-1])
+        cm = self._ensure(real.shape[-1])
+        pred_1hot = jax.nn.one_hot(jnp.argmax(guess, -1), real.shape[-1])
+        cm.add_matrix(np.asarray(_confusion_counts(real, pred_1hot)))
+
+    # -- per-class counters ------------------------------------------------
+    def true_positives(self, i: int) -> int:
+        return self.confusion.count(i, i)
+
+    def false_positives(self, i: int) -> int:
+        return self.confusion.predicted_total(i) - self.confusion.count(i, i)
+
+    def false_negatives(self, i: int) -> int:
+        return self.confusion.actual_total(i) - self.confusion.count(i, i)
+
+    def true_negatives(self, i: int) -> int:
+        return (self.confusion.total() - self.confusion.actual_total(i)
+                - self.false_positives(i))
+
+    # -- metrics -----------------------------------------------------------
+    def accuracy(self) -> float:
+        cm = self.confusion
+        return float(np.trace(cm.counts) / max(cm.total(), 1))
+
+    def precision(self, i: Optional[int] = None) -> float:
+        if i is not None:
+            tp, fp = self.true_positives(i), self.false_positives(i)
+            return tp / (tp + fp) if tp + fp else 0.0
+        return float(np.mean([self.precision(c)
+                              for c in range(self.confusion.num_classes)]))
+
+    def recall(self, i: Optional[int] = None) -> float:
+        if i is not None:
+            tp, fn = self.true_positives(i), self.false_negatives(i)
+            return tp / (tp + fn) if tp + fn else 0.0
+        return float(np.mean([self.recall(c)
+                              for c in range(self.confusion.num_classes)]))
+
+    def f1(self, i: Optional[int] = None) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    # -- report (stats():97 parity) ----------------------------------------
+    def stats(self) -> str:
+        cm = self.confusion
+        lines = ["==========================Scores=====================================",
+                 f" Accuracy:  {self.accuracy():.4f}",
+                 f" Precision: {self.precision():.4f}",
+                 f" Recall:    {self.recall():.4f}",
+                 f" F1 Score:  {self.f1():.4f}",
+                 "====================================================================="]
+        lines.append("Confusion matrix (rows=actual, cols=predicted):")
+        lines.append(str(cm.counts))
+        return "\n".join(lines)

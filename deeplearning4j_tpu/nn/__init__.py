@@ -1,0 +1,1 @@
+"""Neural-network core: configuration, layers, MultiLayerNetwork."""

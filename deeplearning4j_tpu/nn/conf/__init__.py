@@ -1,0 +1,17 @@
+"""Configuration system — parity with ``nn/conf`` of the reference.
+
+One typed config tree (dataclasses) with fluent builders and JSON round-trip
+fills the roles of the reference's Jackson-serialized
+``NeuralNetConfiguration``/``MultiLayerConfiguration`` (SURVEY.md §5.6a) and
+its string-keyed runtime ``Configuration`` (§5.6b).
+"""
+
+from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
+    LayerKind,
+    OptimizationAlgorithm,
+    WeightInit,
+    HiddenUnit,
+    VisibleUnit,
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)

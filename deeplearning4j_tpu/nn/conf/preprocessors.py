@@ -1,0 +1,110 @@
+"""Input/output pre-processors at layer boundaries.
+
+Reference parity: ``nn/conf/preprocessor/`` — ReshapePreProcessor,
+BinomialSamplingPreProcessor, UnitVariancePrePreProcessor,
+ZeroMeanAndUnitVariancePrePreProcessor, Composable{Input,Output}PreProcessor,
+plus ``nn/layers/convolution/preprocessor/ConvolutionInputPreProcessor.java``
+(flat vector -> image tensor at the conv boundary).
+
+TPU-native: each preprocessor is a pure fn ``(x, key) -> x``; specs are JSON
+dicts (``{"name": ..., **kwargs}``) so MultiLayerConfiguration stays
+serializable.  Stochastic preprocessors consume the provided key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+PreProcessor = Callable[[Array, Array | None], Array]
+
+_REGISTRY: Dict[str, Callable[..., PreProcessor]] = {}
+
+
+def register_preprocessor(name: str):
+    def deco(factory):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def make_preprocessor(spec: Dict[str, Any]) -> PreProcessor:
+    spec = dict(spec)
+    name = spec.pop("name")
+    try:
+        return _REGISTRY[name](**spec)
+    except KeyError:
+        raise ValueError(f"unknown preprocessor '{name}'; known {sorted(_REGISTRY)}") from None
+
+
+@register_preprocessor("reshape")
+def _reshape(shape) -> PreProcessor:
+    shape = tuple(shape)
+
+    def fn(x, key=None):
+        return jnp.reshape(x, (x.shape[0],) + shape)
+    return fn
+
+
+@register_preprocessor("flatten")
+def _flatten() -> PreProcessor:
+    def fn(x, key=None):
+        return jnp.reshape(x, (x.shape[0], -1))
+    return fn
+
+
+@register_preprocessor("binomial_sampling")
+def _binomial() -> PreProcessor:
+    """BinomialSamplingPreProcessor: sample Bernoulli(x)."""
+    def fn(x, key=None):
+        if key is None:
+            return x  # deterministic eval path
+        return jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+    return fn
+
+
+@register_preprocessor("unit_variance")
+def _unit_variance() -> PreProcessor:
+    def fn(x, key=None):
+        return x / (jnp.std(x, axis=-1, keepdims=True) + 1e-8)
+    return fn
+
+
+@register_preprocessor("zero_mean_unit_variance")
+def _zero_mean_unit_variance() -> PreProcessor:
+    def fn(x, key=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        sd = jnp.std(x, axis=-1, keepdims=True) + 1e-8
+        return (x - mu) / sd
+    return fn
+
+
+@register_preprocessor("zero_mean")
+def _zero_mean() -> PreProcessor:
+    def fn(x, key=None):
+        return x - jnp.mean(x, axis=-1, keepdims=True)
+    return fn
+
+
+@register_preprocessor("convolution_input")
+def _convolution_input(rows: int, cols: int, channels: int = 1) -> PreProcessor:
+    """ConvolutionInputPreProcessor parity: [B, rows*cols*ch] -> NHWC image."""
+    def fn(x, key=None):
+        return jnp.reshape(x, (x.shape[0], rows, cols, channels))
+    return fn
+
+
+@register_preprocessor("composable")
+def _composable(specs) -> PreProcessor:
+    fns = [make_preprocessor(s) for s in specs]
+
+    def fn(x, key=None):
+        keys = (jax.random.split(key, len(fns)) if key is not None
+                else [None] * len(fns))
+        for f, k in zip(fns, keys):
+            x = f(x, k)
+        return x
+    return fn

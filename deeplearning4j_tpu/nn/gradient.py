@@ -1,0 +1,36 @@
+"""Gradient container — parity with ``nn/gradient/DefaultGradient.java``.
+
+The reference keeps an ordered name->INDArray map keyed by
+``conf.variables()``.  Here gradients are simply pytrees shaped like params;
+this class exists for API parity and for code that wants ordered flattening.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Tuple
+
+import jax
+
+Array = jax.Array
+
+
+class Gradient:
+    def __init__(self, grads: Dict[str, Any] | None = None):
+        self._grads: Dict[str, Any] = dict(grads or {})
+
+    def gradient_for_variable(self, name: str) -> Any:
+        return self._grads[name]
+
+    def set_gradient_for(self, name: str, value: Any) -> None:
+        self._grads[name] = value
+
+    def gradient(self):
+        """Flat concatenation in insertion order (DefaultGradient.gradient())."""
+        from deeplearning4j_tpu.nn.params import pack_params
+        return pack_params(self._grads)
+
+    def items(self) -> Iterator[Tuple[str, Any]]:
+        return iter(self._grads.items())
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._grads)

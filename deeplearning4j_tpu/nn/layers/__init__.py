@@ -1,0 +1,22 @@
+"""Layers — forward/backward math as pure functions.
+
+Importing this package registers all built-in layer kinds with the factory
+registry (parity: nn/layers/factory/LayerFactories.java).
+"""
+
+from deeplearning4j_tpu.nn.layers.base import (  # noqa: F401
+    Layer, register_layer, make_layer,
+)
+from deeplearning4j_tpu.nn.layers.dense import DenseLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.output import OutputLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.rbm import RBMLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.autoencoder import (  # noqa: F401
+    AutoEncoderLayer, RecursiveAutoEncoderLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import (  # noqa: F401
+    ConvolutionLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.lstm import LSTMLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.extras import (  # noqa: F401
+    EmbeddingLayer, BatchNormLayer,
+)

@@ -1,0 +1,124 @@
+"""AutoEncoders — denoising + recursive.
+
+Reference parity:
+- ``models/featuredetectors/autoencoder/AutoEncoder.java`` — tied-ish
+  encoder/decoder (W, W.T) with corruption (``corruptionLevel``) and
+  reconstruction cross-entropy pretraining.
+- ``models/featuredetectors/autoencoder/recursive/RecursiveAutoEncoder.java``
+  — folds a sequence pairwise into a single representation, reconstruction
+  loss at every merge.  The reference recurses over a ``Tree``; here the
+  fold is a ``lax.scan`` over a fixed-length item axis (XLA needs static
+  shapes; variable-length inputs are padded + masked).
+
+Pretraining gradients come from ``jax.value_and_grad`` — the objective is
+differentiable, unlike the RBM's CD estimator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.configuration import LayerKind
+from deeplearning4j_tpu.nn.layers.base import PretrainLayer, register_layer
+from deeplearning4j_tpu.nn import params as P
+from deeplearning4j_tpu.ops import losses as L
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+
+@register_layer(LayerKind.AUTOENCODER)
+class AutoEncoderLayer(PretrainLayer):
+    def init(self, key: Array) -> Params:
+        return P.pretrain_params(key, self.conf)
+
+    def encode(self, params: Params, x: Array) -> Array:
+        return self.activation(x @ params["W"] + params["b"])
+
+    def decode(self, params: Params, h: Array) -> Array:
+        # tied weights (W.T), sigmoid output for cross-entropy reconstruction
+        return jax.nn.sigmoid(h @ params["W"].T + params["vb"])
+
+    def corrupt(self, key: Array, x: Array) -> Array:
+        """Masking corruption at ``corruptionLevel`` (denoising AE)."""
+        lvl = self.conf.corruption_level
+        if lvl <= 0.0:
+            return x
+        mask = jax.random.bernoulli(key, 1.0 - lvl, x.shape)
+        return jnp.where(mask, x, jnp.zeros_like(x))
+
+    def reconstruction_loss(self, params: Params, key: Array, x: Array) -> Array:
+        xc = self.corrupt(key, x)
+        recon = self.decode(params, self.encode(params, xc))
+        # L2 is handled by the updater chain, not the loss (no double-count).
+        return L.score(x, L.LossFunction.RECONSTRUCTION_CROSSENTROPY, recon)
+
+    def pretrain_value_and_grad(self, params: Params, key: Array, x: Array
+                                ) -> Tuple[Array, Params]:
+        return jax.value_and_grad(self.reconstruction_loss)(params, key, x)
+
+    def reconstruct(self, params: Params, x: Array) -> Array:
+        return self.decode(params, self.encode(params, x))
+
+    def activate(self, params, x, key=None, train=False):
+        return self.encode(params, x)
+
+
+@register_layer(LayerKind.RECURSIVE_AUTOENCODER)
+class RecursiveAutoEncoderLayer(PretrainLayer):
+    """Greedy recursive autoencoder over an item axis.
+
+    Input [B, T, D]: repeatedly merges the running representation with the
+    next item via the encoder, accumulating reconstruction loss per merge —
+    capability parity with RecursiveAutoEncoder.java's left-fold over tree
+    leaves, shaped for XLA (scan, static T).
+    """
+
+    def init(self, key: Array) -> Params:
+        # encoder: [2D -> D], decoder: [D -> 2D]
+        d = self.conf.n_in
+        k1, k2 = jax.random.split(key)
+        dtype = jnp.dtype(self.conf.dtype)
+        return {
+            "W": P.init_weight(k1, (2 * d, d), self.conf.weight_init,
+                               self.conf.dist, dtype),
+            "b": jnp.zeros((d,), dtype),
+            "U": P.init_weight(k2, (d, 2 * d), self.conf.weight_init,
+                               self.conf.dist, dtype),
+            "c": jnp.zeros((2 * d,), dtype),
+        }
+
+    def _merge(self, params: Params, a: Array, b: Array) -> Array:
+        return self.activation(jnp.concatenate([a, b], -1) @ params["W"] + params["b"])
+
+    def fold(self, params: Params, xs: Array) -> Tuple[Array, Array]:
+        """xs [B, T, D] -> (root [B, D], total reconstruction loss)."""
+        def step(carry, x_t):
+            rep, loss = carry
+            pair = jnp.concatenate([rep, x_t], -1)
+            merged = self.activation(pair @ params["W"] + params["b"])
+            recon = merged @ params["U"] + params["c"]
+            loss = loss + jnp.mean((recon - pair) ** 2)
+            return (merged, loss), None
+
+        (root, loss), _ = lax.scan(step, (xs[:, 0, :], jnp.float32(0.0)),
+                                   jnp.moveaxis(xs[:, 1:, :], 1, 0))
+        return root, loss
+
+    def pretrain_value_and_grad(self, params: Params, key: Array, x: Array
+                                ) -> Tuple[Array, Params]:
+        def obj(p):
+            _, loss = self.fold(p, x)
+            return loss
+        return jax.value_and_grad(obj)(params)
+
+    def activate(self, params, x, key=None, train=False):
+        root, _ = self.fold(params, x)
+        return root
+
+    def out_features(self, in_features: int) -> int:
+        return self.conf.n_in

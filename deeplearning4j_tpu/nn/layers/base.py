@@ -1,0 +1,107 @@
+"""Layer protocol + factory registry.
+
+Reference parity: ``nn/api/Layer.java:33`` (activate/preOutput/...) and the
+reflection-based ``LayerFactories`` (nn/layers/factory/LayerFactories.java).
+
+TPU-native design: a Layer object is a *stateless description* built from a
+``NeuralNetConfiguration``; all state (params) lives in pytrees passed in and
+out.  This keeps every method jit-traceable and makes distribution trivial
+(params are sharded pytrees, methods run under pjit/shard_map).
+
+Methods:
+- ``init(key) -> params``                     (ParamInitializer parity)
+- ``pre_output(params, x) -> z``              (Layer.preOutput — x·W + b)
+- ``activate(params, x, key=None, train=False) -> y``  (Layer.activate)
+- pretrain layers add ``pretrain_value_and_grad(params, key, x)
+  -> (score, grads)`` — used by greedy layer-wise pretraining.  For
+  differentiable objectives (autoencoders) this is ``jax.value_and_grad``;
+  for RBM it is the explicit CD-k estimator (which is NOT the gradient of
+  any scalar loss — mirroring ``RBM.gradient`` rbm/RBM.java:114).
+
+Backprop through stacks is ``jax.grad`` end-to-end — the reference's manual
+``backWard`` chain (BaseLayer.java:372) is subsumed by autodiff.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import LayerKind, NeuralNetConfiguration
+from deeplearning4j_tpu.ops.registry import get_activation
+from deeplearning4j_tpu.ops import random as dl4j_random
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+_LAYER_REGISTRY: Dict[LayerKind, Type["Layer"]] = {}
+
+
+def register_layer(kind: LayerKind):
+    def deco(cls: Type["Layer"]):
+        _LAYER_REGISTRY[kind] = cls
+        cls.kind = kind
+        return cls
+    return deco
+
+
+def make_layer(conf: NeuralNetConfiguration) -> "Layer":
+    """LayerFactories.getFactory(conf).create(conf) equivalent."""
+    try:
+        return _LAYER_REGISTRY[conf.kind](conf)
+    except KeyError:
+        raise ValueError(
+            f"no layer registered for kind {conf.kind}; "
+            f"known {sorted(k.value for k in _LAYER_REGISTRY)}") from None
+
+
+class Layer:
+    """Base layer: affine pre-output + named activation + optional dropout."""
+
+    kind: LayerKind
+    is_pretrainable: bool = False
+
+    def __init__(self, conf: NeuralNetConfiguration):
+        self.conf = conf
+        self.activation = get_activation(conf.activation)
+
+    # -- state -------------------------------------------------------------
+    def init(self, key: Array) -> Params:
+        raise NotImplementedError
+
+    # -- compute -----------------------------------------------------------
+    def pre_output(self, params: Params, x: Array) -> Array:
+        """input·W + b (BaseLayer.preOutput:177). Runs the matmul in the
+        layer's compute dtype (bfloat16 default — MXU-native) and returns
+        fp32 for stable nonlinearities/losses."""
+        cdt = jnp.dtype(self.conf.compute_dtype)
+        z = x.astype(cdt) @ params["W"].astype(cdt) + params["b"].astype(cdt)
+        return z.astype(jnp.float32)
+
+    def activate(self, params: Params, x: Array,
+                 key: Optional[Array] = None, train: bool = False) -> Array:
+        z = self.pre_output(params, x)
+        y = self.activation(z)
+        if train and self.conf.dropout > 0.0 and key is not None:
+            y = dl4j_random.dropout(key, y, self.conf.dropout)
+        return y
+
+    # Output shape bookkeeping for stack wiring (MultiLayerNetwork.init uses
+    # hiddenLayerSizes; conv/subsampling layers compute their own).
+    def out_features(self, in_features: int) -> int:
+        return self.conf.n_out
+
+    def __repr__(self):
+        return f"{type(self).__name__}(n_in={self.conf.n_in}, n_out={self.conf.n_out})"
+
+
+class PretrainLayer(Layer):
+    """A layer trainable unsupervised (RBM/AutoEncoder family)."""
+
+    is_pretrainable = True
+
+    def pretrain_value_and_grad(self, params: Params, key: Array, x: Array
+                                ) -> Tuple[Array, Params]:
+        raise NotImplementedError

@@ -1,0 +1,78 @@
+"""Convolution + subsampling layers.
+
+Reference parity: ``nn/layers/convolution/ConvolutionDownSampleLayer.java:37``
+— the reference implements conv+downsample with ND4J slice loops
+(``activate:68``).  TPU-native: ``lax.conv_general_dilated`` in NHWC/HWIO
+layout (the MXU-friendly convention XLA tiles directly onto the systolic
+array) and ``lax.reduce_window`` pooling; the conv runs in bfloat16 compute
+dtype with fp32 accumulation/output.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.configuration import LayerKind
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn import params as P
+from deeplearning4j_tpu.ops import random as dl4j_random
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+@register_layer(LayerKind.CONVOLUTION)
+class ConvolutionLayer(Layer):
+    """2-D convolution, NHWC input [B, H, W, C] -> [B, H', W', nFilters]."""
+
+    def init(self, key: Array) -> Params:
+        return P.convolution_params(key, self.conf)
+
+    def pre_output(self, params: Params, x: Array) -> Array:
+        cdt = jnp.dtype(self.conf.compute_dtype)
+        y = lax.conv_general_dilated(
+            x.astype(cdt), params["W"].astype(cdt),
+            window_strides=self.conf.stride,
+            padding=self.conf.padding,
+            dimension_numbers=_DIMS,
+            preferred_element_type=jnp.float32,
+        )
+        return y + params["b"].astype(jnp.float32)
+
+    def activate(self, params, x, key=None, train=False):
+        y = self.activation(self.pre_output(params, x))
+        if train and self.conf.dropout > 0.0 and key is not None:
+            y = dl4j_random.dropout(key, y, self.conf.dropout)
+        return y
+
+    def out_features(self, in_features: int) -> int:
+        return self.conf.n_filters
+
+
+@register_layer(LayerKind.SUBSAMPLING)
+class SubsamplingLayer(Layer):
+    """Max/avg pooling (the "DownSample" half of the reference's fused
+    conv+downsample layer, split out as its own composable layer)."""
+
+    def init(self, key: Array) -> Params:
+        return {}  # stateless
+
+    def activate(self, params, x, key=None, train=False):
+        ph, pw = self.conf.pool_size
+        window = (1, ph, pw, 1)
+        strides = (1, ph, pw, 1)
+        if self.conf.pool_type == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, "VALID")
+        if self.conf.pool_type == "avg":
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, "VALID")
+            return s / (ph * pw)
+        raise ValueError(f"unknown pool_type {self.conf.pool_type}")
+
+    def out_features(self, in_features: int) -> int:
+        return in_features

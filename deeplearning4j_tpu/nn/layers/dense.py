@@ -1,0 +1,19 @@
+"""Dense (fully-connected) layer — the reference's plain ``BaseLayer``
+behavior (nn/layers/BaseLayer.java:42): z = x·W + b, named activation,
+optional dropout."""
+
+from __future__ import annotations
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.configuration import LayerKind
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn import params as P
+
+Array = jax.Array
+
+
+@register_layer(LayerKind.DENSE)
+class DenseLayer(Layer):
+    def init(self, key: Array):
+        return P.default_params(key, self.conf)

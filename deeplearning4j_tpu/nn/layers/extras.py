@@ -1,0 +1,56 @@
+"""Additional layers the new model families need (no direct reference
+counterpart — capability extensions kept in the same Layer SPI)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import LayerKind
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn import params as P
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+
+@register_layer(LayerKind.EMBEDDING)
+class EmbeddingLayer(Layer):
+    """Token-id lookup: [B, T] int32 -> [B, T, nOut]."""
+
+    def init(self, key: Array) -> Params:
+        return {"W": P.init_weight(key, (self.conf.n_in, self.conf.n_out),
+                                   self.conf.weight_init, self.conf.dist,
+                                   jnp.dtype(self.conf.dtype))}
+
+    def activate(self, params, x, key=None, train=False):
+        return jnp.take(params["W"], x.astype(jnp.int32), axis=0)
+
+
+@register_layer(LayerKind.BATCH_NORM)
+class BatchNormLayer(Layer):
+    """Batch normalization over the last axis (stateless running stats are
+    carried in params as non-trained leaves, updated by the trainer)."""
+
+    def init(self, key: Array) -> Params:
+        n = self.conf.n_out or self.conf.n_in
+        return {
+            "scale": jnp.ones((n,), jnp.float32),
+            "bias": jnp.zeros((n,), jnp.float32),
+            "running_mean": jnp.zeros((n,), jnp.float32),
+            "running_var": jnp.ones((n,), jnp.float32),
+        }
+
+    def activate(self, params, x, key=None, train=False):
+        if train:
+            mean = jnp.mean(x, axis=tuple(range(x.ndim - 1)))
+            var = jnp.var(x, axis=tuple(range(x.ndim - 1)))
+        else:
+            mean, var = params["running_mean"], params["running_var"]
+        xn = (x - mean) / jnp.sqrt(var + 1e-5)
+        return xn * params["scale"] + params["bias"]
+
+    def out_features(self, in_features: int) -> int:
+        return in_features

@@ -1,0 +1,47 @@
+"""Output layer — softmax (or other activation) classifier head.
+
+Reference parity: ``nn/layers/OutputLayer.java:47`` — activation over
+pre-output, score via ``LossFunctions`` (:68-92), fit with its own solver
+loop (:233).  Here the layer only defines math; training drives it through
+``optimize.Solver`` like everything else.
+
+TPU-native numerics: when the configured pair is (softmax, mcxent/nll) or
+(sigmoid, xent), ``loss_from_logits`` uses the fused stable form so the
+whole head is one XLA fusion.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.configuration import LayerKind
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn import params as P
+from deeplearning4j_tpu.ops import losses as L
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+
+@register_layer(LayerKind.OUTPUT)
+class OutputLayer(Layer):
+    def init(self, key: Array) -> Params:
+        return P.default_params(key, self.conf)
+
+    def loss(self, params: Params, x: Array, labels: Array) -> Array:
+        """Score on (input, labels): activation -> LossFunctions.score
+        (OutputLayer.java:68-92).  L2 regularization is NOT added here — it
+        is applied once, by the updater's GradientAdjustment chain."""
+        lf = L.LossFunction(self.conf.loss_function)
+        act = self.conf.activation
+        z = self.pre_output(params, x)
+        if act == "softmax" and lf in (L.LossFunction.MCXENT,
+                                       L.LossFunction.NEGATIVELOGLIKELIHOOD):
+            base = L.softmax_cross_entropy_with_logits(labels, z)
+        elif act == "sigmoid" and lf is L.LossFunction.XENT:
+            base = L.sigmoid_binary_cross_entropy_with_logits(labels, z)
+        else:
+            base = L.score(labels, lf, self.activation(z))
+        return base

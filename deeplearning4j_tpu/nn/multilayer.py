@@ -1,0 +1,363 @@
+"""MultiLayerNetwork — the model: a stack of layers + output layer.
+
+Reference parity (nn/multilayer/MultiLayerNetwork.java):
+- ctor from conf ``:82`` / ``init:325`` (builds layers via factories, wires
+  nIn/nOut from ``hiddenLayerSizes``)
+- ``pretrain(iter):144`` greedy layer-wise unsupervised training
+- ``feedForward:462``, ``output:1147``, ``predict:1057``, ``score:1213``
+- ``fit(iter):918`` = pretrain -> finetune -> optional backprop
+- ``finetune:987`` (trains the output layer on last hidden activations)
+- param pack/unpack ``:773/:817``, distributed ``merge:1321``
+- serialization = conf JSON + flat param vector ``:93-97``
+
+TPU-native:
+- params are a list of per-layer dicts (one pytree) — shardable under pjit;
+- the supervised loss is differentiable end-to-end, so "backprop" is
+  ``jax.grad`` of ``loss`` (the reference's manual ``doBackWard:941`` chain
+  is subsumed);
+- ``fit`` on minibatches compiles ONE fused train step (value+grad+update)
+  and reuses it across batches/epochs;
+- dropout/sampling keys are threaded explicitly.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.configuration import (
+    LayerKind, MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import make_preprocessor
+from deeplearning4j_tpu.nn.layers import make_layer
+from deeplearning4j_tpu.nn.layers.base import Layer, PretrainLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.params import pack_params, unpack_params
+from deeplearning4j_tpu.ops.updaters import apply_updates, dl4j_updater
+from deeplearning4j_tpu.optimize.solver import Objective, Solver
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+log = logging.getLogger(__name__)
+
+Array = jax.Array
+Params = List[Dict[str, Array]]
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration,
+                 params: Optional[Params] = None):
+        self.conf = conf
+        self._wire_layer_sizes()
+        self.layers: List[Layer] = [make_layer(c) for c in conf.confs]
+        self.params: Optional[Params] = params
+        self.listeners: List[IterationListener] = []
+        self._in_pre = {i: make_preprocessor(spec)
+                        for i, spec in conf.input_preprocessors.items()}
+        self._out_pre = {i: make_preprocessor(spec)
+                         for i, spec in conf.output_preprocessors.items()}
+        self._jit_cache: Dict[Any, Callable] = {}
+
+    # -- wiring (init:325 parity) ------------------------------------------
+    def _wire_layer_sizes(self) -> None:
+        confs = self.conf.confs
+        sizes = self.conf.hidden_layer_sizes
+        if sizes:
+            n_in = confs[0].n_in
+            if n_in <= 0:
+                raise ValueError("first layer needs n_in when using "
+                                 "hidden_layer_sizes")
+            dims = [n_in] + list(sizes)
+            for i, c in enumerate(confs[:-1]):
+                if i < len(dims) - 1:
+                    c.n_in, c.n_out = dims[i], dims[i + 1]
+            out = confs[-1]
+            out.n_in = dims[-1]
+            if out.n_out <= 0:
+                raise ValueError("output layer needs n_out")
+        else:
+            for prev, cur in zip(confs[:-1], confs[1:]):
+                if cur.n_in <= 0 and cur.kind not in (
+                        LayerKind.CONVOLUTION, LayerKind.SUBSAMPLING):
+                    cur.n_in = prev.n_out
+
+    # -- init --------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        seed = self.conf.confs[0].seed if seed is None else seed
+        keys = jax.random.split(jax.random.key(seed), len(self.layers))
+        self.params = [layer.init(k) for layer, k in zip(self.layers, keys)]
+        return self
+
+    def _require_params(self) -> Params:
+        if self.params is None:
+            self.init()
+        return self.params  # type: ignore[return-value]
+
+    @property
+    def output_layer(self) -> OutputLayer:
+        last = self.layers[-1]
+        if not isinstance(last, OutputLayer):
+            raise TypeError("last layer is not an OutputLayer")
+        return last
+
+    # -- forward (feedForward:462 parity) ----------------------------------
+    def feed_forward(self, params: Params, x: Array,
+                     key: Optional[Array] = None, train: bool = False,
+                     upto: Optional[int] = None) -> List[Array]:
+        """Returns [input, act_0, ..., act_{upto-1}]."""
+        n = len(self.layers) if upto is None else upto
+        acts = [x]
+        keys = (jax.random.split(key, n) if key is not None else [None] * n)
+        for i in range(n):
+            h = acts[-1]
+            if i in self._in_pre:
+                h = self._in_pre[i](h, keys[i])
+            h = self.layers[i].activate(params[i], h, key=keys[i], train=train)
+            if i in self._out_pre:
+                h = self._out_pre[i](h, keys[i])
+            acts.append(h)
+        return acts
+
+    def hidden_activations(self, params: Params, x: Array,
+                           key: Optional[Array] = None,
+                           train: bool = False) -> Array:
+        """Activations entering the output layer (input to finetune)."""
+        return self.feed_forward(params, x, key, train,
+                                 upto=len(self.layers) - 1)[-1]
+
+    # -- losses ------------------------------------------------------------
+    def loss(self, params: Params, x: Array, labels: Array,
+             key: Optional[Array] = None, train: bool = False) -> Array:
+        """End-to-end supervised loss (differentiable — backprop is
+        jax.grad of this)."""
+        h = self.hidden_activations(params, x, key, train)
+        if len(self.layers) - 1 in self._in_pre:
+            h = self._in_pre[len(self.layers) - 1](h, key)
+        return self.output_layer.loss(params[-1], h, labels)
+
+    # -- inference (output:1147 / predict:1057 / score:1213) ---------------
+    def output(self, x: Array, params: Optional[Params] = None) -> Array:
+        params = params if params is not None else self._require_params()
+        return self.feed_forward(params, x)[-1]
+
+    def predict(self, x: Array) -> Array:
+        return jnp.argmax(self.output(x), axis=-1)
+
+    def score(self, data: DataSet, params: Optional[Params] = None) -> float:
+        params = params if params is not None else self._require_params()
+        return float(self.loss(params, data.features, data.labels))
+
+    # -- pretrain (pretrain:144 parity) ------------------------------------
+    def pretrain(self, data: Union[DataSet, Sequence[DataSet]],
+                 seed: int = 0) -> None:
+        """Greedy layer-wise: train each pretrainable layer on the
+        activations of the stack below it, batch by batch.
+
+        For GRADIENT_DESCENT (the default) the step is jitted ONCE per layer
+        with the batch as a traced argument — no per-batch recompilation.
+        Line-search algorithms (CG/LBFGS) run a full Solver per batch (they
+        are full-batch methods; the reference does the same)."""
+        from deeplearning4j_tpu.nn.conf.configuration import OptimizationAlgorithm
+        params = self._require_params()
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        key = jax.random.key(seed)
+        for i, layer in enumerate(self.layers):
+            if not isinstance(layer, PretrainLayer):
+                continue
+            conf = self.conf.confs[i]
+
+            # Inputs to layer i under the CURRENT stack params (greedy).
+            def layer_input(x: Array) -> Array:
+                return self.feed_forward(params, x, upto=i)[-1]
+
+            if conf.optimization_algo in (
+                    OptimizationAlgorithm.GRADIENT_DESCENT,
+                    OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT):
+                updater = dl4j_updater(
+                    lr=conf.lr, momentum=conf.momentum,
+                    momentum_schedule=conf.momentum_after,
+                    use_adagrad=conf.use_adagrad, l2=conf.l2,
+                    use_regularization=conf.use_regularization,
+                    constrain_unit_norm=conf.constrain_gradient_to_unit_norm,
+                )
+
+                @jax.jit
+                def gd_step(p, ustate, inputs, k, it, _layer=layer,
+                            _updater=updater):
+                    score, grads = _layer.pretrain_value_and_grad(p, k, inputs)
+                    # batch_size=1: objectives are batch MEANS (the ÷batch
+                    # step exists for parity with summed reference grads)
+                    updates, ustate = _updater.update(ustate, grads, p, it, 1)
+                    return apply_updates(p, updates), ustate, score
+
+                ustate = updater.init(params[i])
+                it = 0
+                for batch in batches:
+                    inputs = layer_input(batch.features)
+                    for _ in range(conf.num_iterations):
+                        key, sub = jax.random.split(key)
+                        params[i], ustate, score = gd_step(
+                            params[i], ustate, inputs, sub, it)
+                        for ls in self.listeners:
+                            ls.iteration_done(self, it, float(score))
+                        it += 1
+            else:
+                for b, batch in enumerate(batches):
+                    inputs = layer_input(batch.features)
+                    objective = Objective(
+                        value_and_grad=lambda p, k: layer.pretrain_value_and_grad(
+                            p, k, inputs),
+                        value=lambda p, k: layer.pretrain_value_and_grad(
+                            p, k, inputs)[0],
+                        batch_size=1,
+                    )
+                    solver = Solver(conf, objective, listeners=self.listeners)
+                    key, sub = jax.random.split(key)
+                    params[i] = solver.optimize(params[i], sub)
+                    log.debug("pretrain layer %d batch %d done", i, b)
+        self.params = params
+
+    # -- finetune (finetune:987 parity) ------------------------------------
+    def finetune(self, data: DataSet, seed: int = 1) -> None:
+        """Train ONLY the output layer on last-hidden activations."""
+        params = self._require_params()
+        h = self.hidden_activations(params, data.features)
+        # Same boundary transform as loss(): the output layer must train on
+        # exactly what it sees at inference.
+        last = len(self.layers) - 1
+        if last in self._in_pre:
+            h = self._in_pre[last](h, None)
+        out_conf = self.conf.confs[-1]
+        out_layer = self.output_layer
+        objective = Objective(
+            value_and_grad=lambda p, k: jax.value_and_grad(
+                out_layer.loss)(p, h, data.labels),
+            value=lambda p, k: out_layer.loss(p, h, data.labels),
+            batch_size=1,
+        )
+        solver = Solver(out_conf, objective, listeners=self.listeners)
+        params[-1] = solver.optimize(params[-1], jax.random.key(seed))
+        self.params = params
+
+    # -- backprop fine-tuning (doBackWard:941 ≡ jax.grad of loss) ----------
+    def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
+                     num_epochs: int = 1, seed: int = 2) -> None:
+        """Full-network supervised minibatch training with ONE fused,
+        jit-compiled train step (value+grad+GradientAdjustment+update).
+
+        Each layer gets its OWN updater from its conf, so per-layer
+        lr/momentum/l2 overrides (ConfOverride parity) take effect."""
+        params = self._require_params()
+        updaters = [dl4j_updater(
+            lr=c.lr, momentum=c.momentum, momentum_schedule=c.momentum_after,
+            use_adagrad=c.use_adagrad, l2=c.l2,
+            use_regularization=c.use_regularization,
+            constrain_unit_norm=c.constrain_gradient_to_unit_norm,
+        ) for c in self.conf.confs]
+        bn_layers = [i for i, c in enumerate(self.conf.confs)
+                     if c.kind is LayerKind.BATCH_NORM]
+
+        @jax.jit
+        def train_step(params, ustate, x, y, key, iteration):
+            def obj(p):
+                return self.loss(p, x, y, key, train=True)
+            score, grads = jax.value_and_grad(obj)(params)
+            new_params, new_ustate = [], []
+            for i, upd in enumerate(updaters):
+                u_i, s_i = upd.update(ustate[i], grads[i], params[i],
+                                      iteration, 1)
+                new_params.append(apply_updates(params[i], u_i))
+                new_ustate.append(s_i)
+            if bn_layers:
+                # EMA-refresh batch-norm running stats from this batch's
+                # activations (momentum 0.9) — the trainer-side update the
+                # BatchNormLayer contract requires.
+                acts = self.feed_forward(new_params, x, key, train=True)
+                for i in bn_layers:
+                    h_in = acts[i]
+                    mean = jnp.mean(h_in, axis=tuple(range(h_in.ndim - 1)))
+                    var = jnp.var(h_in, axis=tuple(range(h_in.ndim - 1)))
+                    p = dict(new_params[i])
+                    p["running_mean"] = 0.9 * p["running_mean"] + 0.1 * mean
+                    p["running_var"] = 0.9 * p["running_var"] + 0.1 * var
+                    new_params[i] = p
+            return new_params, new_ustate, score
+
+        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        key = jax.random.key(seed)
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        it = 0
+        for epoch in range(num_epochs):
+            for batch in batches:
+                key, sub = jax.random.split(key)
+                params, ustate, score = train_step(
+                    params, ustate, batch.features, batch.labels, sub, it)
+                for ls in self.listeners:
+                    ls.iteration_done(self, it, float(score))
+                it += 1
+        self.params = params
+
+    # -- fit (fit:918 parity: pretrain -> finetune -> optional backprop) ---
+    def fit(self, data: Union[DataSet, Sequence[DataSet]],
+            num_epochs: int = 1) -> None:
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        if self.conf.pretrain:
+            self.pretrain(batches)
+        merged = DataSet.merge(batches) if len(batches) > 1 else batches[0]
+        self.finetune(merged)
+        if self.conf.backprop:
+            self.fit_backprop(batches, num_epochs=num_epochs)
+
+    # -- evaluation helper -------------------------------------------------
+    def evaluate(self, data: DataSet):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation(num_classes=data.num_outcomes())
+        ev.eval(data.labels, self.output(data.features))
+        return ev
+
+    # -- params plumbing (pack:773 / unPack:817 / merge:1321 / setParams) --
+    def params_flat(self) -> Array:
+        return pack_params(self._require_params())
+
+    def set_params_flat(self, flat: Array) -> None:
+        self.params = unpack_params(flat, self._require_params())
+
+    def merge(self, others: Sequence["MultiLayerNetwork"]) -> None:
+        """Parameter averaging with peers (distributed merge:1321)."""
+        all_params = [self._require_params()] + \
+            [o._require_params() for o in others]
+        n = float(len(all_params))
+        self.params = jax.tree.map(lambda *ps: sum(ps) / n, *all_params)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(MultiLayerConfiguration.from_json(
+            self.conf.to_json()))
+        if self.params is not None:
+            net.params = jax.tree.map(jnp.copy, self.params)
+        return net
+
+    # -- serialization (conf JSON + flat params :93-97) --------------------
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        np.savez(buf, conf=self.conf.to_json(),
+                 params=np.asarray(self.params_flat()))
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(blob: bytes) -> "MultiLayerNetwork":
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            conf = MultiLayerConfiguration.from_json(str(z["conf"]))
+            net = MultiLayerNetwork(conf).init()
+            net.set_params_flat(jnp.asarray(z["params"]))
+        return net
+
+    def set_listeners(self, listeners: Sequence[IterationListener]) -> None:
+        self.listeners = list(listeners)
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])

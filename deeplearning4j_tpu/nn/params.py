@@ -1,0 +1,152 @@
+"""Parameter initialization — parity with ``nn/params/`` + ``nn/weights/``.
+
+The reference's ``ParamInitializer`` classes build named parameter tables:
+- ``DefaultParamInitializer`` — keys ``"W"``, ``"b"``
+- ``PretrainParamInitializer`` — adds visible bias ``"vb"``
+- ``LSTMParamInitializer`` (nn/params/LSTMParamInitializer.java:~30) —
+  fused recurrent weights sized ``(nIn+hidden+1) x 4*hidden``, decoder
+  weights+bias
+- ``ConvolutionParamInitializer`` — filter tensor + per-filter bias
+
+``WeightInit`` schemes (nn/weights/WeightInit.java): VI (variance-scaled
+uniform, a.k.a. Glorot-uniform), ZERO, SIZE, DISTRIBUTION, NORMALIZED,
+UNIFORM — plus modern XAVIER/HE/LECUN for the new families.
+
+Params are plain dicts of jnp arrays (pytrees) — the reference's
+``Map<String,INDArray> paramTable`` — so they compose with jit/pjit/optax.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration, WeightInit
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+# Canonical parameter keys (DefaultParamInitializer.W_KEY / B_KEY parity).
+W_KEY = "W"
+B_KEY = "b"
+VISIBLE_BIAS_KEY = "vb"
+
+
+def init_weight(key: Array, shape: Sequence[int], scheme: WeightInit,
+                dist: Tuple[str, float, float] = ("normal", 0.0, 0.01),
+                dtype=jnp.float32) -> Array:
+    """One weight tensor under a named scheme.
+
+    fan_in/fan_out follow the last-two-dims convention so conv filters
+    (H, W, Cin, Cout) and matrices (in, out) both work.
+    """
+    shape = tuple(shape)
+    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+    fan_out = shape[-1]
+    if len(shape) == 4:  # HWIO conv filter
+        receptive = shape[0] * shape[1]
+        fan_in, fan_out = shape[2] * receptive, shape[3] * receptive
+
+    if scheme is WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme is WeightInit.UNIFORM:
+        a = 1.0 / max(fan_in, 1)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme in (WeightInit.VI, WeightInit.XAVIER):
+        # VI: uniform scaled by sqrt(6/(fan_in+fan_out)) (Glorot) — the
+        # reference's WeightInitUtil VI uses +/- sqrt(6/(in+out)).
+        a = math.sqrt(6.0 / max(fan_in + fan_out, 1))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme is WeightInit.SIZE:
+        a = math.sqrt(2.0 / max(fan_in + fan_out, 1))
+        return a * jax.random.normal(key, shape, dtype)
+    if scheme is WeightInit.NORMALIZED:
+        w = jax.random.uniform(key, shape, dtype, -0.5, 0.5)
+        return w / max(fan_in, 1)
+    if scheme is WeightInit.DISTRIBUTION:
+        name, p0, p1 = dist
+        if name == "normal":
+            return p0 + p1 * jax.random.normal(key, shape, dtype)
+        if name == "uniform":
+            return jax.random.uniform(key, shape, dtype, p0, p1)
+        raise ValueError(f"unknown distribution '{name}'")
+    if scheme is WeightInit.HE:
+        return math.sqrt(2.0 / max(fan_in, 1)) * jax.random.normal(key, shape, dtype)
+    if scheme is WeightInit.LECUN:
+        return math.sqrt(1.0 / max(fan_in, 1)) * jax.random.normal(key, shape, dtype)
+    raise ValueError(f"unknown WeightInit {scheme}")
+
+
+def default_params(key: Array, conf: NeuralNetConfiguration) -> Params:
+    """DefaultParamInitializer: W (nIn x nOut) + b (nOut,)."""
+    dtype = jnp.dtype(conf.dtype)
+    return {
+        W_KEY: init_weight(key, (conf.n_in, conf.n_out), conf.weight_init,
+                           conf.dist, dtype),
+        B_KEY: jnp.zeros((conf.n_out,), dtype),
+    }
+
+
+def pretrain_params(key: Array, conf: NeuralNetConfiguration) -> Params:
+    """PretrainParamInitializer: adds visible bias for RBM/AutoEncoder."""
+    p = default_params(key, conf)
+    p[VISIBLE_BIAS_KEY] = jnp.zeros((conf.n_in,), jnp.dtype(conf.dtype))
+    return p
+
+
+def convolution_params(key: Array, conf: NeuralNetConfiguration) -> Params:
+    """ConvolutionParamInitializer: HWIO filter + per-filter bias (NHWC/HWIO
+    is the TPU-native layout; the reference uses [nFilters, ch, kh, kw])."""
+    kh, kw = conf.kernel_size
+    dtype = jnp.dtype(conf.dtype)
+    return {
+        W_KEY: init_weight(key, (kh, kw, conf.n_channels, conf.n_filters),
+                           conf.weight_init, conf.dist, dtype),
+        B_KEY: jnp.zeros((conf.n_filters,), dtype),
+    }
+
+
+def lstm_params(key: Array, conf: NeuralNetConfiguration) -> Params:
+    """LSTMParamInitializer parity: one fused recurrent matrix for all four
+    gates sized (nIn + hidden) x 4*hidden (+ fused gate bias), plus decoder
+    weights/bias to project hidden -> nOut.  The reference folds the bias row
+    into the matrix ((nIn+hidden+1) x 4*hidden); we keep a separate bias for
+    XLA-friendly fused matmul + broadcast-add.
+    """
+    hidden = conf.hidden_size or conf.n_out
+    dtype = jnp.dtype(conf.dtype)
+    k1, k2 = jax.random.split(key)
+    return {
+        "recurrent_W": init_weight(k1, (conf.n_in + hidden, 4 * hidden),
+                                   conf.weight_init, conf.dist, dtype),
+        "recurrent_b": jnp.zeros((4 * hidden,), dtype),
+        "decoder_W": init_weight(k2, (hidden, conf.n_out), conf.weight_init,
+                                 conf.dist, dtype),
+        "decoder_b": jnp.zeros((conf.n_out,), dtype),
+    }
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def pack_params(params) -> Array:
+    """Flatten a params pytree to one vector — parity with
+    ``MultiLayerNetwork.pack`` (MultiLayerNetwork.java:773); used for
+    parameter averaging and serialization."""
+    leaves = jax.tree.leaves(params)
+    return jnp.concatenate([jnp.ravel(p) for p in leaves]) if leaves else jnp.zeros((0,))
+
+
+def unpack_params(flat: Array, like) -> "jax.tree_util.PyTreeDef":
+    """Inverse of ``pack_params`` given a template pytree (``unPack:817``)."""
+    leaves, treedef = jax.tree.flatten(like)
+    out, i = [], 0
+    for leaf in leaves:
+        n = int(leaf.size)
+        out.append(jnp.reshape(flat[i:i + n], leaf.shape).astype(leaf.dtype))
+        i += n
+    return jax.tree.unflatten(treedef, out)

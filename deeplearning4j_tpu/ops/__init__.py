@@ -1,0 +1,17 @@
+"""Tensor-op substrate: the ND4J-equivalent layer.
+
+The reference dispatches string-named elementwise transforms through
+``Nd4j.getExecutioner()``/``getOpFactory()`` (e.g. BaseLayer.java:203,
+MultiLayerNetwork.java:956 request ``activation`` and ``activation+"derivative"``
+ops by name).  Here the same capability is a registry of pure JAX functions
+with autodiff-derived derivatives.
+"""
+
+from deeplearning4j_tpu.ops.registry import (  # noqa: F401
+    get_activation,
+    get_activation_derivative,
+    register_activation,
+    list_activations,
+)
+from deeplearning4j_tpu.ops.losses import LossFunction, score as loss_score  # noqa: F401
+from deeplearning4j_tpu.ops import random  # noqa: F401

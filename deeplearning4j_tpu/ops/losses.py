@@ -1,0 +1,83 @@
+"""Loss functions — parity with ND4J ``LossFunctions.LossFunction``.
+
+The reference scores layers via ``LossFunctions.score(labels, lossFunction,
+output, l2, useRegularization)`` (consumed at OutputLayer.java:68-92,
+BasePretrainNetwork reconstruction scores).  The enum there is:
+MSE, EXPLL, XENT, MCXENT, RMSE_XENT, SQUARED_LOSS,
+RECONSTRUCTION_CROSSENTROPY, NEGATIVELOGLIKELIHOOD.
+
+All losses are mean-per-example scalars, jit-safe, fp32-accumulated (inputs
+may arrive bfloat16 from the MXU path).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-10
+
+
+class LossFunction(str, enum.Enum):
+    MSE = "mse"
+    EXPLL = "expll"                      # exponential log-likelihood (Poisson)
+    XENT = "xent"                        # binary cross-entropy
+    MCXENT = "mcxent"                    # multiclass cross-entropy
+    RMSE_XENT = "rmse_xent"
+    SQUARED_LOSS = "squared_loss"
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    COSINE_PROXIMITY = "cosine_proximity"
+
+
+def score(labels: Array, loss: LossFunction | str, output: Array) -> Array:
+    """Mean loss over the batch. ``output`` is the model's (post-activation)
+    prediction, as in the reference (loss composed with softmax/sigmoid output
+    activations, not logits — logit-space variants live in the model families
+    where they matter for numerics)."""
+    loss = LossFunction(loss)
+    labels = labels.astype(jnp.float32)
+    output = output.astype(jnp.float32)
+    n = labels.shape[0]
+
+    if loss in (LossFunction.MSE, LossFunction.SQUARED_LOSS):
+        per = jnp.sum((labels - output) ** 2, axis=-1)
+        if loss is LossFunction.MSE:
+            per = per / labels.shape[-1]
+        return jnp.mean(per)
+    if loss is LossFunction.RMSE_XENT:
+        return jnp.mean(jnp.sqrt(jnp.sum((labels - output) ** 2, axis=-1) + _EPS))
+    if loss is LossFunction.XENT or loss is LossFunction.RECONSTRUCTION_CROSSENTROPY:
+        p = jnp.clip(output, _EPS, 1.0 - _EPS)
+        per = -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p), axis=-1)
+        return jnp.mean(per)
+    if loss in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        p = jnp.clip(output, _EPS, 1.0)
+        return jnp.mean(-jnp.sum(labels * jnp.log(p), axis=-1))
+    if loss is LossFunction.EXPLL:
+        # Poisson NLL: mean(output - labels*log(output))
+        p = jnp.clip(output, _EPS, None)
+        return jnp.mean(jnp.sum(p - labels * jnp.log(p), axis=-1))
+    if loss is LossFunction.COSINE_PROXIMITY:
+        num = jnp.sum(labels * output, axis=-1)
+        den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(output, axis=-1) + _EPS
+        return -jnp.mean(num / den)
+    raise ValueError(f"unhandled loss {loss}")
+
+
+def softmax_cross_entropy_with_logits(labels: Array, logits: Array) -> Array:
+    """Numerically-stable MCXENT on logits — the TPU-native path the model
+    families use (fuses into one XLA op chain; avoids log(softmax) blowup)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * logp, axis=-1))
+
+
+def sigmoid_binary_cross_entropy_with_logits(labels: Array, logits: Array) -> Array:
+    logits = logits.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.mean(jnp.sum(per, axis=-1))

@@ -1,0 +1,59 @@
+"""RNG stream management.
+
+The reference threads ``org.nd4j.linalg.api.rng`` RNGs and Distributions
+through configs (NeuralNetConfiguration holds an RNG + seed).  The TPU-native
+equivalent is explicit ``jax.random`` key threading: a ``KeyStream`` is a
+convenience for host-side sequential key splitting (init time); inside jit
+everything takes and returns keys explicitly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class KeyStream:
+    """Host-side sequential splitter: ``next()`` yields a fresh key each call.
+
+    Use only OUTSIDE jit (init, data shuffling). Inside jit, split keys
+    explicitly so tracing stays pure.
+    """
+
+    def __init__(self, seed_or_key: int | Array = 0):
+        if isinstance(seed_or_key, int):
+            self._key = jax.random.key(seed_or_key)
+        else:
+            self._key = seed_or_key
+
+    def next(self) -> Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def split(self, n: int) -> Array:
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return jnp.stack(subs)
+
+
+def bernoulli_sample(key: Array, p: Array) -> Array:
+    """Sample {0,1} with probability p (RBM binary units, dropout,
+    BinomialSamplingPreProcessor parity)."""
+    return jax.random.bernoulli(key, p).astype(p.dtype)
+
+
+def gaussian_sample(key: Array, mean: Array, std: float | Array = 1.0) -> Array:
+    return mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+
+
+def dropout(key: Array, x: Array, rate: float) -> Array:
+    """Inverted dropout (scales at train time). The reference's
+    ``BaseLayer.applyDropOutIfNecessary`` (BaseLayer.java:238) zeroes with
+    prob ``dropOut`` without rescaling; we use the standard inverted form so
+    inference needs no correction."""
+    if rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

@@ -1,0 +1,20 @@
+"""Optimization engine — parity with ``optimize/`` of the reference.
+
+``Solver`` dispatches on ``OptimizationAlgorithm`` (Solver.java:51-59) to a
+ConvexOptimizer equivalent; listeners and termination conditions hook the
+iteration loop exactly like ``BaseOptimizer.optimize`` (BaseOptimizer.java:128).
+
+TPU-native: each optimizer's *step* is one jit-compiled fused program
+(value+grad+adjustment+line-search); the Python loop only sequences steps,
+invokes listeners, and checks (host-side) termination — matching the
+reference's listener/termination semantics without dragging Python into the
+hot path.
+"""
+
+from deeplearning4j_tpu.optimize.solver import Solver, Objective  # noqa: F401
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    IterationListener, ScoreIterationListener, ComposableIterationListener,
+)
+from deeplearning4j_tpu.optimize.terminations import (  # noqa: F401
+    EpsTermination, Norm2Termination, ZeroDirection,
+)

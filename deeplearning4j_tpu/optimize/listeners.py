@@ -1,0 +1,67 @@
+"""Iteration listeners — parity with ``optimize/listeners/`` +
+``optimize/api/IterationListener.java``."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, List, Sequence
+
+log = logging.getLogger(__name__)
+
+
+class IterationListener:
+    """Invoked after every optimizer iteration
+    (BaseOptimizer.optimize:179-180 parity)."""
+
+    def iteration_done(self, model: Any, iteration: int, score: float) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Logs the score every N iterations
+    (optimize/listeners/ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10,
+                 sink: Callable[[str], None] | None = None):
+        self.print_iterations = max(1, print_iterations)
+        self.sink = sink or (lambda msg: log.info(msg))
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            self.sink(f"Score at iteration {iteration} is {score}")
+
+
+class ComposableIterationListener(IterationListener):
+    """Fan-out to child listeners (ComposableIterationListener parity)."""
+
+    def __init__(self, listeners: Sequence[IterationListener]):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, score):
+        for ls in self.listeners:
+            ls.iteration_done(model, iteration, score)
+
+
+class CollectScoresListener(IterationListener):
+    """Records (iteration, score) pairs — handy for tests/benchmarks."""
+
+    def __init__(self):
+        self.scores: List[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        self.scores.append((iteration, float(score)))
+
+
+class TimingListener(IterationListener):
+    """Per-iteration wall-clock timing (the reference has no profiler; this
+    is part of the observability upgrade budgeted in SURVEY.md §5.1)."""
+
+    def __init__(self):
+        self.durations: List[float] = []
+        self._last = time.perf_counter()
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        self.durations.append(now - self._last)
+        self._last = now

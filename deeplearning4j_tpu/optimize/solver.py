@@ -1,0 +1,345 @@
+"""Solver — ConvexOptimizer dispatch + implementations.
+
+Reference parity:
+- ``Solver`` (optimize/Solver.java:34) dispatches on OptimizationAlgorithm
+  (:51-59) to GradientAscent/ConjugateGradient/LBFGS/StochasticHessianFree/
+  IterationGradientDescent.
+- ``BaseOptimizer.optimize`` (optimize/solvers/BaseOptimizer.java:128):
+  gradientAndScore -> GradientAdjustment -> BackTrackLineSearch -> listeners
+  -> terminations, per iteration.
+
+TPU-native: the per-iteration step of every optimizer is one jitted program;
+CG/LBFGS operate on the packed flat parameter vector (pack/unpack parity
+with MultiLayerNetwork.pack:773) so dot products/axpy are single fused ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.configuration import (
+    NeuralNetConfiguration, OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.nn.params import pack_params, unpack_params
+from deeplearning4j_tpu.ops.updaters import apply_updates, dl4j_updater
+from deeplearning4j_tpu.optimize.line_search import backtrack_line_search
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.optimize.terminations import (
+    EpsTermination, InvalidScore, TerminationCondition, ZeroDirection,
+)
+
+log = logging.getLogger(__name__)
+
+Array = jax.Array
+Params = Any
+
+
+@dataclasses.dataclass
+class Objective:
+    """What a model hands the solver (Model.gradientAndScore parity).
+
+    value_and_grad(params, key) -> (score, grads)   [grads = descent direction]
+    value(params, key) -> score                      [for line searches]
+    """
+    value_and_grad: Callable[[Params, Array], Tuple[Array, Params]]
+    value: Callable[[Params, Array], Array]
+    batch_size: int = 1
+
+
+class BaseOptimizer:
+    """Python loop over jitted steps, with listeners + terminations."""
+
+    def __init__(self, conf: NeuralNetConfiguration, objective: Objective,
+                 listeners: Sequence[IterationListener] = (),
+                 terminations: Sequence[TerminationCondition] | None = None):
+        self.conf = conf
+        self.objective = objective
+        self.listeners = list(listeners)
+        self.terminations = (list(terminations) if terminations is not None
+                             else [EpsTermination(), ZeroDirection(), InvalidScore()])
+        self.score_history: List[float] = []
+
+    def optimize(self, params: Params, key: Array) -> Params:
+        raise NotImplementedError
+
+    def _notify(self, iteration: int, score: float):
+        self.score_history.append(score)
+        for ls in self.listeners:
+            ls.iteration_done(self, iteration, score)
+
+    def _should_stop(self, new: float, old: float, gnorm: float) -> bool:
+        return any(t.terminate(new, old, gnorm) for t in self.terminations)
+
+
+class GradientDescentOptimizer(BaseOptimizer):
+    """SGD with the reference's GradientAdjustment chain
+    (AdaGrad-or-lr, momentum schedule, L2, unit-norm, ÷batch)."""
+
+    def __init__(self, conf, objective, **kw):
+        super().__init__(conf, objective, **kw)
+        self.updater = dl4j_updater(
+            lr=conf.lr, momentum=conf.momentum,
+            momentum_schedule=conf.momentum_after,
+            use_adagrad=conf.use_adagrad, l2=conf.l2,
+            use_regularization=conf.use_regularization,
+            constrain_unit_norm=conf.constrain_gradient_to_unit_norm,
+        )
+
+        @jax.jit
+        def step(params, ustate, key, iteration):
+            score, grads = objective.value_and_grad(params, key)
+            updates, ustate = self.updater.update(
+                ustate, grads, params, iteration, objective.batch_size)
+            params = apply_updates(params, updates)
+            gnorm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree.leaves(grads)))
+            return params, ustate, score, gnorm
+
+        self._step = step
+
+    def optimize(self, params: Params, key: Array) -> Params:
+        ustate = self.updater.init(params)
+        old_score = float("inf")
+        for i in range(self.conf.num_iterations):
+            key, sub = jax.random.split(key)
+            params, ustate, score, gnorm = self._step(params, ustate, sub, i)
+            score = float(score)
+            self._notify(i, score)
+            if self._should_stop(score, old_score, float(gnorm)):
+                break
+            old_score = score
+        return params
+
+
+class LineSearchGradientDescent(BaseOptimizer):
+    """GradientAscent.java equivalent (steepest descent + backtracking line
+    search each iteration) — one jitted program per iteration."""
+
+    def __init__(self, conf, objective, **kw):
+        super().__init__(conf, objective, **kw)
+        self._step = None  # built lazily once the params template is known
+
+    def _build(self, template):
+        objective = self.objective
+
+        def flat_value(flat, key):
+            return objective.value(unpack_params(flat, template), key)
+
+        @jax.jit
+        def step(flat, key):
+            score, grads = objective.value_and_grad(
+                unpack_params(flat, template), key)
+            g = pack_params(grads)
+            d = -g
+            slope = jnp.vdot(g, d)
+            t, f_new = backtrack_line_search(
+                lambda x: flat_value(x, key), flat, d, score, slope,
+                initial_step=self.conf.lr)
+            return flat + t * d, f_new, jnp.linalg.norm(g)
+
+        self._step = step
+
+    def optimize(self, params: Params, key: Array) -> Params:
+        template = params
+        if self._step is None:
+            self._build(template)
+        flat = pack_params(params)
+        old_score = float("inf")
+        for i in range(self.conf.num_iterations):
+            key, sub = jax.random.split(key)
+            flat, score, gnorm = self._step(flat, sub)
+            score = float(score)
+            self._notify(i, score)
+            if self._should_stop(score, old_score, float(gnorm)):
+                break
+            old_score = score
+        return unpack_params(flat, template)
+
+
+class ConjugateGradientOptimizer(BaseOptimizer):
+    """Polak-Ribiere nonlinear CG with restarts
+    (optimize/solvers/ConjugateGradient.java parity)."""
+
+    def __init__(self, conf, objective, **kw):
+        super().__init__(conf, objective, **kw)
+        self._step = None
+
+    def _build(self, template):
+        objective = self.objective
+
+        def flat_vag(flat, key):
+            score, grads = objective.value_and_grad(
+                unpack_params(flat, template), key)
+            return score, pack_params(grads)
+
+        def flat_value(flat, key):
+            return objective.value(unpack_params(flat, template), key)
+
+        @jax.jit
+        def step(flat, g_prev, d, key):
+            f0, g = flat_vag(flat, key)
+            # Polak-Ribiere beta with restart (max(0, .))
+            denom = jnp.vdot(g_prev, g_prev)
+            beta = jnp.where(denom > 0,
+                             jnp.maximum(jnp.vdot(g, g - g_prev) / (denom + 1e-30), 0.0),
+                             0.0)
+            d_new = -g + beta * d
+            slope = jnp.vdot(g, d_new)
+            # restart to steepest descent if not a descent direction
+            d_new = jnp.where(slope < 0, d_new, -g)
+            slope = jnp.minimum(slope, jnp.vdot(g, d_new))
+            t, f_new = backtrack_line_search(
+                lambda x: flat_value(x, key), flat, d_new, f0, slope,
+                initial_step=self.conf.lr)
+            return flat + t * d_new, g, d_new, f_new, jnp.linalg.norm(g)
+
+        self._step = step
+
+    def optimize(self, params: Params, key: Array) -> Params:
+        template = params
+        if self._step is None:
+            self._build(template)
+        flat = pack_params(params)
+        g = jnp.zeros_like(flat)
+        d = jnp.zeros_like(flat)
+        old_score = float("inf")
+        for i in range(self.conf.num_iterations):
+            key, sub = jax.random.split(key)
+            flat, g, d, score, gnorm = self._step(flat, g, d, sub)
+            score = float(score)
+            self._notify(i, score)
+            if self._should_stop(score, old_score, float(gnorm)):
+                break
+            old_score = score
+        return unpack_params(flat, template)
+
+
+class LBFGSOptimizer(BaseOptimizer):
+    """L-BFGS with two-loop recursion (optimize/solvers/LBFGS.java parity).
+
+    History lives in fixed-size device buffers; the two-loop recursion is a
+    ``lax.fori_loop`` pair so each iteration is one jitted program.
+    """
+
+    def __init__(self, conf, objective, history: int = 10, **kw):
+        super().__init__(conf, objective, **kw)
+        self.m = history
+        self._step = None
+
+    def _build(self, template, n):
+        objective = self.objective
+        m = self.m
+
+        def flat_vag(flat, key):
+            score, grads = objective.value_and_grad(
+                unpack_params(flat, template), key)
+            return score, pack_params(grads)
+
+        def flat_value(flat, key):
+            return objective.value(unpack_params(flat, template), key)
+
+        def two_loop(g, S, Y, rho, count):
+            """Classic two-loop recursion over the ring buffer (newest last)."""
+            q = g
+            alphas = jnp.zeros((m,), jnp.float32)
+
+            def bwd(i, carry):
+                q, alphas = carry
+                idx = m - 1 - i  # newest -> oldest
+                valid = idx >= (m - count)
+                alpha = jnp.where(valid, rho[idx] * jnp.vdot(S[idx], q), 0.0)
+                q = q - alpha * Y[idx] * jnp.where(valid, 1.0, 0.0)
+                return q, alphas.at[idx].set(alpha)
+
+            q, alphas = jax.lax.fori_loop(0, m, bwd, (q, alphas))
+            # initial Hessian scaling gamma = s·y / y·y of newest pair
+            sy = jnp.vdot(S[m - 1], Y[m - 1])
+            yy = jnp.vdot(Y[m - 1], Y[m - 1])
+            gamma = jnp.where((count > 0) & (yy > 0), sy / (yy + 1e-30), 1.0)
+            r = gamma * q
+
+            def fwd(i, r):
+                idx = i  # oldest -> newest
+                valid = idx >= (m - count)
+                beta = jnp.where(valid, rho[idx] * jnp.vdot(Y[idx], r), 0.0)
+                return r + (alphas[idx] - beta) * S[idx] * jnp.where(valid, 1.0, 0.0)
+
+            return jax.lax.fori_loop(0, m, fwd, r)
+
+        @jax.jit
+        def step(flat, S, Y, rho, count, key):
+            f0, g = flat_vag(flat, key)
+            d = -two_loop(g, S, Y, rho, count)
+            slope = jnp.vdot(g, d)
+            d = jnp.where(slope < 0, d, -g)
+            slope = jnp.minimum(slope, jnp.vdot(g, d))
+            t, f_new = backtrack_line_search(
+                lambda x: flat_value(x, key), flat, d, f0, slope,
+                initial_step=1.0)
+            flat_new = flat + t * d
+            _, g_new = flat_vag(flat_new, key)
+            s, y = flat_new - flat, g_new - g
+            sy = jnp.vdot(s, y)
+            # shift ring buffer, append newest pair if curvature is positive
+            def append(args):
+                S, Y, rho, count = args
+                S = jnp.roll(S, -1, axis=0).at[m - 1].set(s)
+                Y = jnp.roll(Y, -1, axis=0).at[m - 1].set(y)
+                rho = jnp.roll(rho, -1).at[m - 1].set(1.0 / (sy + 1e-30))
+                return S, Y, rho, jnp.minimum(count + 1, m)
+            S, Y, rho, count = jax.lax.cond(
+                sy > 1e-10, append, lambda a: a, (S, Y, rho, count))
+            return flat_new, S, Y, rho, count, f_new, jnp.linalg.norm(g)
+
+        self._step = step
+
+    def optimize(self, params: Params, key: Array) -> Params:
+        template = params
+        flat = pack_params(params)
+        n = flat.shape[0]
+        if self._step is None:
+            self._build(template, n)
+        S = jnp.zeros((self.m, n), jnp.float32)
+        Y = jnp.zeros((self.m, n), jnp.float32)
+        rho = jnp.zeros((self.m,), jnp.float32)
+        count = jnp.int32(0)
+        old_score = float("inf")
+        for i in range(self.conf.num_iterations):
+            key, sub = jax.random.split(key)
+            flat, S, Y, rho, count, score, gnorm = self._step(
+                flat, S, Y, rho, count, sub)
+            score = float(score)
+            self._notify(i, score)
+            if self._should_stop(score, old_score, float(gnorm)):
+                break
+            old_score = score
+        return unpack_params(flat, template)
+
+
+class Solver:
+    """Dispatch on OptimizationAlgorithm (Solver.java:51-59 parity)."""
+
+    _DISPATCH = {
+        OptimizationAlgorithm.GRADIENT_DESCENT: GradientDescentOptimizer,
+        OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT: GradientDescentOptimizer,
+        OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradientOptimizer,
+        OptimizationAlgorithm.LBFGS: LBFGSOptimizer,
+        # HESSIAN_FREE is provided at the network level (Gauss-Newton vector
+        # products need the full model); Solver falls back to CG here.
+        OptimizationAlgorithm.HESSIAN_FREE: ConjugateGradientOptimizer,
+    }
+
+    def __init__(self, conf: NeuralNetConfiguration, objective: Objective,
+                 listeners: Sequence[IterationListener] = (),
+                 terminations: Sequence[TerminationCondition] | None = None):
+        cls = self._DISPATCH[conf.optimization_algo]
+        self.optimizer: BaseOptimizer = cls(
+            conf, objective, listeners=listeners, terminations=terminations)
+
+    def optimize(self, params: Params, key: Array) -> Params:
+        return self.optimizer.optimize(params, key)

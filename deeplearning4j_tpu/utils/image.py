@@ -1,0 +1,86 @@
+"""Image loading — parity with ``util/ImageLoader.java`` + LFW directory
+layout (``base/LFWLoader.java``: one subdirectory per person).
+
+Zero-dependency core: reads ``.npy``/``.npz`` arrays and PGM/PPM (P2/P3/P5/P6)
+natively; PNG/JPEG via PIL if available (torch pulls it in on most images).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _read_pnm(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        data = f.read()
+    header = re.match(rb"(P[2356])\s+(?:#.*\s+)?(\d+)\s+(\d+)\s+(\d+)\s", data)
+    if not header:
+        raise ValueError(f"{path}: not a PNM file")
+    magic, w, h, maxval = (header.group(1).decode(), int(header.group(2)),
+                           int(header.group(3)), int(header.group(4)))
+    body = data[header.end():]
+    channels = 3 if magic in ("P3", "P6") else 1
+    if magic in ("P5", "P6"):
+        arr = np.frombuffer(body, dtype=np.uint8, count=w * h * channels)
+    else:
+        arr = np.array(body.split()[:w * h * channels], dtype=np.float32)
+    arr = arr.reshape(h, w, channels).astype(np.float32) / maxval
+    return arr.mean(-1) if channels == 3 else arr[..., 0]
+
+
+def load_image(path: str, size: Optional[int] = None) -> np.ndarray:
+    """Load one image as grayscale float32 [H, W] in [0,1]."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npy":
+        img = np.load(path).astype(np.float32)
+        if img.ndim == 3:
+            img = img.mean(-1)
+        if img.max() > 1.0:
+            img = img / 255.0
+    elif ext in (".pgm", ".ppm", ".pnm"):
+        img = _read_pnm(path)
+    else:
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise ValueError(
+                f"cannot load {path}: install PIL for {ext} or use "
+                ".npy/.pgm/.ppm") from e
+        img = np.asarray(Image.open(path).convert("L"), dtype=np.float32) / 255.0
+    if size is not None and img.shape != (size, size):
+        img = _resize_nearest(img, size)
+    return img
+
+
+def _resize_nearest(img: np.ndarray, size: int) -> np.ndarray:
+    h, w = img.shape
+    ys = (np.arange(size) * h / size).astype(int).clip(0, h - 1)
+    xs = (np.arange(size) * w / size).astype(int).clip(0, w - 1)
+    return img[np.ix_(ys, xs)]
+
+_IMAGE_EXTS = (".npy", ".pgm", ".ppm", ".pnm", ".png", ".jpg", ".jpeg", ".bmp")
+
+
+def load_image_directory(root: str, size: int = 28
+                         ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """LFW-style: root/<person>/<image> -> (flattened images [N, size*size],
+    integer labels [N], person names)."""
+    names = sorted(d for d in os.listdir(root)
+                   if os.path.isdir(os.path.join(root, d)))
+    feats, labels = [], []
+    for idx, name in enumerate(names):
+        person_dir = os.path.join(root, name)
+        for fname in sorted(os.listdir(person_dir)):
+            if not fname.lower().endswith(_IMAGE_EXTS):
+                continue
+            img = load_image(os.path.join(person_dir, fname), size)
+            feats.append(img.ravel())
+            labels.append(idx)
+    if not feats:
+        raise ValueError(f"no images found under {root}")
+    return (np.stack(feats).astype(np.float32),
+            np.asarray(labels, dtype=np.int64), names)

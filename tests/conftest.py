@@ -1,0 +1,25 @@
+"""Test harness: force a virtual 8-device CPU platform BEFORE jax imports.
+
+This is the test-support pattern SURVEY.md §4 calls for — the analog of the
+reference's BaseTestDistributed (boot the real multi-worker runtime in one
+process): tests exercise real Mesh/pjit/shard_map sharding on 8 virtual
+devices without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,70 @@
+"""Config system tests: builder fluency, JSON round-trip, overrides.
+Mirrors the reference's NeuralNetConfigurationTest /
+MultiLayerNeuralNetConfigurationTest (builder -> JSON -> back, equality)."""
+
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    LayerKind, MultiLayerConfiguration, NeuralNetConfiguration,
+    OptimizationAlgorithm, WeightInit,
+)
+
+
+def test_builder_fluent():
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(784).n_out(10)
+            .lr(0.05).momentum(0.9)
+            .activation("tanh")
+            .weight_init(WeightInit.VI)
+            .optimization_algo(OptimizationAlgorithm.CONJUGATE_GRADIENT)
+            .build())
+    assert conf.n_in == 784 and conf.n_out == 10
+    assert conf.lr == 0.05 and conf.momentum == 0.9
+    assert conf.activation == "tanh"
+    assert conf.optimization_algo is OptimizationAlgorithm.CONJUGATE_GRADIENT
+
+
+def test_builder_unknown_field_raises():
+    with pytest.raises(AttributeError):
+        NeuralNetConfiguration.builder().bogus_field(1)
+
+
+def test_layer_conf_json_roundtrip():
+    conf = (NeuralNetConfiguration.builder()
+            .kind(LayerKind.RBM).n_in(100).n_out(30)
+            .momentum_after({10: 0.9, 20: 0.99})
+            .k(3).build())
+    back = NeuralNetConfiguration.from_json(conf.to_json())
+    assert back == conf
+    assert back.momentum_after == {10: 0.9, 20: 0.99}
+
+
+def test_multilayer_conf_roundtrip_and_overrides():
+    mlc = (NeuralNetConfiguration.builder()
+           .n_in(4).lr(0.1).activation("sigmoid")
+           .list(3)
+           .hidden_layer_sizes(8, 6)
+           .override(0, kind=LayerKind.RBM)
+           .override(1, kind=LayerKind.AUTOENCODER, corruption_level=0.5)
+           .override(2, kind=LayerKind.OUTPUT, n_out=3,
+                     activation="softmax", loss_function="mcxent")
+           .pretrain(True).backward(True)
+           .build())
+    assert mlc.num_layers() == 3
+    assert mlc.confs[1].corruption_level == 0.5
+    back = MultiLayerConfiguration.from_json(mlc.to_json())
+    assert back == mlc
+    assert back.confs[2].kind is LayerKind.OUTPUT
+
+
+def test_preprocessor_specs_roundtrip():
+    mlc = (NeuralNetConfiguration.builder().n_in(784)
+           .list(2)
+           .hidden_layer_sizes(16)
+           .override(1, kind=LayerKind.OUTPUT, n_out=10, activation="softmax")
+           .input_preprocessor(0, "reshape", shape=[28, 28, 1])
+           .output_preprocessor(0, "flatten")
+           .build())
+    back = MultiLayerConfiguration.from_json(mlc.to_json())
+    assert back.input_preprocessors[0]["name"] == "reshape"
+    assert back.output_preprocessors[0]["name"] == "flatten"

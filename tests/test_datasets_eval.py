@@ -1,0 +1,122 @@
+"""Data pipeline + eval tests (reference: CSVDataSetIteratorTest,
+RecordReaderDataSetiteratorTest, EvalTest patterns)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import mnist as mnist_io
+from deeplearning4j_tpu.datasets.dataset import DataSet, one_hot
+from deeplearning4j_tpu.datasets.fetchers import (
+    CSVDataFetcher, CurvesDataFetcher, IrisDataFetcher, MnistDataFetcher,
+)
+from deeplearning4j_tpu.datasets.iterator import (
+    IrisDataSetIterator, ListDataSetIterator, MnistDataSetIterator,
+    MultipleEpochsIterator, PrefetchIterator, ReconstructionDataSetIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+
+def test_idx_roundtrip(tmp_path):
+    imgs = (np.random.default_rng(0).random((10, 28, 28)) * 255).astype(np.uint8)
+    labels = np.arange(10, dtype=np.uint8)
+    ip, lp = str(tmp_path / "imgs"), str(tmp_path / "lbls")
+    mnist_io.write_idx_images(ip, imgs)
+    mnist_io.write_idx_labels(lp, labels)
+    np.testing.assert_array_equal(mnist_io.read_idx_images(ip), imgs)
+    np.testing.assert_array_equal(mnist_io.read_idx_labels(lp), labels)
+
+
+def test_mnist_iterator_batching():
+    it = MnistDataSetIterator(batch=32, synthetic_n=100)
+    batches = list(it)
+    assert sum(b.num_examples() for b in batches) == 100
+    assert batches[0].features.shape == (32, 784)
+    assert batches[0].labels.shape == (32, 10)
+    # binarized
+    uniq = np.unique(np.asarray(batches[0].features))
+    assert set(uniq.tolist()) <= {0.0, 1.0}
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(batch=50)
+    b = next(iter(it))
+    assert b.features.shape == (50, 4) and b.labels.shape == (50, 3)
+    assert it.total_examples() == 150
+
+
+def test_csv_fetcher(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1.0,2.0,0\n2.0,3.0,1\n3.0,4.0,2\n1.5,2.5,0\n")
+    f = CSVDataFetcher(str(p))
+    f.fetch(4)
+    ds = f.next()
+    assert ds.features.shape == (4, 2)
+    assert ds.labels.shape == (4, 3)
+
+
+def test_sampling_and_epochs_iterators():
+    base = DataSet(jnp.arange(20.0).reshape(10, 2),
+                   jnp.asarray(one_hot(np.arange(10) % 2, 2)))
+    s = SamplingDataSetIterator(base, batch_size=4, total_samples=12)
+    drawn = sum(b.num_examples() for b in s)
+    assert drawn == 12
+    inner = ListDataSetIterator(base.batch_by(5))
+    me = MultipleEpochsIterator(3, inner)
+    assert sum(b.num_examples() for b in me) == 30
+
+
+def test_reconstruction_and_prefetch():
+    base = DataSet(jnp.ones((8, 3)), jnp.zeros((8, 2)))
+    inner = ListDataSetIterator(base.batch_by(4))
+    rec = ReconstructionDataSetIterator(inner)
+    b = next(iter(rec))
+    np.testing.assert_array_equal(np.asarray(b.labels), np.asarray(b.features))
+    inner2 = ListDataSetIterator(base.batch_by(2))
+    pf = PrefetchIterator(inner2, depth=2)
+    assert sum(b.num_examples() for b in pf) == 8
+
+
+def test_curves_fetcher():
+    f = CurvesDataFetcher(n=16, dim=32)
+    f.fetch(16)
+    ds = f.next()
+    assert ds.features.shape == (16, 32)
+    assert float(ds.features.min()) >= 0.0 and float(ds.features.max()) <= 1.0
+
+
+def test_evaluation_metrics():
+    # 3-class toy: perfect on class 0, confuse 1<->2 half the time
+    labels = one_hot(np.array([0, 0, 1, 1, 2, 2]), 3)
+    preds = one_hot(np.array([0, 0, 1, 2, 2, 1]), 3)
+    ev = Evaluation()
+    ev.eval(labels, preds)
+    assert ev.accuracy() == pytest.approx(4 / 6)
+    assert ev.precision(0) == 1.0 and ev.recall(0) == 1.0
+    assert ev.recall(1) == pytest.approx(0.5)
+    assert ev.true_positives(1) == 1 and ev.false_negatives(1) == 1
+    assert "Accuracy" in ev.stats()
+
+
+def test_evaluation_incremental_accumulation():
+    ev = Evaluation(num_classes=2)
+    ev.eval(one_hot([0, 1], 2), one_hot([0, 1], 2))
+    ev.eval(one_hot([0, 1], 2), one_hot([1, 1], 2))
+    assert ev.confusion.total() == 4
+    assert ev.accuracy() == pytest.approx(3 / 4)
+
+
+def test_dataset_transforms():
+    ds = DataSet(jnp.asarray(np.random.default_rng(0).normal(3, 2, (50, 4))
+                             .astype(np.float32)),
+                 jnp.asarray(one_hot(np.zeros(50), 2)))
+    norm = ds.normalize_zero_mean_unit_variance()
+    np.testing.assert_allclose(np.asarray(norm.features.mean(0)),
+                               np.zeros(4), atol=1e-5)
+    train, test = ds.split_test_and_train(40)
+    assert train.num_examples() == 40 and test.num_examples() == 10
+    merged = DataSet.merge([train, test])
+    assert merged.num_examples() == 50

@@ -1,0 +1,134 @@
+"""Layer tests — shapes + behavioral (score decreases), mirroring the
+reference's RBMTests / LSTMTest / ConvolutionDownSampleLayerTest style."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import (
+    HiddenUnit, LayerKind, NeuralNetConfiguration, VisibleUnit,
+)
+from deeplearning4j_tpu.nn.layers import make_layer
+from deeplearning4j_tpu.ops.updaters import apply_updates
+
+
+def _conf(**kw):
+    c = NeuralNetConfiguration()
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+def test_dense_shapes_and_activation():
+    layer = make_layer(_conf(kind=LayerKind.DENSE, n_in=12, n_out=5,
+                             activation="tanh"))
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (7, 12))
+    y = layer.activate(params, x)
+    assert y.shape == (7, 5)
+    assert float(jnp.abs(y).max()) <= 1.0  # tanh range
+
+
+def test_rbm_cd_learns_reconstruction():
+    conf = _conf(kind=LayerKind.RBM, n_in=16, n_out=8, k=1,
+                 visible_unit=VisibleUnit.BINARY, hidden_unit=HiddenUnit.BINARY)
+    layer = make_layer(conf)
+    params = layer.init(jax.random.key(0))
+    # two binary prototype patterns
+    rng = np.random.default_rng(0)
+    protos = (rng.random((2, 16)) > 0.5).astype(np.float32)
+    x = jnp.asarray(protos[rng.integers(0, 2, 64)])
+
+    @jax.jit
+    def step(params, key):
+        score, grads = layer.pretrain_value_and_grad(params, key, x)
+        return apply_updates(params, jax.tree.map(lambda g: 0.3 * g, grads)), score
+
+    key = jax.random.key(42)
+    first = None
+    for i in range(120):
+        key, sub = jax.random.split(key)
+        params, score = step(params, sub)
+        if first is None:
+            first = float(score)
+    assert float(score) < first * 0.7, (first, float(score))
+
+
+def test_rbm_gaussian_visible_runs():
+    conf = _conf(kind=LayerKind.RBM, n_in=6, n_out=4,
+                 visible_unit=VisibleUnit.GAUSSIAN,
+                 hidden_unit=HiddenUnit.RECTIFIED, k=2)
+    layer = make_layer(conf)
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (10, 6))
+    score, grads = layer.pretrain_value_and_grad(params, jax.random.key(2), x)
+    assert np.isfinite(float(score))
+    assert grads["W"].shape == (6, 4)
+
+
+def test_autoencoder_denoising_learns():
+    conf = _conf(kind=LayerKind.AUTOENCODER, n_in=20, n_out=10,
+                 corruption_level=0.3, activation="sigmoid")
+    layer = make_layer(conf)
+    params = layer.init(jax.random.key(0))
+    x = (jax.random.uniform(jax.random.key(1), (32, 20)) > 0.5).astype(jnp.float32)
+
+    @jax.jit
+    def step(params, key):
+        loss, grads = layer.pretrain_value_and_grad(params, key, x)
+        return apply_updates(params, jax.tree.map(lambda g: 0.5 * g, grads)), loss
+
+    key = jax.random.key(7)
+    losses = []
+    for _ in range(80):
+        key, sub = jax.random.split(key)
+        params, loss = step(params, sub)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_convolution_and_pool_shapes():
+    conv = make_layer(_conf(kind=LayerKind.CONVOLUTION, n_channels=1,
+                            n_filters=6, kernel_size=(5, 5), activation="relu"))
+    pool = make_layer(_conf(kind=LayerKind.SUBSAMPLING, pool_size=(2, 2)))
+    params = conv.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (3, 28, 28, 1))
+    y = conv.activate(params, x)
+    assert y.shape == (3, 24, 24, 6)
+    z = pool.activate({}, y)
+    assert z.shape == (3, 12, 12, 6)
+
+
+def test_lstm_sequence_learns_next_token():
+    vocab = 5
+    conf = _conf(kind=LayerKind.LSTM, n_in=vocab, n_out=vocab, hidden_size=16)
+    layer = make_layer(conf)
+    params = layer.init(jax.random.key(0))
+    # deterministic cyclic sequence: 0->1->2->3->4->0...
+    T = 20
+    ids = jnp.arange(T) % vocab
+    xs = jax.nn.one_hot(ids, vocab)[None]
+    ys = jax.nn.one_hot((ids + 1) % vocab, vocab)[None]
+
+    @jax.jit
+    def step(params):
+        loss, grads = jax.value_and_grad(layer.sequence_loss)(params, xs, ys)
+        return apply_updates(params, jax.tree.map(lambda g: 0.5 * g, grads)), loss
+
+    losses = []
+    for _ in range(150):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < 0.3, losses[-1]
+
+
+def test_recursive_autoencoder_folds():
+    conf = _conf(kind=LayerKind.RECURSIVE_AUTOENCODER, n_in=8,
+                 activation="tanh")
+    layer = make_layer(conf)
+    params = layer.init(jax.random.key(0))
+    xs = jax.random.normal(jax.random.key(1), (4, 6, 8))
+    root = layer.activate(params, xs)
+    assert root.shape == (4, 8)
+    score, grads = layer.pretrain_value_and_grad(params, jax.random.key(2), xs)
+    assert np.isfinite(float(score))

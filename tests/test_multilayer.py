@@ -1,0 +1,115 @@
+"""MultiLayerNetwork end-to-end tests — the reference's MultiLayerTest
+pattern: convergence-style assertions (score decreases, accuracy threshold)
+rather than bitwise goldens (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import IrisDataFetcher
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    LayerKind, MultiLayerConfiguration, NeuralNetConfiguration,
+    OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+
+
+def _iris():
+    f = IrisDataFetcher()
+    f.fetch(150)
+    return f.next().normalize_zero_mean_unit_variance().shuffle(0)
+
+
+def _mlp_conf(pretrain=False, backprop=True,
+              algo=OptimizationAlgorithm.GRADIENT_DESCENT):
+    return (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).momentum(0.5).use_adagrad(False)
+            .num_iterations(60)
+            .optimization_algo(OptimizationAlgorithm(algo))
+            .activation("tanh")
+            .list(3)
+            .hidden_layer_sizes(16, 8)
+            .override(2, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(pretrain).backward(backprop)
+            .build())
+
+
+def test_wiring_from_hidden_layer_sizes():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    assert net.conf.confs[0].n_in == 4 and net.conf.confs[0].n_out == 16
+    assert net.conf.confs[1].n_in == 16 and net.conf.confs[1].n_out == 8
+    assert net.conf.confs[2].n_in == 8 and net.conf.confs[2].n_out == 3
+
+
+def test_backprop_fit_converges_on_iris():
+    data = _iris()
+    train, test = data.split_test_and_train(120)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    listener = CollectScoresListener()
+    net.set_listeners([listener])
+    net.fit_backprop(train.batch_by(32), num_epochs=120)
+    ev = net.evaluate(test)
+    assert ev.accuracy() > 0.85, ev.stats()
+    scores = [s for _, s in listener.scores]
+    assert scores[-1] < scores[0]
+
+
+def test_pretrain_finetune_path():
+    data = _iris().scale_0_1()
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.05).num_iterations(30).use_adagrad(False)
+            .activation("sigmoid")
+            .list(3)
+            .hidden_layer_sizes(10, 6)
+            .override(0, kind=LayerKind.AUTOENCODER, corruption_level=0.1)
+            .override(1, kind=LayerKind.AUTOENCODER, corruption_level=0.1)
+            .override(2, kind=LayerKind.OUTPUT, n_out=3, activation="softmax",
+                      loss_function="mcxent", num_iterations=200, lr=0.5)
+            .pretrain(True).backward(False)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    before = net.score(data)
+    net.fit(data)
+    after = net.score(data)
+    assert after < before
+    ev = net.evaluate(data)
+    assert ev.accuracy() > 0.6, ev.stats()
+
+
+def test_predict_output_shapes():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x = jnp.zeros((5, 4))
+    out = net.output(x)
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), np.ones(5), rtol=1e-5)
+    assert net.predict(x).shape == (5,)
+
+
+def test_params_pack_unpack_roundtrip():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    flat = net.params_flat()
+    n0 = float(np.asarray(flat)[0])
+    net.set_params_flat(flat * 2.0)
+    assert float(np.asarray(net.params_flat())[0]) == 2.0 * n0
+
+
+def test_serialization_roundtrip():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    blob = net.to_bytes()
+    back = MultiLayerNetwork.from_bytes(blob)
+    np.testing.assert_allclose(np.asarray(back.params_flat()),
+                               np.asarray(net.params_flat()), rtol=1e-6)
+    x = jnp.ones((2, 4))
+    np.testing.assert_allclose(np.asarray(back.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-5)
+
+
+def test_merge_parameter_averaging():
+    a = MultiLayerNetwork(_mlp_conf()).init(seed=1)
+    b = MultiLayerNetwork(_mlp_conf()).init(seed=2)
+    fa, fb = np.asarray(a.params_flat()), np.asarray(b.params_flat())
+    a.merge([b])
+    np.testing.assert_allclose(np.asarray(a.params_flat()), (fa + fb) / 2,
+                               rtol=1e-6)
