@@ -1,0 +1,27 @@
+"""Distributed training over device meshes.
+
+This package replaces ALL FOUR of the reference's distributed runtimes
+(SURVEY.md §5.8 — Akka/Hazelcast param server, Spark parameter averaging,
+YARN IterativeReduce BSP, ZooKeeper config) with the TPU-native design:
+
+- data plane: XLA collectives (psum/pmean/all_gather/reduce_scatter/
+  ppermute/all_to_all) compiled over ICI within a slice and DCN across
+  slices, expressed via ``jax.sharding.Mesh`` + ``shard_map``/``pjit``;
+- control plane: a thin in-process/host coordinator (``StateTracker``
+  parity) for job routing, heartbeats, and async (Hogwild) updates — the
+  data plane no longer needs a parameter server.
+
+Axes convention (mesh.py): ``data`` (DP), ``model`` (TP), ``pipe`` (PP),
+``seq`` (SP/ring attention), ``expert`` (EP).
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec, make_mesh, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+    EXPERT_AXIS,
+)
+from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallelTrainer, ParameterAveragingTrainer,
+)
+from deeplearning4j_tpu.parallel.coordinator import (  # noqa: F401
+    Job, StateTracker, WorkerRecord,
+)

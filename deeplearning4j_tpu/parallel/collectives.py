@@ -1,0 +1,65 @@
+"""Named collective wrappers used inside shard_map'd code.
+
+These are the data-plane primitives that replace the reference's four
+message/RPC stacks (SURVEY.md §5.8): gradient sharing = ``pmean`` (≡ Spark
+``fold(Add)``/÷N and YARN ``Master.compute`` averaging), ``ppermute`` rings
+for sequence parallelism, ``all_to_all`` for Ulysses-style head scatter.
+Thin by design — the names document intent at call sites.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+PyTree = Any
+
+
+def grad_share(grads: PyTree, axis: str = "data") -> PyTree:
+    """Mean-allreduce gradients over the data axis — the IterativeReduce/
+    parameter-averaging equivalence: averaging gradients each step IS the
+    reference's synchronous parameter averaging done right."""
+    return jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+
+
+def param_average(params: PyTree, axis: str = "data") -> PyTree:
+    """Mean-allreduce parameters (Spark fitDataSet / YARN Master.compute
+    parity — average AFTER local training rather than per-step)."""
+    return jax.tree.map(lambda p: lax.pmean(p, axis), params)
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def ring_permute(x, axis: str, shift: int = 1):
+    """Send each shard to its ring neighbor (ppermute) — the building block
+    of ring attention / pipelined halo exchange over ICI."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    """Ulysses-style resharding: scatter one array axis, gather another."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)

@@ -1,0 +1,174 @@
+"""Control-plane coordinator — ``StateTracker`` parity.
+
+The reference's distributed control plane is a Hazelcast-backed parameter
+server (api/statetracker/StateTracker.java:43): job assignment, worker
+registry + heartbeats, current global params, update collection, counters,
+enable/disable switches, plus a stale-worker reaper in the master
+(MasterActor.java:139-169).
+
+In the TPU-native design the DATA plane is XLA collectives, so this
+coordinator is deliberately thin host-side state: it orchestrates workers
+(threads driving device slices, or host processes over DCN), routes jobs,
+tracks heartbeats, and buffers async updates for the Hogwild path.  The
+same API works in-process (threading — like the reference's in-JVM
+BaseTestDistributed pattern) and could be served over RPC without changing
+callers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Job:
+    """Unit of distributable work (scaleout/job/Job.java:24 parity)."""
+    work: Any
+    worker_id: str = ""
+    result: Any = None
+
+
+@dataclasses.dataclass
+class WorkerRecord:
+    worker_id: str
+    last_heartbeat: float
+    enabled: bool = True
+
+
+class StateTracker:
+    """In-process StateTracker: thread-safe job/worker/update bookkeeping.
+
+    API parity (StateTracker.java): add_update:223/updates:229,
+    set_current:88/get_current:95, job_for/clear_job, heartbeats,
+    worker_enabled:182, increment/count:52-54.
+    """
+
+    def __init__(self, stale_after_s: float = 120.0):
+        self._lock = threading.RLock()
+        self._workers: Dict[str, WorkerRecord] = {}
+        self._jobs: Dict[str, Job] = {}
+        self._pending: List[Job] = []
+        self._updates: List[Job] = []
+        self._current: Any = None
+        self._counters: Dict[str, int] = {}
+        self._needs_replicate: Dict[str, bool] = {}
+        self.stale_after_s = stale_after_s
+
+    # -- worker registry + heartbeats --------------------------------------
+    def add_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers[worker_id] = WorkerRecord(worker_id, time.time())
+            self._needs_replicate[worker_id] = True
+
+    def heartbeat(self, worker_id: str) -> None:
+        with self._lock:
+            if worker_id in self._workers:
+                self._workers[worker_id].last_heartbeat = time.time()
+
+    def heartbeats(self) -> Dict[str, float]:
+        with self._lock:
+            return {w: r.last_heartbeat for w, r in self._workers.items()}
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            return list(self._workers)
+
+    def remove_stale_workers(self) -> List[str]:
+        """MasterActor reaper parity (stale >= stale_after_s; :139-169):
+        drops the worker and re-queues its in-flight job."""
+        now = time.time()
+        removed = []
+        with self._lock:
+            for wid, rec in list(self._workers.items()):
+                if now - rec.last_heartbeat >= self.stale_after_s:
+                    removed.append(wid)
+                    del self._workers[wid]
+                    self._needs_replicate.pop(wid, None)
+                    job = self._jobs.pop(wid, None)
+                    if job is not None:
+                        job.worker_id = ""
+                        self._pending.append(job)
+        return removed
+
+    def worker_enabled(self, worker_id: str) -> bool:
+        with self._lock:
+            rec = self._workers.get(worker_id)
+            return bool(rec and rec.enabled)
+
+    def enable_worker(self, worker_id: str, enabled: bool = True) -> None:
+        with self._lock:
+            if worker_id in self._workers:
+                self._workers[worker_id].enabled = enabled
+
+    # -- job routing --------------------------------------------------------
+    def add_job(self, job: Job) -> None:
+        with self._lock:
+            self._pending.append(job)
+
+    def job_for(self, worker_id: str) -> Optional[Job]:
+        """Assign (or return the already-assigned) job for a worker —
+        pull-based like WorkerActor.checkJobAvailable:287."""
+        with self._lock:
+            if worker_id in self._jobs:
+                return self._jobs[worker_id]
+            if not self.worker_enabled(worker_id):
+                return None
+            if self._pending:
+                job = self._pending.pop(0)
+                job.worker_id = worker_id
+                self._jobs[worker_id] = job
+                return job
+            return None
+
+    def clear_job(self, worker_id: str) -> None:
+        with self._lock:
+            self._jobs.pop(worker_id, None)
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or bool(self._jobs)
+
+    # -- current global state (the "parameter server" role) ----------------
+    def set_current(self, value: Any) -> None:
+        with self._lock:
+            self._current = value
+            for w in self._needs_replicate:
+                self._needs_replicate[w] = True
+
+    def get_current(self) -> Any:
+        with self._lock:
+            return self._current
+
+    def needs_replicate(self, worker_id: str) -> bool:
+        with self._lock:
+            return self._needs_replicate.get(worker_id, True)
+
+    def done_replicating(self, worker_id: str) -> None:
+        with self._lock:
+            self._needs_replicate[worker_id] = False
+
+    # -- update collection (UpdateSaver/addUpdate parity) -------------------
+    def add_update(self, worker_id: str, job: Job) -> None:
+        with self._lock:
+            self._updates.append(job)
+
+    def updates(self) -> List[Job]:
+        with self._lock:
+            return list(self._updates)
+
+    def drain_updates(self) -> List[Job]:
+        with self._lock:
+            out, self._updates = self._updates, []
+            return out
+
+    # -- counters -----------------------------------------------------------
+    def increment(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return self._counters.get(key, 0)

@@ -1,0 +1,165 @@
+"""Data-parallel trainers over a device mesh.
+
+Two sync strategies, matching the reference's two sync semantics
+(SURVEY.md §2.9), both compiled as single XLA programs via ``shard_map``:
+
+1. ``DataParallelTrainer`` — gradient sharing: every step computes local
+   grads on the batch shard and ``pmean``s them over the ``data`` axis
+   before the update.  This is the faithful TPU-native equivalent of
+   IterativeReduce (YARN ``Master.compute`` averaging, Akka
+   ``INDArrayAggregator``, Spark ``AVERAGE_EACH_ITERATION``) — averaging
+   one-step-trained parameters from identical starts == averaging gradients.
+
+2. ``ParameterAveragingTrainer`` — Spark ``SparkDl4jMultiLayer.fitDataSet``
+   semantics (spark/.../SparkDl4jMultiLayer.java:155-209): each data shard
+   trains LOCALLY for k steps from the same broadcast params, then
+   parameters are mean-allreduced; repeat per round.  ``average_each_round``
+   mirrors the ``org.deeplearning4j.spark.iteration.average`` key.
+
+Both trainers take an arbitrary differentiable ``loss_fn(params, x, y, key)``
+so they serve MultiLayerNetwork, BERT, or any model family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.ops.updaters import Dl4jUpdater, apply_updates
+from deeplearning4j_tpu.parallel import collectives
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+PyTree = Any
+LossFn = Callable[[PyTree, Array, Array, Array], Array]
+
+
+class DataParallelTrainer:
+    """Synchronous gradient-sharing DP (grads pmean'd over ICI each step)."""
+
+    def __init__(self, loss_fn: LossFn, updater: Dl4jUpdater, mesh: Mesh,
+                 donate: bool = True):
+        self.loss_fn = loss_fn
+        self.updater = updater
+        self.mesh = mesh
+
+        # All mesh axes except `data` are unused here; Replicate over them.
+        param_spec = P()
+        batch_spec = P(DATA_AXIS)
+
+        def step(params, ustate, x, y, key, it):
+            # Per-shard loss/grads; each shard sees its local batch slice.
+            # Fold the data-axis index into the key so dropout/sampling
+            # noise differs per shard.
+            shard_key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+            score, grads = jax.value_and_grad(self.loss_fn)(
+                params, x, y, shard_key)
+            grads = collectives.grad_share(grads, DATA_AXIS)
+            score = lax.pmean(score, DATA_AXIS)
+            updates, ustate = self.updater.update(ustate, grads, params, it, 1)
+            return apply_updates(params, updates), ustate, score
+
+        sharded = shard_map(
+            step, mesh=mesh,
+            in_specs=(param_spec, param_spec, batch_spec, batch_spec,
+                      P(), P()),
+            out_specs=(param_spec, param_spec, P()),
+            check_rep=False,
+        )
+        self._step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+    def init_state(self, params: PyTree) -> PyTree:
+        return self.updater.init(params)
+
+    def step(self, params: PyTree, ustate: PyTree, x: Array, y: Array,
+             key: Array, iteration: int | Array):
+        """One global step. x/y are GLOBAL batches (leading dim divisible by
+        the data-parallel degree)."""
+        return self._step(params, ustate, x, y, key,
+                          jnp.asarray(iteration))
+
+    def fit(self, params: PyTree, batches: Iterable[Tuple[Array, Array]],
+            key: Array, listeners=()) -> PyTree:
+        ustate = self.init_state(params)
+        for it, (x, y) in enumerate(batches):
+            key, sub = jax.random.split(key)
+            params, ustate, score = self.step(params, ustate, x, y, sub, it)
+            for ls in listeners:
+                ls.iteration_done(self, it, float(score))
+        return params
+
+
+class ParameterAveragingTrainer:
+    """Spark-semantics DP: local k-step training then parameter averaging."""
+
+    def __init__(self, loss_fn: LossFn, updater: Dl4jUpdater, mesh: Mesh,
+                 local_steps: int = 1, average_each_round: bool = True):
+        self.loss_fn = loss_fn
+        self.updater = updater
+        self.mesh = mesh
+        self.local_steps = local_steps
+        self.average_each_round = average_each_round
+
+        # Params are carried with an explicit per-shard leading axis
+        # [ndp, ...] sharded over `data` — each shard owns its replica
+        # (the Spark executors' local nets), letting replicas DIVERGE
+        # between averages when average_each_round=False.
+        def round_fn(stacked, x, y, key, it0):
+            params = jax.tree.map(lambda a: a[0], stacked)  # this shard's copy
+            shard_key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+            ustate = self.updater.init(params)
+
+            def local_step(carry, i):
+                p, u = carry
+                k = jax.random.fold_in(shard_key, i)
+                score, grads = jax.value_and_grad(self.loss_fn)(p, x, y, k)
+                upd, u = self.updater.update(u, grads, p, it0 + i, 1)
+                return (apply_updates(p, upd), u), score
+
+            (params, _), scores = lax.scan(
+                local_step, (params, ustate), jnp.arange(self.local_steps))
+            if self.average_each_round:
+                params = collectives.param_average(params, DATA_AXIS)
+            score = lax.pmean(scores[-1], DATA_AXIS)
+            return jax.tree.map(lambda a: a[None], params), score
+
+        self._round = jax.jit(shard_map(
+            round_fn, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            out_specs=(P(DATA_AXIS), P()),
+            check_rep=False,
+        ))
+
+        def avg(stacked):
+            def inner(s):
+                p = collectives.param_average(
+                    jax.tree.map(lambda a: a[0], s), DATA_AXIS)
+                return jax.tree.map(lambda a: a[None], p)
+            return shard_map(inner, mesh=mesh, in_specs=(P(DATA_AXIS),),
+                             out_specs=P(DATA_AXIS), check_rep=False)(stacked)
+
+        self._final_avg = jax.jit(avg)
+        self._ndp = mesh.shape[DATA_AXIS]
+
+    def fit(self, params: PyTree, batches: Iterable[Tuple[Array, Array]],
+            key: Array, listeners=()) -> PyTree:
+        """Rounds over global batches (repartition ≡ batch iteration).
+        Takes and returns UNSTACKED (single-replica) params — the broadcast
+        and final collect are internal, like Spark's driver."""
+        stacked = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (self._ndp,) + a.shape),
+            params)
+        it = 0
+        for rnd, (x, y) in enumerate(batches):
+            key, sub = jax.random.split(key)
+            stacked, score = self._round(stacked, x, y, sub, jnp.asarray(it))
+            it += self.local_steps
+            for ls in listeners:
+                ls.iteration_done(self, rnd, float(score))
+        stacked = self._final_avg(stacked)
+        return jax.tree.map(lambda a: a[0], stacked)

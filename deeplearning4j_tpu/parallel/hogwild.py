@@ -1,0 +1,154 @@
+"""Asynchronous (Hogwild-style) training — the reference's async path.
+
+Reference parity: ``HogWildWorkRouter.java:30`` ("always send; async
+lock-free") + the Hazelcast StateTracker update flow: workers pull current
+params, train locally, push deltas; the master folds deltas in as they
+arrive with NO barrier — races embraced by design (SURVEY.md §5.2).
+
+TPU-native design: SPMD collectives are inherently synchronous, so async
+lives on the HOST (SURVEY.md §7 "hard parts" — a deliberate async-update
+design that preserves the capability without fighting XLA):
+
+- each worker thread drives its own jit-compiled train step (on its own
+  device when several are visible, else time-sharing one chip);
+- the ``StateTracker`` coordinator holds the current global params;
+- workers push PARAMETER DELTAS (new - pulled) which the aggregator thread
+  applies immediately — stale-gradient semantics identical to Hogwild;
+- an ``IterateAndUpdate``-style drain folds updates through an aggregator
+  (INDArrayAggregator parity = running mean) when sync rounds are wanted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.updaters import Dl4jUpdater, apply_updates
+from deeplearning4j_tpu.parallel.coordinator import Job, StateTracker
+
+Array = jax.Array
+PyTree = Any
+LossFn = Callable[[PyTree, Array, Array, Array], Array]
+
+
+class INDArrayAggregator:
+    """Running parameter average (scaleout/aggregator/INDArrayAggregator
+    .java:35-60 parity)."""
+
+    def __init__(self):
+        self._sum: Optional[PyTree] = None
+        self._n = 0
+
+    def accumulate(self, params: PyTree) -> None:
+        if self._sum is None:
+            self._sum = params
+        else:
+            self._sum = jax.tree.map(jnp.add, self._sum, params)
+        self._n += 1
+
+    def aggregate(self) -> PyTree:
+        assert self._sum is not None, "nothing accumulated"
+        return jax.tree.map(lambda s: s / self._n, self._sum)
+
+
+class HogwildTrainer:
+    """Async param-delta training over worker threads + StateTracker."""
+
+    def __init__(self, loss_fn: LossFn, updater: Dl4jUpdater,
+                 num_workers: int = 2, local_steps: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        self.loss_fn = loss_fn
+        self.updater = updater
+        self.num_workers = num_workers
+        self.local_steps = local_steps
+        self.devices = list(devices) if devices else jax.devices()
+        self.tracker = StateTracker()
+        self._lock = threading.Lock()  # protects the global-param fold only
+        self._abort = threading.Event()  # set on worker crash -> all exit
+
+        def local_train(params, ustate, x, y, key, it0):
+            def body(carry, i):
+                p, u = carry
+                k = jax.random.fold_in(key, i)
+                score, grads = jax.value_and_grad(self.loss_fn)(p, x, y, k)
+                upd, u = self.updater.update(u, grads, p, it0 + i, 1)
+                return (apply_updates(p, upd), u), score
+
+            (params, ustate), scores = jax.lax.scan(
+                body, (params, ustate), jnp.arange(self.local_steps))
+            return params, ustate, scores[-1]
+
+        self._local_train = jax.jit(local_train)
+
+    def _worker(self, wid: str, key: Array, errors: List[BaseException]) -> None:
+        job = None
+        try:
+            dev = self.devices[int(wid.split("-")[-1]) % len(self.devices)]
+            ustate = None
+            local = None  # this worker's params replica
+            while not self._abort.is_set():
+                self.tracker.heartbeat(wid)
+                job = self.tracker.job_for(wid)
+                if job is None:
+                    if not self.tracker.has_pending():
+                        return
+                    time.sleep(0.001)
+                    continue
+                x, y = job.work
+                # replicate-on-demand (WorkerActor.checkJobAvailable parity):
+                # pull global params only when the tracker flagged them stale
+                if local is None or self.tracker.needs_replicate(wid):
+                    local = self.tracker.get_current()
+                    self.tracker.done_replicating(wid)
+                pulled = local
+                if ustate is None:
+                    ustate = self.updater.init(pulled)
+                key, sub = jax.random.split(key)
+                with jax.default_device(dev):
+                    new_params, ustate, score = self._local_train(
+                        pulled, ustate, x, y, sub,
+                        jnp.asarray(self.tracker.count("iterations")))
+                local = new_params
+                # push the DELTA and fold it into the global params NOW —
+                # async, stale-tolerant (Hogwild)
+                delta = jax.tree.map(jnp.subtract, new_params, pulled)
+                with self._lock:
+                    current = self.tracker.get_current()
+                    self.tracker.set_current(
+                        jax.tree.map(jnp.add, current, delta))
+                self.tracker.done_replicating(wid)  # our own fold isn't stale
+                job.result = float(score)
+                self.tracker.add_update(wid, job)
+                self.tracker.increment("iterations")
+                self.tracker.clear_job(wid)
+                job = None
+        except BaseException as e:  # surface worker crashes to the driver
+            errors.append(e)
+            self._abort.set()  # stop peers: don't spin on an orphaned job
+            if job is not None:
+                self.tracker.clear_job(wid)
+
+    def fit(self, params: PyTree, batches: Iterable[Tuple[Array, Array]],
+            seed: int = 0) -> PyTree:
+        self.tracker.set_current(params)
+        for b in batches:
+            self.tracker.add_job(Job(work=b))
+        errors: List[BaseException] = []
+        threads = []
+        for w in range(self.num_workers):
+            wid = f"worker-{w}"
+            self.tracker.add_worker(wid)
+            t = threading.Thread(
+                target=self._worker,
+                args=(wid, jax.random.key(seed + w), errors), daemon=True)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return self.tracker.get_current()

@@ -1,0 +1,116 @@
+"""Device mesh construction.
+
+The scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives.  Axis names are fixed package-wide so layers/trainers can
+annotate against them without knowing the topology:
+
+- ``data``   — data parallelism (gradient sharing ≡ the reference's
+               IterativeReduce/parameter averaging)
+- ``model``  — tensor parallelism (new capability; SURVEY.md §2.9)
+- ``pipe``   — pipeline parallelism (new capability)
+- ``seq``    — sequence/context parallelism (ring attention; §5.7)
+- ``expert`` — expert parallelism (MoE)
+
+Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``;
+mesh axes laid out so ``data`` spans hosts last (DCN-friendly: gradient
+allreduce rides ICI within a slice, only crossing DCN once per step), while
+``model``/``seq`` stay inside a slice (ICI-only collectives).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism degrees. ``data=-1`` means "absorb remaining
+    devices" (like the reference sizing its worker pool to cores,
+    MasterActor.java:181)."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        for name in ("model", "pipe", "seq", "expert"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} degree must be >= 1, got "
+                                 f"{getattr(self, name)}")
+        if self.data != -1 and self.data < 1:
+            raise ValueError(f"data degree must be >= 1 or -1 (absorb), "
+                             f"got {self.data}")
+        fixed = self.model * self.pipe * self.seq * self.expert
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by model*pipe*seq*expert="
+                f"{fixed}")
+        data = self.data if self.data > 0 else n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{fixed} != {n_devices} devices")
+        return {DATA_AXIS: data, MODEL_AXIS: self.model, PIPE_AXIS: self.pipe,
+                SEQ_AXIS: self.seq, EXPERT_AXIS: self.expert}
+
+
+def make_mesh(spec: MeshSpec | None = None,
+              devices: Optional[Sequence[jax.Device]] = None,
+              axis_order: Tuple[str, ...] = ALL_AXES) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    ``data`` is the FIRST axis so contiguous device blocks — which JAX
+    orders hosts-major — fall into the same data shard: model/seq
+    collectives then run between neighboring chips (ICI), and only the
+    data-axis allreduce crosses host boundaries (DCN).
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in axis_order)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_order)
+
+
+def data_sharding(mesh: Mesh, *, extra_axes: int = 1) -> NamedSharding:
+    """Batch sharding: leading axis over ``data``, rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_axes)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (jax.distributed over DCN) — the replacement for
+    the reference's Akka cluster join (WorkerActor joining MASTER_URL,
+    DeepLearning4jDistributed.setup:301-315).  No-op when single-process."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    n = mesh.shape[DATA_AXIS]
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel degree {n}")
+    return global_batch // n

@@ -1,9 +1,16 @@
-"""Test harness: force a virtual 8-device CPU platform BEFORE jax imports.
+"""Test harness: force a virtual 8-device CPU platform BEFORE any backend
+is initialized.
 
 This is the test-support pattern SURVEY.md §4 calls for — the analog of the
 reference's BaseTestDistributed (boot the real multi-worker runtime in one
 process): tests exercise real Mesh/pjit/shard_map sharding on 8 virtual
 devices without TPU hardware.
+
+IMPORTANT (environment quirk): a sitecustomize may pre-import jax and pin
+``jax_platforms`` to a hardware plugin at interpreter start, so setting the
+``JAX_PLATFORMS`` env var here is NOT enough — we must also update the live
+config.  ``XLA_FLAGS`` is read lazily at CPU-client creation, so appending
+the device-count flag here still works.
 """
 
 import os
@@ -15,6 +22,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+# Override any platform pinned by a pre-imported jax (see docstring); must
+# run before the first backends() call.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

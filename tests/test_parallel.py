@@ -1,0 +1,180 @@
+"""Distributed tests on the virtual 8-device CPU mesh — the
+BaseTestDistributed pattern (SURVEY.md §4): boot the real runtime in one
+process, assert orchestration and math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import IrisDataFetcher
+from deeplearning4j_tpu.ops.updaters import dl4j_updater
+from deeplearning4j_tpu.parallel import (
+    DataParallelTrainer, MeshSpec, ParameterAveragingTrainer, make_mesh,
+)
+from deeplearning4j_tpu.parallel.coordinator import Job, StateTracker
+from deeplearning4j_tpu.parallel.hogwild import HogwildTrainer, INDArrayAggregator
+
+
+def _softmax_loss(params, x, y, key):
+    logits = x @ params["W"] + params["b"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(y * logp, axis=-1))
+
+
+def _iris_batches(n_batches=8, batch=40):
+    f = IrisDataFetcher()
+    f.fetch(150)
+    data = f.next().normalize_zero_mean_unit_variance()
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n_batches):
+        idx = rng.integers(0, 150, size=batch)
+        out.append((jnp.asarray(np.asarray(data.features)[idx]),
+                    jnp.asarray(np.asarray(data.labels)[idx])))
+    return out
+
+
+def _init_params(key=0):
+    k = jax.random.key(key)
+    return {"W": 0.01 * jax.random.normal(k, (4, 3)), "b": jnp.zeros((3,))}
+
+
+def _accuracy(params, ds):
+    f = IrisDataFetcher()
+    f.fetch(150)
+    data = f.next().normalize_zero_mean_unit_variance()
+    preds = jnp.argmax(data.features @ params["W"] + params["b"], -1)
+    actual = jnp.argmax(data.labels, -1)
+    return float((preds == actual).mean())
+
+
+def test_mesh_spec_resolution(devices):
+    mesh = make_mesh(MeshSpec(data=-1, model=2))
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(model=3).resolve(8)
+
+
+def test_gradient_sharing_trains(devices):
+    mesh = make_mesh(MeshSpec())  # 8-way DP
+    trainer = DataParallelTrainer(
+        _softmax_loss, dl4j_updater(lr=0.5, momentum=0.9, use_adagrad=False),
+        mesh)
+    params = trainer.fit(_init_params(), _iris_batches(30, 80),
+                         jax.random.key(0))
+    assert _accuracy(params, None) > 0.8
+
+
+def test_gradient_sharing_equals_single_device_math(devices):
+    """pmean of shard grads == global-batch grad: the DP step must match a
+    single-device step on the same global batch (gradient-sharing
+    correctness, the IterativeReduce equivalence)."""
+    mesh = make_mesh(MeshSpec())
+    upd = dl4j_updater(lr=0.1, momentum=0.0, use_adagrad=False)
+    trainer = DataParallelTrainer(_softmax_loss, upd, mesh)
+    params = _init_params()
+    (x, y) = _iris_batches(1, 80)[0]
+    key = jax.random.key(3)
+
+    # single-device reference step FIRST (trainer.step donates its inputs)
+    score, grads = jax.value_and_grad(_softmax_loss)(params, x, y, key)
+    upd_s = upd.init(params)
+    updates, _ = upd.update(upd_s, grads, params, 0, 1)
+    p_ref = jax.tree.map(lambda p, u: p - u, params, updates)
+
+    ustate = trainer.init_state(params)
+    p_dist, _, score_dist = trainer.step(params, ustate, x, y, key, 0)
+
+    np.testing.assert_allclose(np.asarray(p_dist["W"]), np.asarray(p_ref["W"]),
+                               rtol=1e-5, atol=1e-6)
+    assert abs(float(score_dist) - float(score)) < 1e-5
+
+
+def test_parameter_averaging_trains(devices):
+    mesh = make_mesh(MeshSpec())
+    trainer = ParameterAveragingTrainer(
+        _softmax_loss, dl4j_updater(lr=0.5, momentum=0.0, use_adagrad=False),
+        mesh, local_steps=5, average_each_round=True)
+    params = trainer.fit(_init_params(), _iris_batches(12, 80),
+                         jax.random.key(1))
+    assert _accuracy(params, None) > 0.8
+
+
+def test_parameter_averaging_once_at_end(devices):
+    mesh = make_mesh(MeshSpec())
+    trainer = ParameterAveragingTrainer(
+        _softmax_loss, dl4j_updater(lr=0.5, momentum=0.0, use_adagrad=False),
+        mesh, local_steps=10, average_each_round=False)
+    params = trainer.fit(_init_params(), _iris_batches(6, 80),
+                         jax.random.key(2))
+    assert _accuracy(params, None) > 0.7
+
+
+def test_state_tracker_job_flow():
+    t = StateTracker(stale_after_s=0.05)
+    t.add_worker("w0")
+    t.add_worker("w1")
+    t.add_job(Job(work="a"))
+    t.add_job(Job(work="b"))
+    j0 = t.job_for("w0")
+    assert j0.work == "a" and j0.worker_id == "w0"
+    # same worker asks again -> same job (no double assignment)
+    assert t.job_for("w0") is j0
+    j1 = t.job_for("w1")
+    assert j1.work == "b"
+    t.clear_job("w0")
+    assert t.job_for("w0") is None  # queue empty
+    # disabled workers get nothing
+    t.add_job(Job(work="c"))
+    t.enable_worker("w1", False)
+    t.clear_job("w1")
+    assert t.job_for("w1") is None
+    assert t.job_for("w0").work == "c"
+    # counters
+    t.increment("n")
+    t.increment("n", 2)
+    assert t.count("n") == 3
+
+
+def test_state_tracker_stale_reaper_requeues():
+    import time
+    t = StateTracker(stale_after_s=0.01)
+    t.add_worker("w0")
+    t.add_job(Job(work="a"))
+    j = t.job_for("w0")
+    time.sleep(0.03)
+    removed = t.remove_stale_workers()
+    assert removed == ["w0"]
+    # job went back to the queue for another worker
+    t.add_worker("w1")
+    assert t.job_for("w1").work == "a"
+
+
+def test_state_tracker_replication_flags():
+    t = StateTracker()
+    t.add_worker("w0")
+    assert t.needs_replicate("w0")
+    t.done_replicating("w0")
+    assert not t.needs_replicate("w0")
+    t.set_current({"x": 1})
+    assert t.needs_replicate("w0")  # new params -> re-replicate
+    assert t.get_current() == {"x": 1}
+
+
+def test_aggregator_running_mean():
+    agg = INDArrayAggregator()
+    agg.accumulate({"w": jnp.asarray(2.0)})
+    agg.accumulate({"w": jnp.asarray(4.0)})
+    assert float(agg.aggregate()["w"]) == pytest.approx(3.0)
+
+
+def test_hogwild_async_trains():
+    trainer = HogwildTrainer(
+        _softmax_loss, dl4j_updater(lr=0.3, momentum=0.0, use_adagrad=False),
+        num_workers=4, local_steps=3)
+    params = trainer.fit(_init_params(), _iris_batches(16, 64), seed=0)
+    assert _accuracy(params, None) > 0.75
+    # all jobs processed, async updates recorded
+    assert len(trainer.tracker.updates()) == 16
+    assert trainer.tracker.count("iterations") == 16
