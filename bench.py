@@ -1,0 +1,72 @@
+"""Headline benchmark: BERT-base MLM training throughput (samples/sec/chip).
+
+Runs on whatever jax.devices() provides (real TPU chip under the driver;
+CPU elsewhere — the JSON records the platform).  Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: BASELINE.json's north star is >=0.8x per-chip of an
+nd4j-cuda/A100 baseline, for which no published number exists (the reference
+repo publishes none — BASELINE.md).  We anchor on a public A100 BERT-base
+pretraining figure (~230 seq/s at seq_len=128, fp16, per A100) as the
+denominator so the ratio is meaningful and stable across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+A100_BERT_BASE_SEQ128_SPS = 230.0  # public MLPerf-era per-A100 anchor
+
+
+def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
+               warmup: int = 3):
+    import optax
+    from deeplearning4j_tpu.models import bert
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # keep CI/dev runs quick; same code path, toy shapes
+        cfg = bert.bert_tiny(vocab_size=1024, max_len=seq_len)
+        batch_size, steps = 8, 5
+    else:
+        cfg = bert.bert_base()
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshSpec(data=n_dev), devices=jax.devices())
+    init_fn, step_fn = bert.make_train_step(
+        cfg, mesh, optimizer=optax.adamw(1e-4))
+
+    state = init_fn(jax.random.key(0))
+    batch = bert.synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len)
+
+    for i in range(warmup):
+        state, loss = step_fn(state, batch, jax.random.key(i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, loss = step_fn(state, batch, jax.random.key(100 + i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    sps = batch_size * steps / dt
+    sps_per_chip = sps / n_dev
+    return {
+        "metric": f"bert_{'base' if platform != 'cpu' else 'tiny'}_mlm_train"
+                  f"_samples_per_sec_per_chip_seq{seq_len}",
+        "value": round(sps_per_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_per_chip / A100_BERT_BASE_SEQ128_SPS, 3),
+        "platform": platform,
+        "n_devices": n_dev,
+        "final_loss": round(float(loss), 4),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_bert()))

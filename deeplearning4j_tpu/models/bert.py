@@ -1,0 +1,206 @@
+"""BERT — masked-language-model pretraining on the transformer encoder.
+
+North-star model (BASELINE.json: BERT-base ≥0.8x per-chip vs the reference's
+nd4j-cuda path).  The reference has no attention model at all (SURVEY.md
+§5.7); this is a new capability designed TPU-first:
+
+- MLM head shares the token embedding matrix (weight tying) — the big
+  [H, vocab] matmul is the single largest FLOP consumer outside the blocks;
+  it runs in bf16 on the MXU with fp32 logits.
+- Loss masks to the sampled positions only (standard 15% masking), computed
+  with a gather-free `where` so shapes stay static under jit.
+- ``make_train_step`` returns a jitted step with full dp/tp/sp sharding:
+  params sharded by transformer.param_specs, batch by (data, seq) — XLA
+  inserts all collectives (psum over `model` for TP matmuls, all-gathers at
+  the sharded softmax boundary) per the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models import transformer as tfm
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+Array = jax.Array
+PyTree = Any
+
+
+def bert_base() -> TransformerConfig:
+    return TransformerConfig(vocab_size=30522, max_len=512, hidden=768,
+                             n_layers=12, n_heads=12, ffn_dim=3072)
+
+
+def bert_tiny(vocab_size: int = 1024, max_len: int = 128) -> TransformerConfig:
+    """Test/dryrun-sized config (same code path, toy shapes)."""
+    return TransformerConfig(vocab_size=vocab_size, max_len=max_len,
+                             hidden=64, n_layers=2, n_heads=4, ffn_dim=128,
+                             dropout=0.0)
+
+
+def init_params(key: Array, cfg: TransformerConfig) -> PyTree:
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = tfm.init_params(k1, cfg)
+    H = cfg.hidden
+    params["mlm"] = {
+        # transform before the tied-embedding projection (BERT convention)
+        "w": tfm._trunc_normal(k2, (H, H)),
+        "b": jnp.zeros((H,)),
+        "ln_g": jnp.ones((H,)), "ln_b": jnp.zeros((H,)),
+        "out_b": jnp.zeros((cfg.vocab_size,)),
+    }
+    params["pooler"] = {"w": tfm._trunc_normal(k3, (H, H)), "b": jnp.zeros((H,))}
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> PyTree:
+    specs = tfm.param_specs(cfg)
+    specs["mlm"] = {"w": P(None, None), "b": P(None),
+                    "ln_g": P(None), "ln_b": P(None), "out_b": P(None)}
+    specs["pooler"] = {"w": P(None, None), "b": P(None)}
+    return specs
+
+
+class Batch(NamedTuple):
+    """MLM batch. ``mlm_mask`` marks the (already-corrupted) predict positions;
+    ``labels`` holds original ids everywhere (ignored where mask==0)."""
+    token_ids: Array       # [B, T] int32 — corrupted input
+    attention_mask: Array  # [B, T] float32, 1 = real token
+    type_ids: Array        # [B, T] int32
+    labels: Array          # [B, T] int32 — original ids
+    mlm_mask: Array        # [B, T] float32, 1 = position to predict
+
+
+def batch_spec() -> Batch:
+    s = P(DATA_AXIS, SEQ_AXIS)
+    return Batch(token_ids=s, attention_mask=s, type_ids=s, labels=s,
+                 mlm_mask=s)
+
+
+def forward_hidden(cfg: TransformerConfig, params: PyTree, batch: Batch,
+                   dropout_key: Optional[Array] = None,
+                   attn_fn=tfm.attention) -> Array:
+    return tfm.encode(cfg, params, batch.token_ids, batch.attention_mask,
+                      batch.type_ids, dropout_key, attn_fn=attn_fn)
+
+
+def mlm_logits(cfg: TransformerConfig, params: PyTree, hidden: Array) -> Array:
+    """[B, T, H] -> [B, T, vocab] via transform + tied embeddings."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    m = params["mlm"]
+    h = jax.nn.gelu(hidden.astype(cdt) @ m["w"].astype(cdt) + m["b"])
+    h = tfm.layer_norm(h, m["ln_g"], m["ln_b"], cfg.layer_norm_eps)
+    logits = jnp.einsum("bth,vh->btv", h.astype(cdt),
+                        params["embed"]["tok"].astype(cdt),
+                        preferred_element_type=jnp.float32)
+    return logits + m["out_b"]
+
+
+def mlm_loss(cfg: TransformerConfig, params: PyTree, batch: Batch,
+             dropout_key: Optional[Array] = None,
+             attn_fn=tfm.attention) -> Array:
+    hidden = forward_hidden(cfg, params, batch, dropout_key, attn_fn)
+    logits = mlm_logits(cfg, params, hidden)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, batch.labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(batch.mlm_mask), 1.0)
+    return -jnp.sum(ll * batch.mlm_mask) / denom
+
+
+class TrainState(NamedTuple):
+    params: PyTree
+    opt_state: PyTree
+    step: Array
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh,
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    attn_fn=tfm.attention
+                    ) -> Tuple[Callable, Callable]:
+    """Returns (init_fn(key) -> TrainState, step_fn(state, batch, key)
+    -> (state, loss)), both jitted with dp/tp/sp shardings over `mesh`."""
+    optimizer = optimizer or optax.adamw(1e-4, weight_decay=0.01)
+
+    pspecs = param_specs(cfg)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+    bshard = jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec(),
+                          is_leaf=lambda x: isinstance(x, P))
+
+    def init_fn(key: Array) -> TrainState:
+        params = init_params(key, cfg)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    def step_fn(state: TrainState, batch: Batch, key: Array):
+        def loss_fn(p):
+            return mlm_loss(cfg, p, batch, key, attn_fn)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state,
+                          state.step + 1), loss
+
+    # opt-state sharding mirrors param sharding: any subtree of the optax
+    # state that has the params' tree STRUCTURE (adam mu/nu, momentum
+    # buffers, ...) gets the params' shardings; remaining leaves (step
+    # counters etc.) replicate.
+    def opt_shardings(params_shape):
+        ostate_shape = jax.eval_shape(optimizer.init, params_shape)
+        ptreedef = jax.tree_util.tree_structure(params_shape)
+
+        def assign(node):
+            if jax.tree_util.tree_structure(node) == ptreedef:
+                return pshard
+            if isinstance(node, tuple):
+                mapped = [assign(c) for c in node]
+                return (type(node)(*mapped) if hasattr(node, "_fields")
+                        else tuple(mapped))
+            if isinstance(node, list):
+                return [assign(c) for c in node]
+            if isinstance(node, dict):
+                return {k: assign(v) for k, v in node.items()}
+            return NamedSharding(mesh, P())
+
+        return assign(ostate_shape)
+
+    params_shape = jax.eval_shape(
+        lambda: init_params(jax.random.key(0), cfg))
+    oshard = opt_shardings(params_shape)
+    state_shard = TrainState(params=pshard, opt_state=oshard,
+                             step=NamedSharding(mesh, P()))
+
+    jit_init = jax.jit(init_fn, out_shardings=state_shard)
+    jit_step = jax.jit(
+        step_fn,
+        in_shardings=(state_shard, bshard, NamedSharding(mesh, P())),
+        out_shardings=(state_shard, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return jit_init, jit_step
+
+
+# ---------------------------------------------------------------------------
+# synthetic MLM batch for tests/bench
+# ---------------------------------------------------------------------------
+
+def synthetic_batch(key: Array, cfg: TransformerConfig, batch_size: int,
+                    seq_len: int, mask_prob: float = 0.15,
+                    mask_token: int = 103) -> Batch:
+    k1, k2 = jax.random.split(key)
+    labels = jax.random.randint(k1, (batch_size, seq_len), 5, cfg.vocab_size,
+                                dtype=jnp.int32)
+    mlm = (jax.random.uniform(k2, (batch_size, seq_len)) < mask_prob
+           ).astype(jnp.float32)
+    token_ids = jnp.where(mlm > 0, mask_token, labels).astype(jnp.int32)
+    return Batch(token_ids=token_ids,
+                 attention_mask=jnp.ones((batch_size, seq_len), jnp.float32),
+                 type_ids=jnp.zeros((batch_size, seq_len), jnp.int32),
+                 labels=labels, mlm_mask=mlm)

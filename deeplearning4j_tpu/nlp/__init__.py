@@ -1,0 +1,27 @@
+"""NLP suite: embeddings (Word2Vec/GloVe/ParagraphVectors), vocab/Huffman,
+tokenization SPIs, bag-of-words/TF-IDF vectorizers, similarity queries.
+
+Reference parity: deeplearning4j-nlp (SURVEY.md §2.6), redesigned TPU-first
+(batched device kernels instead of per-word BLAS-1; see word2vec.py).
+"""
+
+from deeplearning4j_tpu.nlp.text import (  # noqa: F401
+    CollectionSentenceIterator, DefaultTokenizerFactory, DocumentIterator,
+    FileSentenceIterator, LabelAwareSentenceIterator, LineSentenceIterator,
+    NGramTokenizerFactory, SentenceIterator, common_preprocessor,
+)
+from deeplearning4j_tpu.nlp.vocab import (  # noqa: F401
+    VocabCache, VocabWord, build_huffman, build_vocab, encode_hs_tables,
+    unigram_table,
+)
+from deeplearning4j_tpu.nlp.word_vectors import (  # noqa: F401
+    WordVectors, load_word_vectors, write_word_vectors,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove, GloveConfig  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import (  # noqa: F401
+    ParagraphVectors, ParagraphVectorsConfig,
+)
+from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
+    BagOfWordsVectorizer, InvertedIndex, TfidfVectorizer,
+)

@@ -1,0 +1,178 @@
+"""GloVe — co-occurrence counting + AdaGrad weighted-least-squares fit.
+
+Reference parity: ``models/glove/Glove.java:57`` (fit:106, parallel
+minibatch loop :172-212), ``GloveWeightLookupTable.iterateSample`` (the
+f(X) = (X/xMax)^0.75-weighted WLS update with per-row AdaGrad), and
+``CoOccurrences.java`` (actor-parallel, disk-buffered counting).
+
+TPU-native redesign:
+- co-occurrence counting is a host-side hash accumulation (string work),
+  emitted as COO triples (i, j, X_ij);
+- training shuffles the triples once per epoch and runs fixed-size batches
+  through ONE jitted step: gathers of w/w~/b/b~ rows, the weighted-squared-
+  error gradient, AdaGrad accumulator updates, and count-normalized
+  scatter-adds (same stability treatment as word2vec).
+- the final embedding is w + w~ (standard GloVe practice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from functools import partial
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.word_vectors import WordVectors
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class GloveConfig:
+    vector_size: int = 100
+    window: int = 5
+    min_word_frequency: int = 1
+    alpha: float = 0.05          # AdaGrad master step
+    x_max: float = 100.0
+    weight_power: float = 0.75
+    epochs: int = 5
+    batch_size: int = 4096
+    symmetric: bool = True
+    seed: int = 13
+
+
+def count_cooccurrences(sentences: Iterable[str], tokenizer,
+                        cache: VocabCache, window: int = 5,
+                        symmetric: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """COO triples (rows, cols, counts); weight 1/d by distance d
+    (standard GloVe counting; CoOccurrences.java equivalent)."""
+    counts: Dict[Tuple[int, int], float] = defaultdict(float)
+    for sent in sentences:
+        idx = [cache.index_of(t) for t in tokenizer(sent)]
+        idx = [i for i in idx if i >= 0]
+        n = len(idx)
+        for pos in range(n):
+            for off in range(1, window + 1):
+                j = pos + off
+                if j >= n:
+                    break
+                w = 1.0 / off
+                counts[(idx[pos], idx[j])] += w
+                if symmetric:
+                    counts[(idx[j], idx[pos])] += w
+    if not counts:
+        return (np.empty(0, np.int32),) * 2 + (np.empty(0, np.float32),)
+    keys = np.asarray(list(counts.keys()), np.int32)
+    vals = np.asarray(list(counts.values()), np.float32)
+    return keys[:, 0], keys[:, 1], vals
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _glove_step(state, rows: Array, cols: Array, x: Array, mask: Array,
+                alpha: Array, x_max: float, power: float):
+    """One batched AdaGrad WLS step on COO triples."""
+    w, wt, b, bt, gw, gwt, gb, gbt = state
+    wi, wj = w[rows], wt[cols]                        # [B, D]
+    diff = (jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bt[cols]
+            - jnp.log(jnp.maximum(x, 1e-12)))
+    fx = jnp.minimum((x / x_max) ** power, 1.0)
+    g = fx * diff * mask                              # [B]
+
+    dwi = g[:, None] * wj
+    dwj = g[:, None] * wi
+    db = g
+
+    def adagrad_scatter(table, gsq, idx, grad, hit):
+        # count-normalized scatter (stability under duplicate rows)
+        cnt = jnp.zeros(table.shape[0]).at[idx].add(hit, mode="drop")
+        norm = jnp.maximum(cnt, 1.0)[idx]
+        if grad.ndim == 2:
+            norm = norm[:, None]
+        grad = grad / norm
+        gsq = gsq.at[idx].add(grad * grad, mode="drop")
+        step = alpha * grad / jnp.sqrt(gsq[idx] + 1e-8)
+        table = table.at[idx].add(-step, mode="drop")
+        return table, gsq
+
+    w, gw = adagrad_scatter(w, gw, rows, dwi, mask)
+    wt, gwt = adagrad_scatter(wt, gwt, cols, dwj, mask)
+    b, gb = adagrad_scatter(b, gb, rows, db, mask)
+    bt, gbt = adagrad_scatter(bt, gbt, cols, db, mask)
+    loss = 0.5 * jnp.sum(fx * diff * diff * mask) / jnp.maximum(
+        jnp.sum(mask), 1.0)
+    return (w, wt, b, bt, gw, gwt, gb, gbt), loss
+
+
+class Glove:
+    def __init__(self, sentences: Iterable[str],
+                 config: Optional[GloveConfig] = None,
+                 tokenizer=None, cache: Optional[VocabCache] = None):
+        self.config = config or GloveConfig()
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.sentences = sentences
+        self.cache = cache
+        self._wv: Optional[WordVectors] = None
+        self.losses: list = []
+
+    def fit(self) -> WordVectors:
+        cfg = self.config
+        if self.cache is None:
+            self.cache = build_vocab(self.sentences, self.tokenizer,
+                                     cfg.min_word_frequency)
+        V, D = len(self.cache), cfg.vector_size
+        if V == 0:
+            raise ValueError("empty vocabulary")
+        rows, cols, x = count_cooccurrences(
+            self.sentences, self.tokenizer, self.cache, cfg.window,
+            cfg.symmetric)
+        if rows.size == 0:
+            raise ValueError("no co-occurrences")
+
+        key = jax.random.key(cfg.seed)
+        k1, k2 = jax.random.split(key)
+        init = lambda k: (jax.random.uniform(k, (V, D)) - 0.5) / D
+        state = (init(k1), init(k2), jnp.zeros(V), jnp.zeros(V),
+                 jnp.full((V, D), 1e-8), jnp.full((V, D), 1e-8),
+                 jnp.full(V, 1e-8), jnp.full(V, 1e-8))
+
+        B = min(cfg.batch_size, max(64, rows.size))
+        rng = np.random.RandomState(cfg.seed)
+        alpha = jnp.float32(cfg.alpha)
+        for _ in range(cfg.epochs):
+            perm = rng.permutation(rows.size)
+            r, c, v = rows[perm], cols[perm], x[perm]
+            for lo in range(0, r.size, B):
+                rb, cb, vb = r[lo:lo + B], c[lo:lo + B], v[lo:lo + B]
+                n_real = rb.size
+                if n_real < B:
+                    pad = B - n_real
+                    rb = np.concatenate([rb, np.zeros(pad, np.int32)])
+                    cb = np.concatenate([cb, np.zeros(pad, np.int32)])
+                    vb = np.concatenate([vb, np.ones(pad, np.float32)])
+                m = jnp.asarray(np.arange(B) < n_real, jnp.float32)
+                state, loss = _glove_step(
+                    state, jnp.asarray(rb), jnp.asarray(cb),
+                    jnp.asarray(vb), m, alpha, cfg.x_max, cfg.weight_power)
+            self.losses.append(float(loss))
+        w, wt = state[0], state[1]
+        self._wv = WordVectors(self.cache, w + wt)
+        return self._wv
+
+    @property
+    def word_vectors(self) -> WordVectors:
+        if self._wv is None:
+            raise RuntimeError("call fit() first")
+        return self._wv
+
+    def similarity(self, a: str, b: str) -> float:
+        return self.word_vectors.similarity(a, b)
+
+    def words_nearest(self, word: str, top_n: int = 10):
+        return self.word_vectors.words_nearest(word, top_n)

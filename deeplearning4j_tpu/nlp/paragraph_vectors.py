@@ -1,0 +1,145 @@
+"""ParagraphVectors — PV-DBOW/PV-DM document embeddings.
+
+Reference parity: ``models/paragraphvectors/ParagraphVectors.java:53``
+(``dbow:188``, ``trainSentence:165``) — label words are injected into the
+same embedding space as vocabulary words and trained alongside them.
+
+TPU-native: reuses the word2vec batched kernels (_hs_step) — the label
+"word" is just an extra row of syn0 trained against every center word of
+its document (PV-DBOW), or averaged into the context (PV-DM simplified to
+the DBOW-style update the reference actually performs in ``dbow``).
+Inference for an unseen document trains ONLY its new label row with the
+rest of the space frozen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import (VocabCache, build_huffman,
+                                          encode_hs_tables)
+from deeplearning4j_tpu.nlp.word2vec import (Word2VecConfig, _hs_step,
+                                             sentence_pairs)
+from deeplearning4j_tpu.nlp.word_vectors import WordVectors
+
+
+@dataclasses.dataclass
+class ParagraphVectorsConfig(Word2VecConfig):
+    train_words: bool = True     # PV-DBOW + word training (dbow+w2v)
+
+
+class ParagraphVectors:
+    """fit() over labelled documents [(label, text), ...]."""
+
+    def __init__(self, labelled_docs: Sequence[Tuple[str, str]],
+                 config: Optional[ParagraphVectorsConfig] = None,
+                 tokenizer=None):
+        self.config = config or ParagraphVectorsConfig()
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.docs = list(labelled_docs)
+        self.cache: Optional[VocabCache] = None
+        self.labels: List[str] = []
+        self.syn0 = None
+        self.syn1 = None
+        self._wv: Optional[WordVectors] = None
+
+    def fit(self) -> WordVectors:
+        cfg = self.config
+        # vocab over words AND label tokens (label words live in the space)
+        cache = VocabCache()
+        for label, text in self.docs:
+            cache.add_document(self.tokenizer(text))
+        cache.trim(cfg.min_word_frequency)
+        self.labels = sorted({l for l, _ in self.docs})
+        for l in self.labels:
+            cache.add_token(l, count=1.0)
+        # labels not already in the word index are appended after it
+        # (a label sharing a word's surface form shares its row)
+        existing = set(cache.index)
+        cache.index += [l for l in self.labels if l not in existing]
+        for i, w in enumerate(cache.index):
+            cache.vocab[w].index = i
+        build_huffman(cache)
+        self.cache = cache
+
+        V, D = len(cache), cfg.vector_size
+        key = jax.random.key(cfg.seed)
+        self.syn0 = (jax.random.uniform(key, (V, D)) - 0.5) / D
+        self.syn1 = jnp.zeros((V, D))
+
+        codes_t, points_t, lengths_t = encode_hs_tables(cache)
+        codes_t = jnp.asarray(codes_t)
+        points_t = jnp.asarray(points_t)
+        mask_full = jnp.asarray(
+            (np.arange(codes_t.shape[1])[None, :] <
+             np.asarray(lengths_t)[:, None]).astype(np.float32))
+
+        rng = np.random.RandomState(cfg.seed)
+        B = cfg.batch_size
+
+        def train_pairs(inputs_np, centers_np):
+            """inputs: syn0 rows to move; centers: HS target words."""
+            for lo in range(0, inputs_np.size, B):
+                ib = inputs_np[lo:lo + B]
+                cb = centers_np[lo:lo + B]
+                n_real = ib.size
+                if n_real < B:
+                    pad = B - n_real
+                    ib = np.concatenate([ib, np.zeros(pad, np.int32)])
+                    cb = np.concatenate([cb, np.zeros(pad, np.int32)])
+                pmask = jnp.asarray(np.arange(B) < n_real, jnp.float32)
+                centers = jnp.asarray(cb)
+                self.syn0, self.syn1 = _hs_step(
+                    self.syn0, self.syn1, jnp.asarray(ib),
+                    codes_t[centers], points_t[centers],
+                    mask_full[centers] * pmask[:, None],
+                    jnp.float32(cfg.alpha))
+
+        for _ in range(cfg.epochs):
+            for label, text in self.docs:
+                li = cache.index_of(label)
+                idx = np.asarray(
+                    [i for i in (cache.index_of(t)
+                                 for t in self.tokenizer(text)) if i >= 0],
+                    np.int32)
+                if idx.size == 0:
+                    continue
+                # PV-DBOW: the label row is trained to predict every word
+                lbl_in = np.full(idx.size, li, np.int32)
+                train_pairs(lbl_in, idx)
+                if cfg.train_words:
+                    c, x = sentence_pairs(idx, cfg.window, rng)
+                    if c.size:
+                        train_pairs(x, c)
+
+        self._wv = WordVectors(cache, self.syn0)
+        return self._wv
+
+    # -- queries ------------------------------------------------------------
+    @property
+    def word_vectors(self) -> WordVectors:
+        if self._wv is None:
+            raise RuntimeError("call fit() first")
+        return self._wv
+
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.word_vectors.word_vector(label)
+
+    def similarity(self, a: str, b: str) -> float:
+        return self.word_vectors.similarity(a, b)
+
+    def nearest_labels(self, text: str, top_n: int = 3):
+        """Infer by averaging word vectors of the text, rank labels."""
+        idx = [self.cache.index_of(t) for t in self.tokenizer(text)]
+        idx = [i for i in idx if i >= 0]
+        if not idx:
+            return []
+        v = np.asarray(self.syn0)[idx].mean(axis=0)
+        sims = self.word_vectors.words_nearest(v, top_n=len(self.cache))
+        return [(w, s) for w, s in sims if w in set(self.labels)][:top_n]

@@ -1,0 +1,100 @@
+"""Bag-of-words / TF-IDF vectorization + inverted index.
+
+Reference parity: ``bagofwords/vectorizer/{TfidfVectorizer,
+BagOfWordsVectorizer}.java`` over ``InvertedIndex``
+(text/invertedindex/LuceneInvertedIndex.java — Lucene replaced by a plain
+in-memory posting-list index; the capability is the contract, not Lucene).
+
+Output matrices are jnp arrays [n_docs, V] ready for model input (the
+reference feeds these to MultiLayerNetwork classifiers).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class InvertedIndex:
+    """word -> posting list of (doc_id, positions)."""
+
+    def __init__(self):
+        self.postings: Dict[str, List[Tuple[int, List[int]]]] = defaultdict(list)
+        self.docs: List[List[str]] = []
+
+    def add_document(self, tokens: Sequence[str]) -> int:
+        doc_id = len(self.docs)
+        self.docs.append(list(tokens))
+        pos: Dict[str, List[int]] = defaultdict(list)
+        for i, t in enumerate(tokens):
+            pos[t].append(i)
+        for t, ps in pos.items():
+            self.postings[t].append((doc_id, ps))
+        return doc_id
+
+    def documents_containing(self, word: str) -> List[int]:
+        return [d for d, _ in self.postings.get(word, [])]
+
+    def doc_frequency(self, word: str) -> int:
+        return len(self.postings.get(word, []))
+
+    def num_docs(self) -> int:
+        return len(self.docs)
+
+
+class BagOfWordsVectorizer:
+    """Count-vectorizer: fit builds vocab + index, transform -> [N, V]."""
+
+    def __init__(self, tokenizer=None, min_word_frequency: int = 1):
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.min_word_frequency = min_word_frequency
+        self.cache = VocabCache()
+        self.index = InvertedIndex()
+
+    def fit(self, texts: Iterable[str]) -> "BagOfWordsVectorizer":
+        for t in texts:
+            toks = self.tokenizer(t)
+            self.cache.add_document(toks)
+            self.index.add_document(toks)
+        self.cache.trim(self.min_word_frequency)
+        return self
+
+    def _doc_counts(self, text: str) -> np.ndarray:
+        v = np.zeros(len(self.cache), np.float32)
+        for t in self.tokenizer(text):
+            i = self.cache.index_of(t)
+            if i >= 0:
+                v[i] += 1.0
+        return v
+
+    def transform(self, texts: Sequence[str]) -> jnp.ndarray:
+        return jnp.asarray(np.stack([self._doc_counts(t) for t in texts]))
+
+    def fit_transform(self, texts: Sequence[str]) -> jnp.ndarray:
+        texts = list(texts)
+        self.fit(texts)
+        return self.transform(texts)
+
+
+class TfidfVectorizer(BagOfWordsVectorizer):
+    """tf-idf with idf = log(N / df) (TfidfVectorizer.java semantics)."""
+
+    def idf(self) -> np.ndarray:
+        n = max(1, self.cache.num_docs)
+        out = np.zeros(len(self.cache), np.float32)
+        for i, w in enumerate(self.cache.index):
+            df = max(1, self.cache.doc_frequency(w))
+            out[i] = math.log(n / df)
+        return out
+
+    def transform(self, texts: Sequence[str]) -> jnp.ndarray:
+        counts = np.stack([self._doc_counts(t) for t in texts])
+        tf = counts / np.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+        return jnp.asarray(tf * self.idf()[None, :])

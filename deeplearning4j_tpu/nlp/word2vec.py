@@ -1,0 +1,294 @@
+"""Word2Vec — skip-gram with hierarchical softmax + negative sampling.
+
+Reference parity: ``models/word2vec/Word2Vec.java:57`` (fit:101,
+buildVocab:257, trainSentence:298, skipGram:314) and the inner kernel
+``InMemoryLookupTable.iterateSample:195-303`` (HS tree walk: dot -> sigmoid
+-> g=(1-code-f)*alpha -> axpy into syn0/syn1; negative-sampling loop over a
+unigram table; lr decay by words seen).
+
+TPU-native redesign — the reference's kernel is per-word BLAS-1 axpy on
+small vectors, the worst possible TPU shape (SURVEY.md "hard parts": sparse
+embedding updates).  Here the whole minibatch of (center, context) pairs is
+trained in ONE jitted program:
+
+- gather the padded Huffman tables (vocab.encode_hs_tables) for the batch:
+  codes/points [B, L] + mask;
+- one [B, D] x [B, L, D] einsum computes every HS dot in the batch on the
+  MXU; sigmoid, g, and the two rank-1 update families become dense batched
+  ops;
+- parameter updates are scatter-adds (``.at[].add``) into syn0/syn1 —
+  XLA lowers these to efficient TPU scatters;
+- negative sampling draws [B, K] negatives on device from the unigram
+  table and trains syn1neg the same way;
+- the LR schedule (linear decay by words seen, min 1e-4 floor —
+  Word2Vec.java trainSentence) is computed per batch and passed as a
+  scalar.
+
+Pair generation (dynamic window shrink b = rand % window, skipGram:314)
+stays on host — it is string work — and batches are processed in FIXED-size
+padded chunks so the jitted steps compile exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from functools import partial
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import (VocabCache, build_huffman,
+                                          build_vocab, encode_hs_tables,
+                                          unigram_table)
+from deeplearning4j_tpu.nlp.word_vectors import WordVectors
+
+log = logging.getLogger(__name__)
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class Word2VecConfig:
+    vector_size: int = 100
+    window: int = 5
+    min_word_frequency: int = 1
+    alpha: float = 0.025
+    min_alpha: float = 1e-4
+    negative: int = 0           # 0 => hierarchical softmax only
+    use_hs: bool = True
+    epochs: int = 1
+    batch_size: int = 2048
+    seed: int = 42
+    table_size: int = 100_000
+
+
+# -- jitted training steps --------------------------------------------------
+
+@partial(jax.jit, static_argnames=(), donate_argnums=(0, 1))
+def _hs_step(syn0: Array, syn1: Array, inputs: Array, codes: Array,
+             points: Array, mask: Array, alpha: Array):
+    """One batched HS update.
+
+    inputs [B] — rows of syn0 to train (context words);
+    codes/points/mask [B, L] — the center words' Huffman paths.
+    Padded pairs carry mask == 0 everywhere, so they contribute nothing."""
+    l1 = syn0[inputs]                                   # [B, D]
+    s1 = syn1[points]                                   # [B, L, D]
+    f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", l1, s1))
+    g = (1.0 - codes.astype(jnp.float32) - f) * alpha * mask
+    neu1e = jnp.einsum("bl,bld->bd", g, s1)             # dL/dl1
+    dsyn1 = g[:, :, None] * l1[:, None, :]              # [B, L, D]
+    B, L, D = dsyn1.shape
+    # Rows hit many times in one batch would receive a SUM of updates all
+    # computed at stale values (the reference applies them sequentially);
+    # normalize to the per-row MEAN so the batched step stays stable at any
+    # batch-size/vocab ratio.
+    flat_pts = points.reshape(B * L)
+    cnt1 = jnp.zeros(syn1.shape[0]).at[flat_pts].add(
+        mask.reshape(B * L), mode="drop")
+    syn1 = syn1.at[flat_pts].add(
+        dsyn1.reshape(B * L, D)
+        / jnp.maximum(cnt1, 1.0)[flat_pts][:, None], mode="drop")
+    row_mask = (jnp.sum(mask, axis=1) > 0).astype(jnp.float32)
+    cnt0 = jnp.zeros(syn0.shape[0]).at[inputs].add(row_mask, mode="drop")
+    syn0 = syn0.at[inputs].add(
+        neu1e / jnp.maximum(cnt0, 1.0)[inputs][:, None], mode="drop")
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _neg_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
+              negatives: Array, pair_mask: Array, alpha: Array):
+    """Negative sampling: target center word label 1, K negatives label 0.
+    ``pair_mask`` [B] zeroes padded pairs."""
+    l1 = syn0[inputs]                                    # [B, D]
+    rows = jnp.concatenate([targets[:, None], negatives], axis=1)  # [B,K+1]
+    labels = jnp.concatenate(
+        [jnp.ones_like(targets[:, None], jnp.float32),
+         jnp.zeros(negatives.shape, jnp.float32)], axis=1)
+    sn = syn1neg[rows]                                   # [B, K+1, D]
+    f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", l1, sn))
+    # mask accidental collisions negative == target
+    valid = jnp.concatenate(
+        [jnp.ones_like(targets[:, None], jnp.float32),
+         (negatives != targets[:, None]).astype(jnp.float32)], axis=1)
+    g = (labels - f) * alpha * valid * pair_mask[:, None]
+    neu1e = jnp.einsum("bk,bkd->bd", g, sn)
+    dneg = g[:, :, None] * l1[:, None, :]
+    B, K1, D = dneg.shape
+    # per-row mean normalization (see _hs_step)
+    flat_rows = rows.reshape(B * K1)
+    hit = (valid * pair_mask[:, None]).reshape(B * K1)
+    cntn = jnp.zeros(syn1neg.shape[0]).at[flat_rows].add(hit, mode="drop")
+    syn1neg = syn1neg.at[flat_rows].add(
+        dneg.reshape(B * K1, D)
+        / jnp.maximum(cntn, 1.0)[flat_rows][:, None], mode="drop")
+    cnt0 = jnp.zeros(syn0.shape[0]).at[inputs].add(pair_mask, mode="drop")
+    syn0 = syn0.at[inputs].add(
+        neu1e / jnp.maximum(cnt0, 1.0)[inputs][:, None], mode="drop")
+    return syn0, syn1neg
+
+
+# -- host-side pair generation ---------------------------------------------
+
+def sentence_pairs(idx: np.ndarray, window: int,
+                   rng: np.random.RandomState
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """(center, context) pairs with per-position dynamic window shrink
+    (skipGram:314's b = rand % window).  Vectorized numpy."""
+    n = idx.shape[0]
+    if n < 2:
+        return (np.empty(0, np.int32),) * 2
+    centers, contexts = [], []
+    b = rng.randint(0, window, size=n)
+    for pos in range(n):
+        w = window - b[pos]
+        lo, hi = max(0, pos - w), min(n, pos + w + 1)
+        for j in range(lo, hi):
+            if j != pos:
+                centers.append(idx[pos])
+                contexts.append(idx[j])
+    return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+
+
+class Word2Vec:
+    """fit() -> WordVectors.  API parity with Word2Vec.java's builder usage:
+    Word2Vec(sentences, Word2VecConfig(...), tokenizer)."""
+
+    def __init__(self, sentences: Iterable[str],
+                 config: Optional[Word2VecConfig] = None,
+                 tokenizer=None,
+                 cache: Optional[VocabCache] = None):
+        self.config = config or Word2VecConfig()
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.sentences = sentences
+        self.cache = cache
+        self.syn0: Optional[Array] = None
+        self.syn1: Optional[Array] = None
+        self.syn1neg: Optional[Array] = None
+        self._wv: Optional[WordVectors] = None
+
+    # -- vocab (buildVocab:257 parity) -------------------------------------
+    def build_vocab(self) -> VocabCache:
+        if self.cache is None:
+            self.cache = build_vocab(self.sentences, self.tokenizer,
+                                     self.config.min_word_frequency)
+        if self.config.use_hs:
+            build_huffman(self.cache)
+        return self.cache
+
+    def _reset_weights(self) -> None:
+        """syn0 ~ U(-0.5, 0.5)/dim (InMemoryLookupTable:98-104)."""
+        cfg = self.config
+        V, D = len(self.cache), cfg.vector_size
+        key = jax.random.key(cfg.seed)
+        self.syn0 = (jax.random.uniform(key, (V, D)) - 0.5) / D
+        self.syn1 = jnp.zeros((V, D))
+        if cfg.negative > 0:
+            self.syn1neg = jnp.zeros((V, D))
+
+    def fit(self) -> WordVectors:
+        cfg = self.config
+        if not cfg.use_hs and cfg.negative <= 0:
+            raise ValueError(
+                "no training objective: enable use_hs and/or negative > 0")
+        self.build_vocab()
+        if len(self.cache) == 0:
+            raise ValueError("empty vocabulary")
+        self._reset_weights()
+        codes_t, points_t, lengths_t = encode_hs_tables(self.cache)
+        codes_t = jnp.asarray(codes_t)
+        points_t = jnp.asarray(points_t)
+        mask_t = jnp.asarray(
+            (np.arange(codes_t.shape[1])[None, :] <
+             np.asarray(lengths_t)[:, None]).astype(np.float32))
+        table = jnp.asarray(unigram_table(self.cache, cfg.table_size))
+        rng = np.random.RandomState(cfg.seed)
+        nkey = jax.random.key(cfg.seed + 1)
+
+        # pre-index sentences once
+        indexed: List[np.ndarray] = []
+        total_words = 0
+        for sent in self.sentences:
+            idx = [self.cache.index_of(t) for t in self.tokenizer(sent)]
+            arr = np.asarray([i for i in idx if i >= 0], np.int32)
+            if arr.size:
+                indexed.append(arr)
+                total_words += arr.size
+        total = max(1, total_words * cfg.epochs)
+
+        words_seen = 0
+        B = cfg.batch_size
+        pend_c = np.empty(0, np.int32)
+        pend_x = np.empty(0, np.int32)
+
+        def run_chunk(centers_np: np.ndarray, contexts_np: np.ndarray,
+                      n_real: int) -> None:
+            """Train one FIXED-size [B] chunk (padded with masked zeros)."""
+            nonlocal nkey
+            pad = B - n_real
+            pmask_np = np.concatenate(
+                [np.ones(n_real, np.float32), np.zeros(pad, np.float32)])
+            if pad:
+                centers_np = np.concatenate(
+                    [centers_np, np.zeros(pad, np.int32)])
+                contexts_np = np.concatenate(
+                    [contexts_np, np.zeros(pad, np.int32)])
+            centers = jnp.asarray(centers_np)
+            contexts = jnp.asarray(contexts_np)
+            pmask = jnp.asarray(pmask_np)
+            alpha = max(cfg.min_alpha,
+                        cfg.alpha * (1.0 - words_seen / total))
+            a = jnp.float32(alpha)
+            if cfg.use_hs:
+                self.syn0, self.syn1 = _hs_step(
+                    self.syn0, self.syn1, contexts, codes_t[centers],
+                    points_t[centers], mask_t[centers] * pmask[:, None], a)
+            if cfg.negative > 0:
+                nkey, sub = jax.random.split(nkey)
+                draws = jax.random.randint(
+                    sub, (B, cfg.negative), 0, table.shape[0])
+                negs = table[draws]
+                self.syn0, self.syn1neg = _neg_step(
+                    self.syn0, self.syn1neg, contexts, centers, negs,
+                    pmask, a)
+
+        def drain(final: bool) -> None:
+            nonlocal pend_c, pend_x
+            while pend_c.size >= B:
+                run_chunk(pend_c[:B], pend_x[:B], B)
+                pend_c, pend_x = pend_c[B:], pend_x[B:]
+            if final and pend_c.size:
+                run_chunk(pend_c, pend_x, pend_c.size)
+                pend_c = np.empty(0, np.int32)
+                pend_x = np.empty(0, np.int32)
+
+        for _ in range(cfg.epochs):
+            for arr in indexed:
+                c, x = sentence_pairs(arr, cfg.window, rng)
+                words_seen += arr.size
+                if c.size == 0:
+                    continue
+                pend_c = np.concatenate([pend_c, c])
+                pend_x = np.concatenate([pend_x, x])
+                drain(final=False)
+        drain(final=True)
+        self._wv = WordVectors(self.cache, self.syn0)
+        return self._wv
+
+    # -- query passthrough --------------------------------------------------
+    @property
+    def word_vectors(self) -> WordVectors:
+        if self._wv is None:
+            raise RuntimeError("call fit() first")
+        return self._wv
+
+    def similarity(self, a: str, b: str) -> float:
+        return self.word_vectors.similarity(a, b)
+
+    def words_nearest(self, word: str, top_n: int = 10):
+        return self.word_vectors.words_nearest(word, top_n)

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from deeplearning4j_tpu.ops.updaters import Dl4jUpdater, apply_updates
 from deeplearning4j_tpu.parallel import collectives
@@ -69,7 +69,7 @@ class DataParallelTrainer:
             in_specs=(param_spec, param_spec, batch_spec, batch_spec,
                       P(), P()),
             out_specs=(param_spec, param_spec, P()),
-            check_rep=False,
+            check_vma=False,
         )
         self._step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
@@ -132,7 +132,7 @@ class ParameterAveragingTrainer:
             round_fn, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
             out_specs=(P(DATA_AXIS), P()),
-            check_rep=False,
+            check_vma=False,
         ))
 
         def avg(stacked):
@@ -141,7 +141,7 @@ class ParameterAveragingTrainer:
                     jax.tree.map(lambda a: a[0], s), DATA_AXIS)
                 return jax.tree.map(lambda a: a[None], p)
             return shard_map(inner, mesh=mesh, in_specs=(P(DATA_AXIS),),
-                             out_specs=P(DATA_AXIS), check_rep=False)(stacked)
+                             out_specs=P(DATA_AXIS), check_vma=False)(stacked)
 
         self._final_avg = jax.jit(avg)
         self._ndp = mesh.shape[DATA_AXIS]

@@ -1,0 +1,149 @@
+"""Ring attention — blockwise sequence-parallel attention over ICI.
+
+New capability with no reference counterpart (SURVEY.md §5.7 documents the
+reference has no attention, let alone sequence parallelism).  Design follows
+the public ring-attention recipe (Liu et al., blockwise parallel
+transformers): shard the sequence over the mesh ``seq`` axis, keep Q local,
+and rotate K/V blocks around the ring with ``lax.ppermute`` while
+accumulating the softmax online (flash-style running max / running sum), so
+peak memory is O(T/n) per chip and the K/V transfer overlaps compute on the
+ICI torus.
+
+Also here: ``ulysses_attention`` — the all-to-all alternative (head-scatter /
+seq-gather) that trades one a2a for full-sequence local attention, which is
+preferable when n_heads >= seq_degree and T is moderate.
+
+Both run under ``shard_map`` with the package mesh axis names.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _block_attend(q: Array, k: Array, v: Array,
+                  mask_k: Optional[Array],
+                  logit_bias: Optional[Array] = None):
+    """One (Q-local, K-block) attention tile with fp32 logits.
+
+    Returns (numerator [B,Tq,H,D] fp32, row max [B,H,Tq], row sumexp).
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask_k is not None:
+        logits = logits + (1.0 - mask_k[:, None, None, :]) * jnp.float32(-1e9)
+    if logit_bias is not None:
+        logits = logits + logit_bias
+    m = jnp.max(logits, axis=-1)                       # [B,H,Tq]
+    p = jnp.exp(logits - m[..., None])                 # [B,H,Tq,Tk]
+    l = jnp.sum(p, axis=-1)                            # [B,H,Tq]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return num, m, l
+
+
+def ring_attention(q: Array, k: Array, v: Array,
+                   mask: Optional[Array] = None,
+                   causal: bool = False,
+                   axis_name: str = "seq") -> Array:
+    """Sequence-parallel attention: every shard holds [B, T/n, H, D].
+
+    MUST run inside shard_map with ``axis_name`` bound.  K/V (+key mask)
+    rotate n-1 times via ppermute; the online-softmax accumulators merge
+    each block exactly as flash attention does across KV tiles.
+
+    ``causal`` masks by absolute block position (shard i attends to shards
+    j <= i; the diagonal block uses the triangular mask).
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    cdt = q.dtype
+
+    def causal_bias(kv_idx, Tk):
+        # bias [1, 1, Tq, Tk]: 0 where allowed, -1e9 where future
+        iq = my_idx * Tq + jnp.arange(Tq)[:, None]
+        ik = kv_idx * Tk + jnp.arange(Tk)[None, :]
+        return jnp.where(ik <= iq, 0.0, -1e9)[None, None].astype(jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        (kk, vv, mm, kv_idx, acc_num, acc_max, acc_den) = carry
+        bias = causal_bias(kv_idx, kk.shape[1]) if causal else None
+        num, m, l = _block_attend(q, kk, vv, mm, bias)
+        new_max = jnp.maximum(acc_max, m)
+        c_old = jnp.exp(acc_max - new_max)
+        c_new = jnp.exp(m - new_max)
+        acc_num = (acc_num * c_old[..., None].transpose(0, 2, 1, 3)
+                   + num * c_new[..., None].transpose(0, 2, 1, 3))
+        acc_den = acc_den * c_old + l * c_new
+        # rotate kv to the next shard (ICI neighbor on the ring)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        mm = (lax.ppermute(mm, axis_name, perm) if mm is not None else None)
+        kv_idx = lax.ppermute(kv_idx, axis_name, perm)
+        return (kk, vv, mm, kv_idx, acc_num, new_max, acc_den), None
+
+    acc_num = jnp.zeros((B, Tq, H, D), jnp.float32)
+    acc_max = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    acc_den = jnp.zeros((B, H, Tq), jnp.float32)
+    carry = (k, v, mask, my_idx, acc_num, acc_max, acc_den)
+    carry, _ = lax.scan(step, carry, None, length=n)
+    _, _, _, _, acc_num, acc_max, acc_den = carry
+    den = jnp.maximum(acc_den, 1e-30)[..., None].transpose(0, 2, 1, 3)
+    return (acc_num / den).astype(cdt)
+
+
+def make_ring_attn_fn(axis_name: str = "seq"):
+    """Adapter matching models.transformer.attention's signature."""
+    def attn(q, k, v, mask, causal=False):
+        return ring_attention(q, k, v, mask, causal, axis_name)
+    return attn
+
+
+def ulysses_attention(q: Array, k: Array, v: Array,
+                      mask: Optional[Array] = None,
+                      causal: bool = False,
+                      axis_name: str = "seq") -> Array:
+    """DeepSpeed-Ulysses style: all_to_all so each shard holds the FULL
+    sequence for H/n heads, attends locally, then a2a back to seq-sharded
+    layout.  Requires n_heads % seq_degree == 0."""
+    n = lax.psum(1, axis_name)
+    B, T, H, D = q.shape
+
+    def scatter_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        x = x.reshape(B, T, n, H // n, D)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                           tiled=False)
+        return x.reshape(B, T * n, H // n, D)
+
+    def gather_seq(x):
+        # [B, T, H/n, D] -> [B, T/n, H, D]
+        x = x.reshape(B, n, T, H // n, D)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                           tiled=False)
+        return x.reshape(B, T, H, D)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    full_mask = (lax.all_gather(mask, axis_name, axis=1, tiled=True)
+                 if mask is not None else None)
+    num, m, l = _block_attend(qg, kg, vg, full_mask,
+                              _full_causal_bias(qg) if causal else None)
+    out = num / jnp.maximum(l, 1e-30)[..., None].transpose(0, 2, 1, 3)
+    return gather_seq(out.astype(q.dtype))
+
+
+def _full_causal_bias(q):
+    T = q.shape[1]
+    i = jnp.arange(T)
+    return jnp.where(i[None, :] <= i[:, None], 0.0, -1e9)[None, None]

@@ -1,0 +1,204 @@
+"""t-SNE — exact (device-native) and Barnes-Hut (host trees + device steps).
+
+Reference parity: ``plot/Tsne.java:47`` (computeGaussianPerplexity:125,
+gradient:334, momentum schedule step:351) and ``plot/BarnesHutTsne.java:63``
+(O(N log N) via QuadTree; implements Model).
+
+TPU-native split (SURVEY.md §7.10: "exact t-SNE on TPU is easy; BH trees
+stay host-side"):
+- exact mode: P/Q affinity matrices and the gradient are dense [N, N]
+  device math; the whole iteration loop runs in ONE ``lax.fori_loop`` with
+  the reference's momentum schedule (0.5 -> 0.8 at iter 250) and early
+  exaggeration;
+- barnes-hut mode: per-iteration positive forces from a kNN-sparse P
+  (device gather math), negative forces via the host SpTree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.trees import SpTree
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class TsneConfig:
+    n_components: int = 2
+    perplexity: float = 30.0
+    learning_rate: float = 200.0
+    max_iter: int = 500
+    early_exaggeration: float = 12.0
+    exaggeration_iters: int = 100
+    momentum_initial: float = 0.5
+    momentum_final: float = 0.8
+    momentum_switch_iter: int = 250   # Tsne.java switchMomentumIteration
+    theta: float = 0.5                # Barnes-Hut accuracy
+    seed: int = 0
+
+
+def _binary_search_betas(d2: np.ndarray, perplexity: float,
+                         tol: float = 1e-5, max_steps: int = 50
+                         ) -> np.ndarray:
+    """Per-point precision search (computeGaussianPerplexity:125)."""
+    n = d2.shape[0]
+    target = np.log(perplexity)
+    betas = np.ones(n)
+    for i in range(n):
+        lo, hi = -np.inf, np.inf
+        beta = 1.0
+        di = np.delete(d2[i], i)
+        for _ in range(max_steps):
+            p = np.exp(-di * beta)
+            s = max(p.sum(), 1e-12)
+            h = np.log(s) + beta * float((di * p).sum()) / s
+            diff = h - target
+            if abs(diff) < tol:
+                break
+            if diff > 0:
+                lo = beta
+                beta = beta * 2 if hi == np.inf else (beta + hi) / 2
+            else:
+                hi = beta
+                beta = beta / 2 if lo == -np.inf else (beta + lo) / 2
+        betas[i] = beta
+    return betas
+
+
+def joint_probabilities(x: np.ndarray, perplexity: float) -> np.ndarray:
+    """Symmetrized high-dimensional affinities P."""
+    x = np.asarray(x, np.float64)
+    n = x.shape[0]
+    sq = np.sum(x * x, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
+    np.fill_diagonal(d2, 0.0)
+    betas = _binary_search_betas(d2, perplexity)
+    p = np.exp(-d2 * betas[:, None])
+    np.fill_diagonal(p, 0.0)
+    p /= np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
+    p = (p + p.T) / (2.0 * n)
+    return np.maximum(p, 1e-12)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "exag_iters", "switch_iter"))
+def _exact_loop(p: Array, y0: Array, max_iter: int, exag_iters: int,
+                switch_iter: int, lr: float, exag: float, mom_i: float,
+                mom_f: float):
+    n = y0.shape[0]
+
+    def grad_kl(y, p_eff):
+        sq = jnp.sum(y * y, axis=1)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (y @ y.T)
+        num = 1.0 / (1.0 + d2)
+        num = num.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+        q = num / jnp.maximum(jnp.sum(num), 1e-12)
+        q = jnp.maximum(q, 1e-12)
+        pq = (p_eff - q) * num                       # [N, N]
+        g = 4.0 * (jnp.diag(jnp.sum(pq, axis=1)) - pq) @ y
+        kl = jnp.sum(p_eff * jnp.log(p_eff / q))
+        return g, kl
+
+    def body(it, carry):
+        y, vel, gains, _ = carry
+        p_eff = jnp.where(it < exag_iters, p * exag, p)
+        g, kl = grad_kl(y, p_eff)
+        mom = jnp.where(it < switch_iter, mom_i, mom_f)
+        # gains (bar-delta adaptive lr, standard t-SNE; Tsne.java gradient)
+        same_sign = (jnp.sign(g) == jnp.sign(vel))
+        gains = jnp.clip(jnp.where(same_sign, gains * 0.8, gains + 0.2),
+                         0.01, None)
+        vel = mom * vel - lr * gains * g
+        y = y + vel
+        y = y - jnp.mean(y, axis=0, keepdims=True)
+        return y, vel, gains, kl
+
+    init = (y0, jnp.zeros_like(y0), jnp.ones_like(y0), jnp.asarray(0.0))
+    y, _, _, kl = jax.lax.fori_loop(0, max_iter, body, init)
+    return y, kl
+
+
+class Tsne:
+    """Exact t-SNE (Tsne.java parity), device-iterated."""
+
+    def __init__(self, config: Optional[TsneConfig] = None, **kw):
+        self.config = config or TsneConfig(**kw)
+        self.kl_: Optional[float] = None
+
+    def fit_transform(self, x) -> np.ndarray:
+        cfg = self.config
+        x = np.asarray(x, np.float64)
+        p = jnp.asarray(joint_probabilities(x, cfg.perplexity), jnp.float32)
+        key = jax.random.key(cfg.seed)
+        y0 = 1e-4 * jax.random.normal(
+            key, (x.shape[0], cfg.n_components), jnp.float32)
+        y, kl = _exact_loop(
+            p, y0, cfg.max_iter, cfg.exaggeration_iters,
+            cfg.momentum_switch_iter, cfg.learning_rate,
+            cfg.early_exaggeration, cfg.momentum_initial,
+            cfg.momentum_final)
+        self.kl_ = float(kl)
+        return np.asarray(y)
+
+
+class BarnesHutTsne:
+    """O(N log N) t-SNE: kNN-sparse P + SpTree negative forces
+    (BarnesHutTsne.java parity; tree traversal host-side by design)."""
+
+    def __init__(self, config: Optional[TsneConfig] = None, **kw):
+        self.config = config or TsneConfig(**kw)
+        self.kl_: Optional[float] = None
+
+    def fit_transform(self, x) -> np.ndarray:
+        cfg = self.config
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        k = min(n - 1, int(3 * cfg.perplexity))
+        p_full = joint_probabilities(x, cfg.perplexity)
+        # sparsify to kNN of P mass
+        cols = np.argsort(-p_full, axis=1)[:, :k]          # [N, k]
+        vals = np.take_along_axis(p_full, cols, axis=1)
+        vals /= max(vals.sum(), 1e-12)
+
+        rng = np.random.RandomState(cfg.seed)
+        y = 1e-4 * rng.randn(n, cfg.n_components)
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+
+        cols_j = jnp.asarray(cols)
+        vals_j = jnp.asarray(vals, jnp.float32)
+
+        @jax.jit
+        def pos_forces(yj, p_eff):
+            diff = yj[:, None, :] - yj[cols_j]              # [N, k, C]
+            d2 = jnp.sum(diff * diff, axis=-1)
+            w = p_eff / (1.0 + d2)
+            return jnp.sum(w[..., None] * diff, axis=1)
+
+        for it in range(cfg.max_iter):
+            exag = cfg.early_exaggeration if it < cfg.exaggeration_iters else 1.0
+            pos = np.asarray(pos_forces(jnp.asarray(y, jnp.float32),
+                                        vals_j * exag))
+            tree = SpTree.build(y)
+            neg = np.zeros_like(y)
+            z = 0.0
+            for i in range(n):
+                f = np.zeros(cfg.n_components)
+                z += tree.compute_non_edge_forces(y[i], cfg.theta, f)
+                neg[i] = f
+            g = pos - neg / max(z, 1e-12)
+            mom = (cfg.momentum_initial if it < cfg.momentum_switch_iter
+                   else cfg.momentum_final)
+            same = np.sign(g) == np.sign(vel)
+            gains = np.clip(np.where(same, gains * 0.8, gains + 0.2),
+                            0.01, None)
+            vel = mom * vel - cfg.learning_rate * gains * g
+            y = y + vel
+            y -= y.mean(axis=0, keepdims=True)
+        return y

@@ -1,0 +1,113 @@
+"""Observability: per-step metrics, throughput, profiler hooks.
+
+The reference's tracing story is a single ``ScoreIterationListener`` plus
+coarse YARN metrics maps (SURVEY.md §5.1/§5.5).  The TPU upgrade budgeted
+there: real per-step timing, a JSONL scalars sink (renders anywhere), and
+``jax.profiler`` trace capture around training windows (XLA op-level
+profiles in TensorBoard format).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+class ScalarsLogger:
+    """Append-only JSONL scalars sink — one line per step:
+    {"step": i, "wall": t, **scalars}.  The render-webapp parity surface
+    (plot/dashboard.py reads these files)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._t0 = time.time()
+
+    def log(self, step: int, **scalars: float) -> None:
+        rec = {"step": step, "wall": round(time.time() - self._t0, 4)}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def read(path: str) -> List[Dict[str, Any]]:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+class MetricsListener(IterationListener):
+    """IterationListener that records score + step wall-time to a
+    ScalarsLogger (and optionally samples/sec given a batch size)."""
+
+    def __init__(self, logger: ScalarsLogger, batch_size: int = 0):
+        self.logger = logger
+        self.batch_size = batch_size
+        self._last = None
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        scalars = {"score": score}
+        if self._last is not None:
+            dt = now - self._last
+            scalars["step_seconds"] = dt
+            if self.batch_size and dt > 0:
+                scalars["samples_per_sec"] = self.batch_size / dt
+        self._last = now
+        self.logger.log(iteration, **scalars)
+
+
+class ThroughputMeter:
+    """Windowed samples/sec; call tick(n_samples) once per step."""
+
+    def __init__(self, window: int = 50):
+        self.window = window
+        self._events: List[tuple] = []
+
+    def tick(self, n_samples: int) -> Optional[float]:
+        now = time.perf_counter()
+        self._events.append((now, n_samples))
+        self._events = self._events[-self.window:]
+        if len(self._events) < 2:
+            return None
+        dt = self._events[-1][0] - self._events[0][0]
+        n = sum(s for _, s in self._events[1:])
+        return n / dt if dt > 0 else None
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Capture an XLA profiler trace (TensorBoard-viewable) for the
+    enclosed training window."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in profiler timelines (TraceAnnotation)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    """Per-device HBM usage where the backend reports it."""
+    stats = {}
+    for d in jax.devices():
+        try:
+            stats[str(d)] = d.memory_stats()
+        except Exception:
+            stats[str(d)] = None
+    return stats

@@ -1,0 +1,107 @@
+"""Clustering (KMeans, trees) + t-SNE tests — reference test-tier parity
+(KDTreeTest/QuadTreeTest/VpTreeNodeTest/TsneTest behavioral assertions)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, KMeansConfig
+from deeplearning4j_tpu.clustering.trees import KDTree, QuadTree, SpTree, VPTree
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne, TsneConfig
+
+
+def _blobs(seed=0, n_per=50, centers=((0, 0), (10, 10), (-10, 10))):
+    rng = np.random.RandomState(seed)
+    pts, labels = [], []
+    for ci, c in enumerate(centers):
+        pts.append(rng.randn(n_per, len(c)) + np.asarray(c))
+        labels += [ci] * n_per
+    return np.concatenate(pts), np.asarray(labels)
+
+
+def test_kmeans_recovers_blobs():
+    x, true = _blobs()
+    km = KMeansClustering(KMeansConfig(n_clusters=3, seed=1))
+    labels = np.asarray(km.apply_to(x))
+    # cluster purity: each true blob maps to one dominant predicted label
+    for c in range(3):
+        part = labels[true == c]
+        assert (part == np.bincount(part).argmax()).mean() > 0.95
+    assert km.inertia_ < np.var(x) * x.shape[0]
+    # predict on new points lands in the right cluster
+    pred = np.asarray(km.predict(np.asarray([[10.2, 9.8]])))
+    assert labels[true == 1][0] == pred[0]
+
+
+def test_kdtree_knn_matches_bruteforce():
+    rng = np.random.RandomState(2)
+    pts = rng.randn(200, 3)
+    tree = KDTree.build(pts)
+    q = rng.randn(3)
+    got = tree.knn(q, k=5)
+    brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+    assert [i for _, i in got] == list(brute)
+    assert tree.contains(pts[17])
+    assert not tree.contains(np.asarray([99.0, 99.0, 99.0]))
+
+
+def test_kdtree_insert():
+    tree = KDTree(2)
+    for p in ([1.0, 2.0], [3.0, 1.0], [0.5, 4.0]):
+        tree.insert(p)
+    assert tree.contains([3.0, 1.0])
+    d, _ = tree.nearest([3.1, 1.1])
+    assert d < 0.2
+
+
+def test_vptree_knn_matches_bruteforce():
+    rng = np.random.RandomState(3)
+    pts = rng.randn(150, 4)
+    tree = VPTree(pts, seed=5)
+    q = rng.randn(4)
+    got = [i for _, i in tree.knn(q, k=4)]
+    brute = list(np.argsort(np.linalg.norm(pts - q, axis=1))[:4])
+    assert got == brute
+
+
+def test_sptree_center_of_mass_and_forces():
+    rng = np.random.RandomState(4)
+    pts = rng.randn(100, 2)
+    tree = QuadTree.build(pts)
+    assert tree.mass == 100.0
+    np.testing.assert_allclose(tree.com, pts.mean(axis=0), atol=1e-9)
+    # theta=0 forces == exact repulsion
+    p = pts[0]
+    f_exact = np.zeros(2)
+    z_exact = 0.0
+    for j in range(1, 100):
+        diff = p - pts[j]
+        q = 1.0 / (1.0 + diff @ diff)
+        z_exact += q
+        f_exact += q * q * diff
+    f = np.zeros(2)
+    z = tree.compute_non_edge_forces(p, 0.0, f)
+    np.testing.assert_allclose(z, z_exact, rtol=1e-9)
+    np.testing.assert_allclose(f, f_exact, rtol=1e-9)
+
+
+def test_exact_tsne_separates_blobs():
+    x, true = _blobs(n_per=25)
+    cfg = TsneConfig(perplexity=10.0, max_iter=300, seed=1)
+    y = Tsne(cfg).fit_transform(x)
+    assert y.shape == (75, 2)
+    # within-cluster distances << between-cluster distances
+    within = np.mean([np.linalg.norm(y[true == c] -
+                                     y[true == c].mean(0), axis=1).mean()
+                      for c in range(3)])
+    centers = np.stack([y[true == c].mean(0) for c in range(3)])
+    between = np.mean([np.linalg.norm(centers[i] - centers[j])
+                       for i in range(3) for j in range(i + 1, 3)])
+    assert between > 3 * within
+
+
+def test_barnes_hut_tsne_runs():
+    x, true = _blobs(n_per=20)
+    cfg = TsneConfig(perplexity=8.0, max_iter=60, seed=2)
+    y = BarnesHutTsne(cfg).fit_transform(x)
+    assert y.shape == (60, 2)
+    assert np.all(np.isfinite(y))

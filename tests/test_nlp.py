@@ -1,0 +1,108 @@
+"""NLP suite tests: tokenizers, vocab, Huffman, Word2Vec convergence."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.text import (CollectionSentenceIterator,
+                                         DefaultTokenizerFactory,
+                                         NGramTokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import (VocabCache, build_huffman,
+                                          build_vocab, encode_hs_tables,
+                                          unigram_table)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig
+from deeplearning4j_tpu.nlp.word_vectors import (load_word_vectors,
+                                                 write_word_vectors)
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the rug",
+    "a cat and a dog are friends",
+    "the king rules the castle",
+    "the queen rules the palace",
+    "the cat chased the mouse",
+    "the dog chased the ball",
+    "a king and a queen wear crowns",
+] * 30
+
+
+def test_tokenizer():
+    tok = DefaultTokenizerFactory()
+    assert tok("The CAT, sat!") == ["the", "cat", "sat"]
+    ng = NGramTokenizerFactory(1, 2)
+    toks = ng("a b c")
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_vocab_build_and_trim():
+    cache = build_vocab(CORPUS[:8], DefaultTokenizerFactory(),
+                        min_word_frequency=2)
+    assert "the" in cache and cache.index_of("the") == 0  # most frequent
+    assert cache.word_frequency("the") > cache.word_frequency("cat")
+    # doc frequency counted once per sentence
+    assert cache.doc_frequency("the") == 6
+
+
+def test_huffman_codes_valid():
+    cache = build_vocab(CORPUS, DefaultTokenizerFactory())
+    build_huffman(cache)
+    V = len(cache)
+    # prefix-free: no word's code is a prefix of another's
+    codes = {tuple(cache.vocab[w].codes) for w in cache.index}
+    assert len(codes) == V
+    for w in cache.index:
+        vw = cache.vocab[w]
+        assert len(vw.codes) == len(vw.points)
+        assert all(0 <= p < V - 1 for p in vw.points)
+    # frequent words get shorter codes
+    assert (len(cache.vocab["the"].codes)
+            <= len(cache.vocab["mouse"].codes))
+    # dense tables
+    codes_t, points_t, lengths = encode_hs_tables(cache)
+    assert codes_t.shape == points_t.shape
+    assert int(lengths[cache.index_of("the")]) == len(cache.vocab["the"].codes)
+
+
+def test_unigram_table():
+    cache = build_vocab(CORPUS, DefaultTokenizerFactory())
+    table = unigram_table(cache, table_size=1000)
+    counts = np.bincount(table, minlength=len(cache))
+    assert counts[cache.index_of("the")] == counts.max()
+
+
+@pytest.mark.parametrize("negative,use_hs", [(0, True), (5, False),
+                                             (5, True)])
+def test_word2vec_trains(negative, use_hs):
+    cfg = Word2VecConfig(vector_size=32, window=3, epochs=3,
+                         batch_size=512, negative=negative, use_hs=use_hs,
+                         seed=7)
+    w2v = Word2Vec(CORPUS, cfg)
+    wv = w2v.fit()
+    assert wv.vectors.shape == (len(w2v.cache), 32)
+    assert np.all(np.isfinite(np.asarray(wv.vectors)))
+
+
+def test_word2vec_semantic_sanity():
+    """Words in similar contexts end up closer (Word2VecTests parity:
+    the beach->sea style nearest-neighbor check, on a toy corpus)."""
+    cfg = Word2VecConfig(vector_size=48, window=3, epochs=30, alpha=0.05,
+                         batch_size=128, negative=5, use_hs=True, seed=3)
+    wv = Word2Vec(CORPUS, cfg).fit()
+    # cat/dog share contexts (sat, chased, pets); king/queen share contexts
+    assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")
+    assert wv.similarity("king", "queen") > wv.similarity("king", "mouse")
+
+
+def test_word_vectors_serialization(tmp_path):
+    cfg = Word2VecConfig(vector_size=16, epochs=1, batch_size=256)
+    wv = Word2Vec(CORPUS[:40], cfg).fit()
+    p = str(tmp_path / "vecs.txt")
+    write_word_vectors(wv, p)
+    wv2 = load_word_vectors(p)
+    assert wv2.vectors.shape == wv.vectors.shape
+    w = wv.cache.word_for(0)
+    np.testing.assert_allclose(wv.word_vector(w), wv2.word_vector(w),
+                               atol=1e-5)
+    sims1 = wv.words_nearest("the", 3)
+    sims2 = wv2.words_nearest("the", 3)
+    assert [w for w, _ in sims1] == [w for w, _ in sims2]

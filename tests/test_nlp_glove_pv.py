@@ -1,0 +1,80 @@
+"""GloVe, ParagraphVectors, vectorizer tests."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BagOfWordsVectorizer, Glove, GloveConfig,
+                                    ParagraphVectors,
+                                    ParagraphVectorsConfig, TfidfVectorizer)
+from deeplearning4j_tpu.nlp.glove import count_cooccurrences
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the rug",
+    "a cat and a dog are friends",
+    "the king rules the castle",
+    "the queen rules the palace",
+    "the cat chased the mouse",
+    "the dog chased the ball",
+    "a king and a queen wear crowns",
+] * 20
+
+
+def test_cooccurrence_counts():
+    tok = DefaultTokenizerFactory()
+    cache = build_vocab(CORPUS[:8], tok)
+    rows, cols, x = count_cooccurrences(CORPUS[:8], tok, cache, window=2)
+    assert rows.size == cols.size == x.size > 0
+    # symmetric: (i,j) and (j,i) both present with equal counts
+    pairs = {(int(r), int(c)): float(v) for r, c, v in zip(rows, cols, x)}
+    for (i, j), v in list(pairs.items())[:50]:
+        assert pairs.get((j, i)) == pytest.approx(v)
+
+
+def test_glove_trains_and_loss_decreases():
+    cfg = GloveConfig(vector_size=32, window=3, epochs=12, batch_size=512,
+                      x_max=10.0, seed=5)
+    g = Glove(CORPUS, cfg)
+    wv = g.fit()
+    assert np.all(np.isfinite(np.asarray(wv.vectors)))
+    assert g.losses[-1] < g.losses[0]
+    # similar-context words closer than unrelated ones
+    assert g.similarity("cat", "dog") > g.similarity("cat", "crowns")
+
+
+def test_paragraph_vectors_separates_topics():
+    docs = ([("animals_%d" % i,
+              "the cat and the dog chased the mouse on the mat")
+             for i in range(10)]
+            + [("royalty_%d" % i,
+                "the king and the queen rule the castle and the palace")
+               for i in range(10)])
+    cfg = ParagraphVectorsConfig(vector_size=32, window=3, epochs=25,
+                                 alpha=0.05, batch_size=128, seed=11)
+    pv = ParagraphVectors(docs, cfg)
+    pv.fit()
+    same = pv.similarity("animals_0", "animals_1")
+    cross = pv.similarity("animals_0", "royalty_1")
+    assert same > cross
+    # doc vectors exist for every label
+    assert pv.doc_vector("royalty_3") is not None
+
+
+def test_bag_of_words_and_tfidf():
+    texts = ["the cat sat", "the dog sat", "the cat and the cat"]
+    bow = BagOfWordsVectorizer()
+    m = np.asarray(bow.fit_transform(texts))
+    assert m.shape == (3, len(bow.cache))
+    cat = bow.cache.index_of("cat")
+    assert m[2, cat] == 2.0
+    assert bow.index.doc_frequency("cat") == 2
+    assert bow.index.documents_containing("dog") == [1]
+
+    tfidf = TfidfVectorizer()
+    t = np.asarray(tfidf.fit_transform(texts))
+    the = tfidf.cache.index_of("the")
+    # 'the' appears in every doc => idf 0 => tfidf 0
+    assert np.allclose(t[:, the], 0.0)
+    assert t[0, tfidf.cache.index_of("cat")] > 0
