@@ -163,7 +163,9 @@ class NeuralNetConfiguration:
             return ListBuilder(self._c, n_layers)
 
         def build(self) -> "NeuralNetConfiguration":
-            return copy.deepcopy(self._c)
+            conf = copy.deepcopy(self._c)
+            conf.validate()
+            return conf
 
     @staticmethod
     def builder(**kw) -> "NeuralNetConfiguration.Builder":
@@ -193,7 +195,10 @@ class NeuralNetConfiguration:
             if tup_field in d and isinstance(d[tup_field], list):
                 d[tup_field] = tuple(d[tup_field])
         known = NeuralNetConfiguration.__dataclass_fields__
-        return NeuralNetConfiguration(**{k: v for k, v in d.items() if k in known})
+        conf = NeuralNetConfiguration(
+            **{k: v for k, v in d.items() if k in known})
+        conf.validate()   # workers rebuilding from JSON fail fast too
+        return conf
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2, sort_keys=True)
@@ -201,6 +206,14 @@ class NeuralNetConfiguration:
     @staticmethod
     def from_json(s: str) -> "NeuralNetConfiguration":
         return NeuralNetConfiguration.from_dict(json.loads(s))
+
+    def validate(self) -> None:
+        """Fail-fast checks: unknown activation / loss names raise here
+        (at build time) rather than deep inside a jitted forward pass."""
+        from deeplearning4j_tpu.ops.losses import LossFunction
+        from deeplearning4j_tpu.ops.registry import get_activation
+        get_activation(self.activation)        # raises ValueError if unknown
+        LossFunction(self.loss_function)       # raises ValueError if unknown
 
     def copy_with(self, **kw) -> "NeuralNetConfiguration":
         c = copy.deepcopy(self)
@@ -253,6 +266,8 @@ class ListBuilder:
         return self
 
     def build(self) -> "MultiLayerConfiguration":
+        for conf in self._confs:
+            conf.validate()
         return MultiLayerConfiguration(confs=self._confs, **self._mlc_kwargs)
 
 

@@ -1,0 +1,173 @@
+"""Expert parallelism — Mixture-of-Experts with all_to_all dispatch.
+
+New capability with no reference counterpart (SURVEY.md §2.9: expert
+parallelism absent from the reference).  GShard/Switch-style design, built
+for the TPU torus:
+
+- Top-k router with capacity factor; dispatch/combine are dense one-hot
+  einsums (MXU-friendly — no scatters, no dynamic shapes under jit).
+- Experts are sharded over the mesh ``expert`` axis; tokens travel to their
+  experts and back via two ``lax.all_to_all`` collectives (ICI), each shard
+  batch-applying only its resident experts.
+- Load-balance auxiliary loss (Switch Transformer form): E * Σ_e f_e · p_e
+  where f_e is the fraction of tokens routed to expert e and p_e the mean
+  router probability.
+- Single-shard path (no ``expert`` axis in the mesh) runs the same
+  dispatch/combine math without collectives, so the layer is
+  topology-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    d_model: int = 64
+    d_ff: int = 256
+    aux_loss_weight: float = 1e-2
+
+
+def init_moe_params(key: Array, cfg: MoEConfig) -> dict:
+    kr, k1, k2 = jax.random.split(key, 3)
+    E, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+    return {
+        "router": jax.random.normal(kr, (d, E)) * 0.02,
+        "wi": jax.random.normal(k1, (E, d, f)) * (1.0 / jnp.sqrt(d)),
+        "wo": jax.random.normal(k2, (E, f, d)) * (1.0 / jnp.sqrt(f)),
+    }
+
+
+def compute_capacity(n_tokens: int, n_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    c = int(capacity_factor * top_k * n_tokens / n_experts)
+    return max(c, 1)
+
+
+def route_topk(gates: Array, top_k: int, capacity: int
+               ) -> Tuple[Array, Array, Array]:
+    """Top-k routing with per-expert capacity.
+
+    gates: [N, E] router probabilities.  Returns (dispatch [N,E,C] {0,1},
+    combine [N,E,C] gate-weighted, aux_loss scalar).
+    """
+    N, E = gates.shape
+    topv, topi = lax.top_k(gates, top_k)                # [N, k]
+    topv = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+
+    masks = jax.nn.one_hot(topi, E, dtype=gates.dtype)  # [N, k, E]
+    # positions: choice-major cumulative count per expert (choice 0 of every
+    # token outranks choice 1, GShard-style priority)
+    flat = jnp.swapaxes(masks, 0, 1).reshape(top_k * N, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat          # 0-based slot
+    pos = jnp.swapaxes(pos_flat.reshape(top_k, N, E), 0, 1)  # [N, k, E]
+
+    dispatch = jnp.zeros((N, E, capacity), gates.dtype)
+    combine = jnp.zeros((N, E, capacity), gates.dtype)
+    for j in range(top_k):
+        m = masks[:, j]                                  # [N, E]
+        slot = jnp.sum(pos[:, j] * m, axis=-1).astype(jnp.int32)  # [N]
+        sel = m * (slot < capacity)[:, None]             # capacity-dropped
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=gates.dtype)
+        d_j = sel[:, :, None] * slot_oh[:, None, :]      # [N, E, C]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * topv[:, j][:, None, None]
+
+    # Switch aux loss: E * sum_e (token fraction to e) * (mean prob of e)
+    f_e = jnp.sum(masks.sum(1), axis=0) / (N * top_k)        # [E]
+    p_e = jnp.mean(gates, axis=0)                            # [E]
+    aux = E * jnp.sum(f_e * p_e)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(wi: Array, wo: Array, x: Array) -> Array:
+    """Batched expert FFN: x [E_local, C', d] through per-expert weights."""
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", x, wi,
+                               preferred_element_type=jnp.float32))
+    return jnp.einsum("ecf,efd->ecd", h.astype(x.dtype), wo,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def moe_ffn(params: dict, x: Array, cfg: MoEConfig,
+            axis_name: Optional[str] = None) -> Tuple[Array, Array]:
+    """MoE FFN over tokens x [N, d] -> (y [N, d], aux_loss).
+
+    When ``axis_name`` is given (running inside shard_map), x holds this
+    shard's N local tokens and params hold the LOCAL experts
+    ``[E/ep, ...]``; dispatch crosses shards via all_to_all.  The router
+    table is replicated.
+    """
+    N, d = x.shape
+    E = cfg.n_experts
+    gates = jax.nn.softmax(
+        jnp.einsum("nd,de->ne", x, params["router"],
+                   preferred_element_type=jnp.float32), axis=-1
+    ).astype(x.dtype)
+    C = compute_capacity(N, E, cfg.top_k, cfg.capacity_factor)
+    dispatch, combine, aux = route_topk(gates, cfg.top_k, C)
+
+    # [N,E,C] x [N,d] -> [E,C,d] expert inboxes
+    inbox = jnp.einsum("nec,nd->ecd", dispatch, x)
+
+    if axis_name is None:
+        out = _expert_ffn(params["wi"], params["wo"], inbox)
+    else:
+        # [E, C, d] -> each shard holds every source shard's slots for its
+        # local experts: [E/ep, ep*C, d] (slot axis blocked by source shard)
+        inbox = lax.all_to_all(inbox, axis_name,
+                               split_axis=0, concat_axis=1, tiled=True)
+        out = _expert_ffn(params["wi"], params["wo"], inbox)
+        # route results back to source shards: [E, C, d]
+        out = lax.all_to_all(out, axis_name,
+                             split_axis=1, concat_axis=0, tiled=True)
+
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    return y, aux.astype(jnp.float32)
+
+
+def expert_param_specs(cfg: MoEConfig) -> dict:
+    """PartitionSpecs: experts sharded over ``expert``, router replicated."""
+    return {"router": P(), "wi": P(EXPERT_AXIS), "wo": P(EXPERT_AXIS)}
+
+
+def make_moe_layer(mesh: Mesh, cfg: MoEConfig):
+    """Build ``f(params, x) -> (y, aux)`` for token batch x [N, d], with
+    experts sharded over the mesh ``expert`` axis and tokens over ``data``
+    (falling back to replicated when those axes are absent/size-1)."""
+    ep = mesh.shape.get(EXPERT_AXIS, 1)
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
+                         f"expert degree {ep}")
+    if ep == 1:
+        def apply(params, x):
+            return moe_ffn(params, x, cfg, axis_name=None)
+        return apply
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tok_spec = P(DATA_AXIS) if dp > 1 else P()
+    pspec = expert_param_specs(cfg)
+
+    def inner(params, x):
+        y, aux = moe_ffn(params, x, cfg, axis_name=EXPERT_AXIS)
+        if dp > 1:
+            aux = lax.pmean(aux, DATA_AXIS)
+        aux = lax.pmean(aux, EXPERT_AXIS)
+        return y, aux
+
+    return shard_map(inner, mesh=mesh, in_specs=(pspec, tok_spec),
+                     out_specs=(tok_spec, P()), check_vma=False)

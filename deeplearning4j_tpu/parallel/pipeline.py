@@ -1,0 +1,200 @@
+"""Pipeline parallelism — GPipe-style microbatched SPMD pipeline.
+
+New capability with no reference counterpart (SURVEY.md §2.9: the reference
+has no pipeline parallelism; its only stage-wise scheduling is greedy
+layer-wise pretraining, MultiLayerNetwork.pretrain).  Built TPU-first:
+
+- The net is split into ``n_stages`` equal stages laid out over the mesh
+  ``pipe`` axis; every device holds ONLY its stage's parameters (stacked
+  ``[n_stages, ...]`` pytree sharded on the leading axis).
+- One jitted SPMD program runs on all stages (shard_map): at each tick every
+  device applies its stage to its resident activation, then the activation
+  ring-shifts to the next stage via ``lax.ppermute`` (neighbor ICI hop — the
+  cheapest collective on a TPU torus).
+- Microbatches enter at stage 0 one per tick and exit at the last stage
+  after ``n_stages - 1`` ticks of fill; total ticks =
+  ``n_micro + n_stages - 1`` (the GPipe bubble).  Reverse-mode autodiff
+  through the scan+ppermute yields the mirrored backward pipeline
+  automatically — no hand-written schedule.
+- Composes with data parallelism: the microbatch's batch dim may be sharded
+  over ``data``; XLA inserts the gradient psum when the loss is reduced.
+
+Typical use: ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape``
+(e.g. a run of transformer blocks); embed/unembed live inside the first and
+last stage respectively, or outside the pipelined region.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+Array = jax.Array
+PyTree = Any
+StageFn = Callable[[PyTree, Array], Array]
+
+
+def pipeline_forward(stage_fn: StageFn, stage_params: PyTree,
+                     microbatches: Array,
+                     axis_name: str = PIPE_AXIS) -> Array:
+    """SPMD pipelined forward.  MUST run inside shard_map with ``axis_name``
+    bound; every shard holds its own ``stage_params`` and the same
+    ``microbatches`` ``[n_micro, mb, ...]``; returns ``[n_micro, mb, ...]``
+    outputs (identical on every shard).
+
+    Tick ``t``: stage ``s`` processes microbatch ``t - s`` (when in range),
+    so the last stage emits microbatch ``t - (n_stages-1)`` at tick ``t``.
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    is_first = stage == 0
+    is_last = stage == n_stages - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t; everyone else takes the ring input.
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        x = jnp.where(is_first, inject, state)
+        y = stage_fn(stage_params, x)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        valid = jnp.logical_and(is_last, t >= n_stages - 1)
+        prev = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, y, prev), out_idx, 0)
+        state = lax.ppermute(y, axis_name, shift)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (state, outputs), _ = lax.scan(
+        tick, (state0, out0), jnp.arange(n_micro + n_stages - 1))
+    # outputs are only populated on the last stage; psum-broadcast them so
+    # every shard (and the caller outside shard_map) sees the result.
+    return lax.psum(jnp.where(is_last, outputs, 0.0), axis_name)
+
+
+def to_microbatches(x: Array, n_micro: int) -> Array:
+    """[B, ...] -> [n_micro, B/n_micro, ...]."""
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def from_microbatches(x: Array) -> Array:
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def stack_stage_params(per_stage: Sequence[PyTree]) -> PyTree:
+    """List of per-stage param pytrees -> stacked [n_stages, ...] pytree
+    (leading axis is what gets sharded over ``pipe``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def make_pipeline_fn(mesh: Mesh, stage_fn: StageFn, n_micro: int,
+                     data_sharded: bool = True):
+    """Build ``f(stacked_params, batch) -> out`` running the GPipe pipeline
+    over ``mesh``'s ``pipe`` axis (and batch over ``data`` if present).
+
+    ``stacked_params`` leaves have leading dim n_stages = mesh.shape['pipe'];
+    ``batch`` is ``[B, ...]`` with ``B`` divisible by ``n_micro`` (and the
+    microbatch size divisible by the data degree).
+    """
+    bdim = DATA_AXIS if data_sharded and mesh.shape.get(DATA_AXIS, 1) > 1 \
+        else None
+    xspec = P(None, bdim)          # [n_micro, mb, ...]: mb over data
+    pspec = P(PIPE_AXIS)           # prefix spec: leading stage axis
+
+    def inner(stacked, micro):
+        own = jax.tree.map(lambda p: p[0], stacked)   # this shard's stage
+        return pipeline_forward(stage_fn, own, micro)
+
+    sharded = shard_map(inner, mesh=mesh, in_specs=(pspec, xspec),
+                        out_specs=xspec, check_vma=False)
+
+    n_stages = mesh.shape[PIPE_AXIS]
+
+    def apply(stacked_params, batch):
+        for leaf in jax.tree.leaves(stacked_params):
+            if leaf.shape[0] != n_stages:
+                raise ValueError(
+                    f"stacked params leading dim {leaf.shape[0]} != pipe "
+                    f"degree {n_stages}; each shard must own exactly one "
+                    f"stage (use split_layers_into_stages for deeper nets)")
+        micro = to_microbatches(batch, n_micro)
+        return from_microbatches(sharded(stacked_params, micro))
+
+    return apply
+
+
+def make_pipeline_train_step(mesh: Mesh, stage_fn: StageFn,
+                             loss_fn: Callable[[Array, Array], Array],
+                             n_micro: int, optimizer=None,
+                             learning_rate: float = 1e-2):
+    """Full dp+pp training step: pipelined forward, loss vs targets, grads
+    through the mirrored backward pipeline, SGD (or optax) update.
+
+    Returns ``(init_opt_state, step)`` where
+    ``step(params, opt_state, batch, targets) -> (params, opt_state, loss)``.
+    ``params`` is the stacked [n_stages, ...] pytree (shard it with
+    ``stage_param_sharding`` before passing for zero relayout).
+    """
+    fwd = make_pipeline_fn(mesh, stage_fn, n_micro)
+
+    def loss_of(params, batch, targets):
+        out = fwd(params, batch)
+        return loss_fn(out, targets)
+
+    if optimizer is None:
+        def init_opt(params):
+            return ()
+
+        @jax.jit
+        def step(params, opt_state, batch, targets):
+            loss, grads = jax.value_and_grad(loss_of)(params, batch, targets)
+            params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                                  params, grads)
+            return params, opt_state, loss
+        return init_opt, step
+
+    def init_opt(params):
+        return optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch, targets):
+        loss, grads = jax.value_and_grad(loss_of)(params, batch, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+    return init_opt, step
+
+
+def stage_param_sharding(mesh: Mesh, stacked_params: PyTree) -> PyTree:
+    """NamedShardings placing each stage's params on its pipe shard."""
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, P(PIPE_AXIS))
+    return jax.tree.map(lambda _: sh, stacked_params)
+
+
+def split_layers_into_stages(stacked_layer_params: PyTree,
+                             n_stages: int) -> PyTree:
+    """Reshape a ``[n_layers, ...]`` scanned-blocks pytree (e.g. the
+    transformer's) into ``[n_stages, layers_per_stage, ...]`` so each pipe
+    shard scans its own run of blocks."""
+    def resh(p):
+        n_layers = p.shape[0]
+        if n_layers % n_stages != 0:
+            raise ValueError(
+                f"n_layers={n_layers} not divisible by n_stages={n_stages}")
+        return p.reshape((n_stages, n_layers // n_stages) + p.shape[1:])
+    return jax.tree.map(resh, stacked_layer_params)

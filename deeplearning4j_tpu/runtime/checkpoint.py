@@ -69,8 +69,10 @@ def save_pytree(path: str, tree: PyTree, meta: Optional[Dict] = None) -> None:
         "meta": meta or {},
         "format": 1,
     }
-    with open(path + ".json", "w") as f:
+    side_tmp = path + ".json.tmp"
+    with open(side_tmp, "w") as f:
         json.dump(sidecar, f, indent=1)
+    os.replace(side_tmp, path + ".json")
 
 
 def load_pytree(path: str, like: Optional[PyTree] = None

@@ -1,0 +1,104 @@
+"""MoE / expert parallelism: routing invariants, dense-reference equality
+with ample capacity, expert-parallel == single-shard, aux loss sanity,
+training reduces loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import expert as ex
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def dense_reference(params, x, cfg):
+    """Every token through its top-k experts directly (no capacity)."""
+    gates = jax.nn.softmax(x @ params["router"], axis=-1)
+    topv, topi = jax.lax.top_k(gates, cfg.top_k)
+    topv = topv / jnp.sum(topv, -1, keepdims=True)
+    outs = []
+    for n in range(x.shape[0]):
+        acc = jnp.zeros(cfg.d_model)
+        for j in range(cfg.top_k):
+            e = topi[n, j]
+            h = jax.nn.gelu(x[n] @ params["wi"][e])
+            acc = acc + topv[n, j] * (h @ params["wo"][e])
+        outs.append(acc)
+    return jnp.stack(outs)
+
+
+def test_route_topk_invariants():
+    N, E, C, k = 16, 4, 32, 2
+    gates = jax.nn.softmax(
+        jax.random.normal(jax.random.key(0), (N, E)), axis=-1)
+    dispatch, combine, aux = ex.route_topk(gates, k, C)
+    # each token occupies at most k slots, each slot at most one token
+    assert dispatch.shape == (N, E, C)
+    assert float(jnp.max(jnp.sum(dispatch, axis=(1, 2)))) <= k
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0 + 1e-6
+    # combine weights per token sum to ~1 when nothing is dropped
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))),
+                               np.ones(N), rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_capacity_drops_tokens():
+    N, E, k = 8, 2, 1
+    gates = jnp.tile(jnp.asarray([[0.9, 0.1]]), (N, 1))  # all pick expert 0
+    dispatch, combine, aux = ex.route_topk(gates, k, capacity=3)
+    assert float(jnp.sum(dispatch)) == 3.0  # only 3 slots for 8 tokens
+
+
+def test_moe_matches_dense_reference():
+    cfg = ex.MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                       d_model=8, d_ff=16)
+    params = ex.init_moe_params(jax.random.key(1), cfg)
+    x = jax.random.normal(jax.random.key(2), (12, 8))
+    y, aux = ex.moe_ffn(params, x, cfg)
+    ref = dense_reference(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("ep,dp", [(4, 1), (4, 2), (8, 1)])
+def test_expert_parallel_matches_single(devices, ep, dp):
+    cfg = ex.MoEConfig(n_experts=8, top_k=2, capacity_factor=4.0,
+                       d_model=8, d_ff=16)
+    params = ex.init_moe_params(jax.random.key(3), cfg)
+    x = jax.random.normal(jax.random.key(4), (16, 8))
+
+    y_single, aux_single = ex.moe_ffn(params, x, cfg)
+
+    mesh = make_mesh(MeshSpec(data=dp, expert=ep), devices=devices[:ep * dp])
+    layer = ex.make_moe_layer(mesh, cfg)
+    y_par, aux_par = jax.jit(layer)(params, x)
+    if dp > 1:
+        # tokens sharded over data: each group routes independently with
+        # per-shard capacity; with ample capacity outputs still match.
+        pass
+    np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_single),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_training_reduces_loss(devices):
+    cfg = ex.MoEConfig(n_experts=4, top_k=1, capacity_factor=2.0,
+                       d_model=8, d_ff=16, aux_loss_weight=1e-2)
+    mesh = make_mesh(MeshSpec(data=1, expert=4), devices=devices[:4])
+    layer = ex.make_moe_layer(mesh, cfg)
+    params = ex.init_moe_params(jax.random.key(5), cfg)
+    x = jax.random.normal(jax.random.key(6), (32, 8))
+    t = jnp.tanh(x @ jax.random.normal(jax.random.key(7), (8, 8)))
+
+    @jax.jit
+    def step(p):
+        def loss_fn(p):
+            y, aux = layer(p, x)
+            return jnp.mean((y - t) ** 2) + cfg.aux_loss_weight * aux
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        return jax.tree.map(lambda a, b: a - 0.1 * b, p, g), loss
+
+    losses = []
+    for _ in range(15):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
