@@ -20,7 +20,7 @@ for the TPU torus:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -70,7 +70,10 @@ def route_topk(gates: Array, top_k: int, capacity: int
     topv, topi = lax.top_k(gates, top_k)                # [N, k]
     topv = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
 
-    masks = jax.nn.one_hot(topi, E, dtype=gates.dtype)  # [N, k, E]
+    # Slot accounting is COUNTING, not math on probabilities: keep it in
+    # int32.  In bf16 (the usual compute dtype) a cumsum cannot represent
+    # counts above 256 exactly, silently colliding tokens into one slot.
+    masks = jax.nn.one_hot(topi, E, dtype=jnp.int32)    # [N, k, E]
     # positions: choice-major cumulative count per expert (choice 0 of every
     # token outranks choice 1, GShard-style priority)
     flat = jnp.swapaxes(masks, 0, 1).reshape(top_k * N, E)
@@ -80,17 +83,18 @@ def route_topk(gates: Array, top_k: int, capacity: int
     dispatch = jnp.zeros((N, E, capacity), gates.dtype)
     combine = jnp.zeros((N, E, capacity), gates.dtype)
     for j in range(top_k):
-        m = masks[:, j]                                  # [N, E]
-        slot = jnp.sum(pos[:, j] * m, axis=-1).astype(jnp.int32)  # [N]
-        sel = m * (slot < capacity)[:, None]             # capacity-dropped
+        m = masks[:, j]                                  # [N, E] int
+        slot = jnp.sum(pos[:, j] * m, axis=-1)           # [N] int32
+        sel = (m * (slot < capacity)[:, None]).astype(gates.dtype)
         slot_oh = jax.nn.one_hot(slot, capacity, dtype=gates.dtype)
         d_j = sel[:, :, None] * slot_oh[:, None, :]      # [N, E, C]
         dispatch = dispatch + d_j
         combine = combine + d_j * topv[:, j][:, None, None]
 
-    # Switch aux loss: E * sum_e (token fraction to e) * (mean prob of e)
-    f_e = jnp.sum(masks.sum(1), axis=0) / (N * top_k)        # [E]
-    p_e = jnp.mean(gates, axis=0)                            # [E]
+    # Switch aux loss: E * sum_e (token fraction to e) * (mean prob of e);
+    # accumulated in f32 (a bf16 sum over N tokens is equally lossy).
+    f_e = jnp.sum(masks.sum(1), axis=0).astype(jnp.float32) / (N * top_k)
+    p_e = jnp.mean(gates.astype(jnp.float32), axis=0)        # [E]
     aux = E * jnp.sum(f_e * p_e)
     return dispatch, combine, aux
 
@@ -147,8 +151,9 @@ def expert_param_specs(cfg: MoEConfig) -> dict:
 
 def make_moe_layer(mesh: Mesh, cfg: MoEConfig):
     """Build ``f(params, x) -> (y, aux)`` for token batch x [N, d], with
-    experts sharded over the mesh ``expert`` axis and tokens over ``data``
-    (falling back to replicated when those axes are absent/size-1)."""
+    experts sharded over the mesh ``expert`` axis and tokens over
+    ``data`` x ``expert`` (falling back to replicated when those axes are
+    absent/size-1)."""
     ep = mesh.shape.get(EXPERT_AXIS, 1)
     if cfg.n_experts % ep != 0:
         raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
@@ -159,7 +164,15 @@ def make_moe_layer(mesh: Mesh, cfg: MoEConfig):
         return apply
 
     dp = mesh.shape.get(DATA_AXIS, 1)
-    tok_spec = P(DATA_AXIS) if dp > 1 else P()
+    # Tokens shard over BOTH data and expert axes: with tokens only on
+    # ``data``, every expert shard would route the identical token set and
+    # do the full single-device FFN FLOPs — expert parallelism would save
+    # weight memory but zero compute.  Splitting tokens across the expert
+    # axis cuts per-device routing + FFN work by the expert degree; the
+    # all_to_alls then move each sub-batch's slots to their expert owners.
+    tok_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS)
+                     if mesh.shape.get(a, 1) > 1)
+    tok_spec = P(tok_axes) if tok_axes else P()
     pspec = expert_param_specs(cfg)
 
     def inner(params, x):

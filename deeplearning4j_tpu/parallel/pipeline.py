@@ -26,7 +26,7 @@ last stage respectively, or outside the pipelined region.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp

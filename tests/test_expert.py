@@ -72,10 +72,8 @@ def test_expert_parallel_matches_single(devices, ep, dp):
     mesh = make_mesh(MeshSpec(data=dp, expert=ep), devices=devices[:ep * dp])
     layer = ex.make_moe_layer(mesh, cfg)
     y_par, aux_par = jax.jit(layer)(params, x)
-    if dp > 1:
-        # tokens sharded over data: each group routes independently with
-        # per-shard capacity; with ample capacity outputs still match.
-        pass
+    # dp > 1 shards tokens over data: each group routes independently with
+    # per-shard capacity; with ample capacity outputs still match.
     np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_single),
                                rtol=2e-4, atol=2e-4)
 
@@ -102,3 +100,16 @@ def test_moe_training_reduces_loss(devices):
         params, loss = step(params)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_route_topk_bf16_no_slot_collisions():
+    """Slot counting must be exact in int32 even when gates are bf16: a
+    bf16 cumsum cannot represent counts > 256, which used to collide many
+    tokens into one capacity slot at realistic token counts."""
+    N, E, k = 512, 2, 1
+    gates = jax.nn.softmax(
+        jax.random.normal(jax.random.key(5), (N, E)), axis=-1
+    ).astype(jnp.bfloat16)
+    dispatch, _, _ = ex.route_topk(gates, k, capacity=400)
+    per_slot = np.asarray(jnp.sum(dispatch.astype(jnp.float32), axis=0))
+    assert per_slot.max() <= 1.0 + 1e-6, f"slot collision: {per_slot.max()}"
