@@ -1,0 +1,359 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The hot op of the BERT/transformer path (SURVEY.md §5.7 calls attention out
+as a new first-class capability; the reference has none).  Design:
+
+- Online-softmax blocked attention (Flash style): the [Tq, Tk] score matrix
+  never materializes in HBM — each grid step streams K/V blocks through
+  VMEM with running (max, sum, acc) statistics in fp32.
+- Grid is (batch*heads, query-blocks); K/V for the head live in VMEM and an
+  inner ``fori_loop`` walks key blocks.  Causal masking prunes the key loop
+  to the lower-triangular blocks (no wasted MXU work past the diagonal).
+- Backward is the standard two-kernel flash split — dKdV (grid over key
+  blocks) and dQ (grid over query blocks) — recomputing probabilities from
+  the saved logsumexp instead of storing the T² matrix.
+- Matmuls run on the MXU in the input dtype (bf16 in practice) with fp32
+  accumulation (``preferred_element_type``); softmax statistics stay fp32.
+- ``interpret=True`` runs the same kernels through the Pallas interpreter,
+  which is how the CPU test harness validates them against the plain XLA
+  attention in models/transformer.py.
+
+Use ``flash_attention`` directly, or ``attention_auto`` which falls back to
+the plain XLA implementation off-TPU or for unaligned shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _pick_block(t: int, preferred: int) -> int:
+    """Largest block size <= preferred that divides t."""
+    b = min(preferred, t)
+    while t % b != 0:
+        b -= 1
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                scale: float, block_k: int, causal: bool):
+    """One (batch*head, q-block) grid step.
+
+    q_ref [1, bq, D]; k_ref/v_ref [1, T, D]; bias_ref [1, T] additive mask;
+    o_ref [1, bq, D]; lse_ref [1, bq].
+    """
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    T = k_ref.shape[1]
+    D = q_ref.shape[2]
+    n_k = T // block_k
+
+    q = q_ref[0]                                         # [bq, D]
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+
+    q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]     # [bk, D]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = s + bias_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            k_cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_rows >= k_cols, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                           # [bq, bk] fp32
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc = acc * alpha + pv
+        return m_new, l, acc
+
+    if causal:
+        # key blocks strictly above the diagonal contribute nothing
+        n_live = lax.div(qi * bq + bq + block_k - 1, block_k)
+        n_iter = jnp.minimum(n_live, n_k)
+    else:
+        n_iter = n_k
+    m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+
+    l = jnp.maximum(l, 1e-30)                            # fully-masked rows
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
+    """q4/k4/v4: [BH, T, D] (head-major flattened); bias [B_or_BH?, T].
+
+    bias is already expanded to [BH, T] by the caller.
+    """
+    BH, T, D = q4.shape
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(T, block_k)
+    scale = 1.0 / (D ** 0.5)
+
+    kern = functools.partial(_fwd_kernel, scale=scale, block_k=bk,
+                             causal=causal)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, T), lambda bh, i: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q4.dtype),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q4, k4, v4, bias)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *,
+                    scale: float, block_q: int, causal: bool):
+    """Grid (BH, key-blocks): accumulate dK/dV for one key block by
+    streaming query blocks."""
+    kj = pl.program_id(1)
+    bk = k_ref.shape[1]
+    T = q_ref.shape[1]
+    D = q_ref.shape[2]
+    n_q = T // block_q
+
+    k = k_ref[0]                                         # [bk, D]
+    v = v_ref[0]
+    bias = bias_ref[0][None, :]                          # [1, bk] (this block)
+    k_cols = kj * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]     # [bq, D]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = s + bias
+        if causal:
+            q_rows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            s = jnp.where(q_rows >= k_cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                             # [bq, bk] fp32
+
+        dv = dv + lax.dot_general(p.astype(do.dtype), do,
+                                  (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                    # [bq, bk]
+        dk = dk + lax.dot_general(ds.astype(q.dtype), q,
+                                  (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # query blocks strictly before this key block see none of it
+        i0 = lax.div(kj * bk, block_q)
+    else:
+        i0 = 0
+    dk0 = jnp.zeros((bk, D), jnp.float32)
+    dv0 = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = lax.fori_loop(i0, n_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *,
+                   scale: float, block_k: int, causal: bool):
+    """Grid (BH, query-blocks): accumulate dQ for one query block."""
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    T = k_ref.shape[1]
+    D = q_ref.shape[2]
+    n_k = T // block_k
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = s + bias_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            k_cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_rows >= k_cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + lax.dot_general(ds.astype(k.dtype), k,
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    if causal:
+        n_live = lax.div(qi * bq + bq + block_k - 1, block_k)
+        n_iter = jnp.minimum(n_live, n_k)
+    else:
+        n_iter = n_k
+    dq = lax.fori_loop(0, n_iter, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, do4):
+    q4, k4, v4, bias, o4, lse = residuals
+    BH, T, D = q4.shape
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(T, block_k)
+    scale = 1.0 / (D ** 0.5)
+
+    # delta_i = rowsum(dO * O) — the softmax-jacobian diagonal term
+    delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32),
+                    axis=-1)                             # [BH, T]
+
+    full = lambda bh, i: (bh, 0, 0)
+    vec = lambda bh, i: (bh, 0)
+
+    dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                 block_q=bq, causal=causal)
+    dk4, dv4 = pl.pallas_call(
+        dkv_kern,
+        grid=(BH, T // bk),
+        in_specs=[
+            pl.BlockSpec((1, T, D), full),                       # q
+            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # k block
+            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # v block
+            pl.BlockSpec((1, bk), lambda bh, j: (bh, j)),        # bias block
+            pl.BlockSpec((1, T, D), full),                       # do
+            pl.BlockSpec((1, T), vec),                           # lse
+            pl.BlockSpec((1, T), vec),                           # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k4.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v4.dtype),
+        ],
+        interpret=interpret,
+    )(q4, k4, v4, bias, do4, lse, delta)
+
+    dq_kern = functools.partial(_bwd_dq_kernel, scale=scale,
+                                block_k=bk, causal=causal)
+    dq4 = pl.pallas_call(
+        dq_kern,
+        grid=(BH, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # q block
+            pl.BlockSpec((1, T, D), full),                       # k
+            pl.BlockSpec((1, T, D), full),                       # v
+            pl.BlockSpec((1, T), vec),                           # bias
+            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # do block
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),        # lse block
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),        # delta blk
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q4.dtype),
+        interpret=interpret,
+    )(q4, k4, v4, bias, do4, lse, delta)
+
+    return dq4, dk4, dv4, None  # no gradient for bias
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhtd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd_rule(q4, k4, v4, bias, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret)
+    return o, (q4, k4, v4, bias, o, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, residuals, do4):
+    return _bwd(causal, block_q, block_k, interpret, residuals, do4)
+
+
+_flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: Array, k: Array, v: Array,
+                    mask: Optional[Array] = None, causal: bool = False, *,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> Array:
+    """Flash attention: q/k/v [B, T, NH, D] -> [B, T, NH, D].
+
+    Drop-in for models/transformer.py:attention (same signature + mask
+    semantics: mask [B, Tk], 1 = attend).  ``interpret=None`` auto-selects
+    the Pallas interpreter off-TPU so tests run on the CPU harness.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    B, T, NH, D = q.shape
+    to_bhtd = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * NH, T, D)
+    q4, k4, v4 = to_bhtd(q), to_bhtd(k), to_bhtd(v)
+    if mask is None:
+        bias = jnp.zeros((B, T), jnp.float32)
+    else:
+        bias = (1.0 - mask.astype(jnp.float32)) * _NEG_INF
+    bias = jnp.repeat(bias, NH, axis=0)                  # [BH, T]
+    o4 = _flash_bhtd(q4, k4, v4, bias, causal, block_q, block_k, interpret)
+    return jnp.transpose(o4.reshape(B, NH, T, D), (0, 2, 1, 3))
+
+
+def attention_auto(q: Array, k: Array, v: Array,
+                   mask: Optional[Array] = None,
+                   causal: bool = False) -> Array:
+    """Pallas flash attention on TPU; plain XLA attention elsewhere (the
+    interpreter is far too slow for real CPU training, and XLA fuses the
+    small-T case well)."""
+    from deeplearning4j_tpu.models import transformer as tfm
+
+    if jax.devices()[0].platform == "tpu":
+        return flash_attention(q, k, v, mask, causal)
+    return tfm.attention(q, k, v, mask, causal)
