@@ -36,12 +36,13 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
     else:
         cfg = bert.bert_base()
 
-    from deeplearning4j_tpu.ops.pallas_attention import attention_auto
+    from deeplearning4j_tpu.ops.pallas_attention import make_flash_attn
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshSpec(data=n_dev), devices=jax.devices())
     init_fn, step_fn = bert.make_train_step(
-        cfg, mesh, optimizer=optax.adamw(1e-4), attn_fn=attention_auto)
+        cfg, mesh, optimizer=optax.adamw(1e-4),
+        attn_fn=make_flash_attn(mesh))
 
     state = init_fn(jax.random.key(0))
     batch = bert.synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len)

@@ -34,7 +34,15 @@ from jax.experimental import pallas as pl
 
 Array = jax.Array
 
-_NEG_INF = -1e30
+# Additive mask value.  Deliberately NOT -1e30: the backward pass
+# reconstructs probabilities as exp(s - lse) from the SAVED fp32
+# logsumexp, and for a fully-masked row lse = mask_val + log(T).  The
+# log(T) term must survive fp32 rounding next to mask_val (ulp(1e5) =
+# 0.008, ulp(1e30) = 1e23), otherwise padding rows get p = 1 per key
+# instead of 1/T and inject T-times-too-large garbage into dK/dV.
+# -1e5 still underflows exp() to exactly 0 against any real score.
+_MASK_VAL = -1e5
+_NEG_INIT = -1e30                    # running-max seed only; never stored
 
 
 def _pick_block(t: int, preferred: int) -> int:
@@ -63,7 +71,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     n_k = T // block_k
 
     q = q_ref[0]                                         # [bq, D]
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INIT, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, D), jnp.float32)
 
@@ -79,7 +87,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         if causal:
             k_cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_rows >= k_cols, s, _NEG_INF)
+            s = jnp.where(q_rows >= k_cols, s, _MASK_VAL)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -104,33 +112,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
 
 
 def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
-    """q4/k4/v4: [BH, T, D] (head-major flattened); bias [B_or_BH?, T].
-
-    bias is already expanded to [BH, T] by the caller.
-    """
-    BH, T, D = q4.shape
-    bq = _pick_block(T, block_q)
-    bk = _pick_block(T, block_k)
+    """q4 [BH, Tq, D]; k4/v4 [BH, Tk, D]; bias [BH, Tk] (already expanded
+    across heads by the caller).  Tq and Tk may differ (cross-attention)."""
+    BH, Tq, D = q4.shape
+    Tk = k4.shape[1]
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
     scale = 1.0 / (D ** 0.5)
 
     kern = functools.partial(_fwd_kernel, scale=scale, block_k=bk,
                              causal=causal)
     o, lse = pl.pallas_call(
         kern,
-        grid=(BH, T // bq),
+        grid=(BH, Tq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, T), lambda bh, i: (bh, 0)),
+            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Tk), lambda bh, i: (bh, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q4.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq, D), q4.dtype),
+            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
         ],
         interpret=interpret,
     )(q4, k4, v4, bias)
@@ -170,7 +177,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         if causal:
             q_rows = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
-            s = jnp.where(q_rows >= k_cols, s, _NEG_INF)
+            s = jnp.where(q_rows >= k_cols, s, _MASK_VAL)
         p = jnp.exp(s - lse)                             # [bq, bk] fp32
 
         dv = dv + lax.dot_general(p.astype(do.dtype), do,
@@ -221,7 +228,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         if causal:
             k_cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_rows >= k_cols, s, _NEG_INF)
+            s = jnp.where(q_rows >= k_cols, s, _MASK_VAL)
         p = jnp.exp(s - lse)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -241,14 +248,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
 def _bwd(causal, block_q, block_k, interpret, residuals, do4):
     q4, k4, v4, bias, o4, lse = residuals
-    BH, T, D = q4.shape
-    bq = _pick_block(T, block_q)
-    bk = _pick_block(T, block_k)
+    BH, Tq, D = q4.shape
+    Tk = k4.shape[1]
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
     scale = 1.0 / (D ** 0.5)
 
     # delta_i = rowsum(dO * O) — the softmax-jacobian diagonal term
     delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32),
-                    axis=-1)                             # [BH, T]
+                    axis=-1)                             # [BH, Tq]
 
     full = lambda bh, i: (bh, 0, 0)
     vec = lambda bh, i: (bh, 0)
@@ -257,23 +265,23 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
                                  block_q=bq, causal=causal)
     dk4, dv4 = pl.pallas_call(
         dkv_kern,
-        grid=(BH, T // bk),
+        grid=(BH, Tk // bk),
         in_specs=[
-            pl.BlockSpec((1, T, D), full),                       # q
+            pl.BlockSpec((1, Tq, D), full),                      # q
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # k block
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # v block
             pl.BlockSpec((1, bk), lambda bh, j: (bh, j)),        # bias block
-            pl.BlockSpec((1, T, D), full),                       # do
-            pl.BlockSpec((1, T), vec),                           # lse
-            pl.BlockSpec((1, T), vec),                           # delta
+            pl.BlockSpec((1, Tq, D), full),                      # do
+            pl.BlockSpec((1, Tq), vec),                          # lse
+            pl.BlockSpec((1, Tq), vec),                          # delta
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), k4.dtype),
-            jax.ShapeDtypeStruct((BH, T, D), v4.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), k4.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), v4.dtype),
         ],
         interpret=interpret,
     )(q4, k4, v4, bias, do4, lse, delta)
@@ -282,18 +290,18 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
                                 block_k=bk, causal=causal)
     dq4 = pl.pallas_call(
         dq_kern,
-        grid=(BH, T // bq),
+        grid=(BH, Tq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # q block
-            pl.BlockSpec((1, T, D), full),                       # k
-            pl.BlockSpec((1, T, D), full),                       # v
-            pl.BlockSpec((1, T), vec),                           # bias
+            pl.BlockSpec((1, Tk, D), full),                      # k
+            pl.BlockSpec((1, Tk, D), full),                      # v
+            pl.BlockSpec((1, Tk), vec),                          # bias
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # do block
             pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),        # lse block
             pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),        # delta blk
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q4.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q4.dtype),
         interpret=interpret,
     )(q4, k4, v4, bias, do4, lse, delta)
 
@@ -334,26 +342,91 @@ def flash_attention(q: Array, k: Array, v: Array,
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    B, T, NH, D = q.shape
-    to_bhtd = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * NH, T, D)
+    B, Tq, NH, D = q.shape
+    Tk = k.shape[1]
+    if causal and Tq != Tk:
+        raise ValueError(f"causal flash attention requires Tq == Tk, got "
+                         f"{Tq} != {Tk}")
+
+    def to_bhtd(x):
+        b, t, nh, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * nh, t, d)
+
     q4, k4, v4 = to_bhtd(q), to_bhtd(k), to_bhtd(v)
     if mask is None:
-        bias = jnp.zeros((B, T), jnp.float32)
+        bias = jnp.zeros((B, Tk), jnp.float32)
     else:
-        bias = (1.0 - mask.astype(jnp.float32)) * _NEG_INF
-    bias = jnp.repeat(bias, NH, axis=0)                  # [BH, T]
+        bias = (1.0 - mask.astype(jnp.float32)) * _MASK_VAL
+    bias = jnp.repeat(bias, NH, axis=0)                  # [BH, Tk]
     o4 = _flash_bhtd(q4, k4, v4, bias, causal, block_q, block_k, interpret)
-    return jnp.transpose(o4.reshape(B, NH, T, D), (0, 2, 1, 3))
+    return jnp.transpose(o4.reshape(B, NH, Tq, D), (0, 2, 1, 3))
+
+
+def _aligned_for_tpu(Tq: int, Tk: int, D: int) -> bool:
+    """Shapes Mosaic tiles well: block sizes stay >= 8 sublanes and the
+    head dim is a multiple of the fp32 sublane count."""
+    return (_pick_block(Tq, 128) >= 8 and _pick_block(Tk, 128) >= 8
+            and D % 8 == 0 and D <= 256)
 
 
 def attention_auto(q: Array, k: Array, v: Array,
                    mask: Optional[Array] = None,
                    causal: bool = False) -> Array:
-    """Pallas flash attention on TPU; plain XLA attention elsewhere (the
-    interpreter is far too slow for real CPU training, and XLA fuses the
-    small-T case well)."""
+    """Pallas flash attention when it can actually run well: on a single
+    TPU device with Mosaic-friendly shapes.  Everywhere else — CPU (the
+    interpreter is far too slow for real training), unaligned shapes
+    (degenerate block sizes), or multi-device meshes (a pallas_call inside
+    a GSPMD-jitted step cannot be partitioned; use ``make_flash_attn``
+    with the mesh instead) — the plain XLA attention.
+    """
     from deeplearning4j_tpu.models import transformer as tfm
 
-    if jax.devices()[0].platform == "tpu":
+    if (jax.devices()[0].platform == "tpu" and jax.device_count() == 1
+            and _aligned_for_tpu(q.shape[1], k.shape[1], q.shape[3])):
         return flash_attention(q, k, v, mask, causal)
     return tfm.attention(q, k, v, mask, causal)
+
+
+def make_flash_attn(mesh):
+    """Mesh-aware flash attention for multi-chip training steps.
+
+    A raw ``pallas_call`` inside a GSPMD-jitted train step is an opaque
+    custom call the SPMD partitioner cannot split, so the kernel must be
+    placed under ``shard_map`` along the axes the batch/heads are actually
+    sharded over (``data`` for the batch, ``model`` for heads — attention
+    is independent per (batch, head), so no collectives are needed).
+    Falls back to plain XLA attention off-TPU, under sequence parallelism
+    (ring attention owns that axis), or for unaligned shapes.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.models import transformer as tfm
+    from deeplearning4j_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+    if (jax.devices()[0].platform != "tpu"
+            or mesh.shape.get(SEQ_AXIS, 1) > 1):
+        return tfm.attention
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    qspec = P(DATA_AXIS, None, MODEL_AXIS, None)
+    mspec = P(DATA_AXIS, None)
+
+    def attn(q, k, v, mask=None, causal=False):
+        B, Tq, NH, D = q.shape
+        Tk = k.shape[1]
+        if (B % dp != 0 or NH % tp != 0
+                or not _aligned_for_tpu(Tq, Tk, D)):
+            return tfm.attention(q, k, v, mask, causal)
+        if mask is None:
+            mask = jnp.ones((B, Tk), jnp.float32)
+        f = shard_map(
+            lambda q, k, v, m: flash_attention(q, k, v, m, causal,
+                                               interpret=False),
+            mesh=mesh, in_specs=(qspec, qspec, qspec, mspec),
+            out_specs=qspec, check_vma=False)
+        return f(q, k, v, mask)
+
+    return attn

@@ -83,3 +83,51 @@ def test_attention_auto_dispatch():
     out = pa.attention_auto(q, k, v, None, False)
     ref = tfm.attention(q, k, v, None, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_fully_masked_row_grads_bounded():
+    """A length-0 padded sequence must not inject inflated gradients: the
+    saved fp32 lse has to keep log(T) next to the mask value (regression
+    for the -1e30 mask constant, which made backward p = 1 per key — a
+    T-times-too-large dK/dV).  Exact values intentionally differ from
+    tfm.attention there (its -1e9 bias collapses scores to uniform via
+    fp32 rounding), so assert boundedness: backward probabilities must
+    still sum to ~1 per row, so masked-batch grads stay the same order of
+    magnitude as real ones."""
+    T = 16
+    q, k, v = _qkv(jax.random.key(5), B=2, T=T, NH=1, D=8)
+    mask = jnp.stack([jnp.zeros(T), jnp.ones(T)]).astype(jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pa.flash_attention(q, k, v, mask, False,
+                                          block_q=8, block_k=8,
+                                          interpret=True) ** 2)
+
+    dq, dk, dv = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    masked_dv = float(jnp.max(jnp.abs(dv[0])))
+    live_dv = float(jnp.max(jnp.abs(dv[1])))
+    # with the -1e30 bug masked_dv came out ~T x live_dv
+    assert masked_dv < 4 * live_dv, (masked_dv, live_dv)
+    assert np.isfinite(np.asarray(dq)).all()
+    assert np.isfinite(np.asarray(dk)).all()
+
+
+def test_cross_attention_tq_ne_tk():
+    kq, kk, kv = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(kq, (2, 16, 2, 8))
+    k = jax.random.normal(kk, (2, 48, 2, 8))
+    v = jax.random.normal(kv, (2, 48, 2, 8))
+    ref = tfm.attention(q, k, v, None, False)
+    out = pa.flash_attention(q, k, v, None, False,
+                             block_q=8, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError):
+        pa.flash_attention(q, k, v, None, True, interpret=True)
+
+
+def test_make_flash_attn_cpu_fallback(devices):
+    """Off-TPU the mesh-aware factory must return the plain XLA path."""
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    assert pa.make_flash_attn(mesh) is tfm.attention
