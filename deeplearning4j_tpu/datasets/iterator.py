@@ -316,3 +316,80 @@ class MnistDataSetIterator(BaseDatasetIterator):
 class IrisDataSetIterator(BaseDatasetIterator):
     def __init__(self, batch: int, num_examples: int = 0, **kw):
         super().__init__(batch, num_examples, IrisDataFetcher(**kw))
+
+
+class NativeBatchIterator(DataSetIterator):
+    """Endless shuffled minibatch stream assembled by the native C++
+    producer thread (runtime/native.NativeBatcher): batch gather runs off
+    the Python thread and overlaps device compute.  Pure-Python fallback
+    (numpy permutation per epoch) when the native library is unavailable,
+    so callers never need to branch.
+
+    ``has_next`` is epoch-scoped like BaseDatasetIterator: one epoch of
+    full batches, then reset() rewinds (the underlying stream keeps
+    producing across epochs — reset only rewinds the epoch counter).
+    """
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray,
+                 batch_size: int, seed: int = 0, shuffle: bool = True):
+        super().__init__(batch_size)
+        self._x = np.ascontiguousarray(features, dtype=np.float32)
+        self._y = np.ascontiguousarray(labels, dtype=np.float32)
+        if self._y.ndim == 1:
+            self._y = self._y[:, None]
+        self._seed = seed
+        self._shuffle = shuffle
+        self._native = None
+        try:
+            from deeplearning4j_tpu.runtime.native import NativeBatcher
+            self._native = NativeBatcher(self._x, self._y, batch_size,
+                                         seed=seed, shuffle=shuffle)
+            self.batches_per_epoch = self._native.batches_per_epoch
+        except (RuntimeError, ImportError):
+            self.batches_per_epoch = max(len(self._x) // batch_size, 1)
+            self._epoch = 0
+            self._order = self._make_order()
+        self._cursor = 0
+
+    def _make_order(self) -> np.ndarray:
+        if not self._shuffle:
+            return np.arange(len(self._x))
+        rng = np.random.default_rng(self._seed + getattr(self, "_epoch", 0))
+        return rng.permutation(len(self._x))
+
+    @property
+    def uses_native(self) -> bool:
+        return self._native is not None
+
+    def has_next(self) -> bool:
+        return self._cursor < self.batches_per_epoch
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        if self._native is not None:
+            bx, by = self._native.next()
+        else:
+            b, n = self.batch_size, len(self._x)
+            idx = [self._order[(self._cursor * b + r) % n] for r in range(b)]
+            bx, by = self._x[idx], self._y[idx]
+            if self._cursor + 1 >= self.batches_per_epoch:
+                self._epoch += 1
+                self._order = self._make_order()
+        self._cursor += 1
+        return self._post(DataSet(jnp.asarray(bx), jnp.asarray(by)))
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def total_examples(self) -> int:
+        return len(self._x)
+
+    def input_columns(self) -> int:
+        return self._x.shape[1]
+
+    def total_outcomes(self) -> int:
+        return self._y.shape[1]
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None

@@ -82,7 +82,12 @@ def find_mnist_dir() -> Optional[str]:
 
 def load_mnist(data_dir: str, train: bool = True
                ) -> Tuple[np.ndarray, np.ndarray]:
-    """(images uint8 [N,28,28], labels uint8 [N]) from idx files."""
+    """(images uint8 [N,28,28], labels uint8 [N]) from idx files.
+
+    Uncompressed files parse through the native C++ reader when the
+    runtime library is available (runtime/native.py); .gz falls back to
+    the Python readers.
+    """
     img_key = "train_images" if train else "test_images"
     lbl_key = "train_labels" if train else "test_labels"
 
@@ -94,7 +99,19 @@ def load_mnist(data_dir: str, train: bool = True
                     return p
         raise FileNotFoundError(f"no idx file for {key} in {data_dir}")
 
-    return read_idx_images(resolve(img_key)), read_idx_labels(resolve(lbl_key))
+    img_path, lbl_path = resolve(img_key), resolve(lbl_key)
+    if not img_path.endswith(".gz") and not lbl_path.endswith(".gz"):
+        from deeplearning4j_tpu.runtime import native
+
+        if native.available():
+            imgs = native.parse_idx_images(img_path)    # [N, r*c] in [0,1]
+            lbls = native.parse_idx_labels(lbl_path)
+            if imgs is not None and lbls is not None:
+                n = imgs.shape[0]
+                side = int(round((imgs.shape[1]) ** 0.5))
+                imgs_u8 = np.round(imgs * 255.0).astype(np.uint8)
+                return imgs_u8.reshape(n, side, side), lbls.astype(np.uint8)
+    return read_idx_images(img_path), read_idx_labels(lbl_path)
 
 
 def synthetic_mnist(n: int = 2048, seed: int = 0,

@@ -1,0 +1,273 @@
+"""ctypes bindings for the native runtime library (native/dl4j_native.cpp).
+
+The reference's below-JVM layer (ND4J backends, Canova record readers) is
+native code; the TPU build keeps XLA as the compute substrate and owns the
+HOST side natively: record parsing and threaded batch assembly.  pybind11
+is not in this image, so the library exposes a C ABI consumed here via
+ctypes.
+
+The library auto-builds with g++ on first use (`make -C native`); every
+consumer degrades to a pure-Python path when the toolchain or library is
+unavailable, so nothing in the framework hard-requires it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdl4j_tpu_native.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_longp = ctypes.POINTER(ctypes.c_long)
+_u8p = ctypes.POINTER(ctypes.c_ubyte)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # missing toolchain, compile error, ...
+        log.warning("native library build failed (%s); using Python paths", e)
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.dl4j_parse_idx_images.restype = ctypes.c_long
+    lib.dl4j_parse_idx_images.argtypes = [ctypes.c_char_p, _f32p,
+                                          ctypes.c_long]
+    lib.dl4j_idx_image_dims.restype = ctypes.c_long
+    lib.dl4j_idx_image_dims.argtypes = [ctypes.c_char_p, _longp]
+    lib.dl4j_parse_idx_labels.restype = ctypes.c_long
+    lib.dl4j_parse_idx_labels.argtypes = [ctypes.c_char_p, _i32p,
+                                          ctypes.c_long]
+    lib.dl4j_parse_csv.restype = ctypes.c_long
+    lib.dl4j_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                   ctypes.c_long, ctypes.c_long, _f32p,
+                                   ctypes.c_long]
+    lib.dl4j_csv_dims.restype = ctypes.c_long
+    lib.dl4j_csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                  ctypes.c_long, _longp]
+    lib.dl4j_batcher_create.restype = ctypes.c_void_p
+    lib.dl4j_batcher_create.argtypes = [_f32p, _f32p, ctypes.c_long,
+                                        ctypes.c_long, ctypes.c_long,
+                                        ctypes.c_long, ctypes.c_uint64,
+                                        ctypes.c_int, ctypes.c_long]
+    lib.dl4j_batcher_next.restype = ctypes.c_long
+    lib.dl4j_batcher_next.argtypes = [ctypes.c_void_p, _f32p, _f32p]
+    lib.dl4j_batcher_batches_per_epoch.restype = ctypes.c_long
+    lib.dl4j_batcher_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.dl4j_batcher_destroy.restype = None
+    lib.dl4j_batcher_destroy.argtypes = [ctypes.c_void_p]
+    lib.dl4j_diskqueue_create.restype = ctypes.c_void_p
+    lib.dl4j_diskqueue_create.argtypes = [ctypes.c_char_p]
+    lib.dl4j_diskqueue_push.restype = ctypes.c_long
+    lib.dl4j_diskqueue_push.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_long]
+    lib.dl4j_diskqueue_peek_size.restype = ctypes.c_long
+    lib.dl4j_diskqueue_peek_size.argtypes = [ctypes.c_void_p]
+    lib.dl4j_diskqueue_pop.restype = ctypes.c_long
+    lib.dl4j_diskqueue_pop.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_long]
+    lib.dl4j_diskqueue_size.restype = ctypes.c_long
+    lib.dl4j_diskqueue_size.argtypes = [ctypes.c_void_p]
+    lib.dl4j_diskqueue_destroy.restype = None
+    lib.dl4j_diskqueue_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call; None when
+    unavailable (callers must fall back)."""
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+        except OSError as e:
+            log.warning("native library load failed (%s)", e)
+            _lib_failed = True
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# parsing wrappers
+# ---------------------------------------------------------------------------
+
+def parse_idx_images(path: str) -> Optional[np.ndarray]:
+    """float32 [N, rows*cols] in [0,1], or None if native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    dims = (ctypes.c_long * 3)()
+    if lib.dl4j_idx_image_dims(path.encode(), dims) != 0:
+        raise ValueError(f"{path}: not an idx3 image file")
+    n, rows, cols = dims[0], dims[1], dims[2]
+    out = np.empty(n * rows * cols, dtype=np.float32)
+    got = lib.dl4j_parse_idx_images(path.encode(),
+                                    out.ctypes.data_as(_f32p), out.size)
+    if got != n:
+        raise ValueError(f"{path}: idx parse failed (code {got})")
+    return out.reshape(n, rows * cols)
+
+
+def parse_idx_labels(path: str) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = 10_000_000
+    out = np.empty(cap, dtype=np.int32)
+    got = lib.dl4j_parse_idx_labels(path.encode(),
+                                    out.ctypes.data_as(_i32p), cap)
+    if got < 0:
+        raise ValueError(f"{path}: idx label parse failed (code {got})")
+    return out[:got].copy()
+
+
+def parse_csv(path: str, sep: str = ",",
+              skip_header: int = 0) -> Optional[np.ndarray]:
+    """float32 [rows, cols], or None if native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    dims = (ctypes.c_long * 2)()
+    if lib.dl4j_csv_dims(path.encode(), sep.encode()[0:1],
+                         skip_header, dims) != 0:
+        raise ValueError(f"{path}: cannot open")
+    rows, cols = dims[0], dims[1]
+    out = np.empty((max(rows, 1), cols), dtype=np.float32)
+    got = lib.dl4j_parse_csv(path.encode(), sep.encode()[0:1], skip_header,
+                             cols, out.ctypes.data_as(_f32p), rows)
+    if got < 0:
+        raise ValueError(f"{path}: csv parse failed (code {got})")
+    return out[:got]
+
+
+# ---------------------------------------------------------------------------
+# threaded batch assembler
+# ---------------------------------------------------------------------------
+
+class NativeBatcher:
+    """Shuffled minibatch stream assembled by a native producer thread.
+
+    Overlaps host-side batch gather with device compute: ``next()`` usually
+    returns a pre-assembled batch from the ring buffer.  Falls back is the
+    caller's job (see datasets/iterator.py); constructing this with the
+    library unavailable raises RuntimeError.
+    """
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray,
+                 batch_size: int, seed: int = 0, shuffle: bool = True,
+                 capacity: int = 4):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        # keep alive: the native side borrows these buffers
+        self._x = np.ascontiguousarray(features, dtype=np.float32)
+        self._y = np.ascontiguousarray(labels, dtype=np.float32)
+        if self._y.ndim == 1:
+            self._y = self._y[:, None]
+        n, dx = self._x.shape
+        dy = self._y.shape[1]
+        self.batch_size = int(batch_size)
+        self.dx, self.dy = dx, dy
+        self._handle = lib.dl4j_batcher_create(
+            self._x.ctypes.data_as(_f32p), self._y.ctypes.data_as(_f32p),
+            n, dx, dy, self.batch_size, seed, int(shuffle), capacity)
+        if not self._handle:
+            raise RuntimeError("batcher creation failed")
+        self.batches_per_epoch = lib.dl4j_batcher_batches_per_epoch(
+            self._handle)
+
+    def next(self):
+        ox = np.empty((self.batch_size, self.dx), dtype=np.float32)
+        oy = np.empty((self.batch_size, self.dy), dtype=np.float32)
+        rc = self._lib.dl4j_batcher_next(self._handle,
+                                         ox.ctypes.data_as(_f32p),
+                                         oy.ctypes.data_as(_f32p))
+        if rc != 0:
+            raise RuntimeError("batcher stopped")
+        return ox, oy
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.dl4j_batcher_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# disk-backed queue (util/DiskBasedQueue.java parity)
+# ---------------------------------------------------------------------------
+
+class DiskBasedQueue:
+    """FIFO of byte records spilled to a backing file — for streams larger
+    than memory (the reference buffers sentence/work streams this way)."""
+
+    def __init__(self, path: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.dl4j_diskqueue_create(path.encode())
+        if not self._handle:
+            raise RuntimeError(f"cannot create disk queue at {path}")
+
+    def push(self, data: bytes) -> None:
+        buf = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
+        if self._lib.dl4j_diskqueue_push(self._handle, buf, len(data)) != 0:
+            raise IOError("disk queue write failed")
+
+    def pop(self) -> Optional[bytes]:
+        size = self._lib.dl4j_diskqueue_peek_size(self._handle)
+        if size < 0:
+            return None
+        buf = (ctypes.c_ubyte * max(size, 1))()
+        got = self._lib.dl4j_diskqueue_pop(self._handle, buf, max(size, 1))
+        if got < 0:
+            raise IOError(f"disk queue read failed (code {got})")
+        return bytes(buf[:got])
+
+    def __len__(self) -> int:
+        return self._lib.dl4j_diskqueue_size(self._handle)
+
+    def close(self, unlink: bool = True) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dl4j_diskqueue_destroy(self._handle, int(unlink))
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

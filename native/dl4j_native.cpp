@@ -1,0 +1,353 @@
+// Native runtime for deeplearning4j_tpu: host-side data pipeline.
+//
+// The reference's below-JVM layer (ND4J/Canova) is external native code; the
+// TPU build's compute substrate is XLA, so the native layer here owns what
+// actually runs on the host CPU: record parsing (idx/CSV — Canova
+// RecordReader parity) and shuffled batch assembly with a producer thread +
+// bounded ring buffer, so the next host batch is gathered while the device
+// runs the current step.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+// Every function is thread-compatible; the batcher is internally
+// synchronized with a mutex + condvars.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// idx (MNIST) parsing — MnistDbFile/MnistImageFile/MnistLabelFile parity
+// ---------------------------------------------------------------------------
+
+static uint32_t read_be32(FILE* f) {
+  unsigned char b[4];
+  if (fread(b, 1, 4, f) != 4) return 0;
+  return (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+         (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+}
+
+// Parses an idx3-ubyte image file into caller-provided float32 [n*rows*cols],
+// scaled to [0,1].  Returns n on success, -1 on open failure, -2 on bad
+// magic, -3 on short read, -4 if the caller capacity is too small.
+long dl4j_parse_idx_images(const char* path, float* out, long capacity) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t magic = read_be32(f);
+  if (magic != 2051) { fclose(f); return -2; }
+  long n = (long)read_be32(f);
+  long rows = (long)read_be32(f);
+  long cols = (long)read_be32(f);
+  long total = n * rows * cols;
+  if (total > capacity) { fclose(f); return -4; }
+  std::vector<unsigned char> buf(total);
+  if ((long)fread(buf.data(), 1, total, f) != total) { fclose(f); return -3; }
+  fclose(f);
+  const float inv = 1.0f / 255.0f;
+  for (long i = 0; i < total; ++i) out[i] = buf[i] * inv;
+  return n;
+}
+
+// idx3 header only: fills dims[0..2] = {n, rows, cols}; returns 0 or <0.
+long dl4j_idx_image_dims(const char* path, long* dims) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t magic = read_be32(f);
+  if (magic != 2051) { fclose(f); return -2; }
+  dims[0] = (long)read_be32(f);
+  dims[1] = (long)read_be32(f);
+  dims[2] = (long)read_be32(f);
+  fclose(f);
+  return 0;
+}
+
+// idx1-ubyte labels into caller int32 [n].  Returns n or <0 (codes above).
+long dl4j_parse_idx_labels(const char* path, int32_t* out, long capacity) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t magic = read_be32(f);
+  if (magic != 2049) { fclose(f); return -2; }
+  long n = (long)read_be32(f);
+  if (n > capacity) { fclose(f); return -4; }
+  std::vector<unsigned char> buf(n);
+  if ((long)fread(buf.data(), 1, n, f) != n) { fclose(f); return -3; }
+  fclose(f);
+  for (long i = 0; i < n; ++i) out[i] = (int32_t)buf[i];
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// CSV parsing — CSVDataFetcher / Canova CSVRecordReader parity
+// ---------------------------------------------------------------------------
+
+// Parses a numeric CSV (one record per line, `sep`-separated) into
+// caller float32 [max_rows * n_cols].  Skips `skip_header` lines.  Cells
+// that fail to parse become 0.  Returns rows parsed, or -1 (open),
+// -5 (row with wrong column count).
+long dl4j_parse_csv(const char* path, char sep, long skip_header,
+                    long n_cols, float* out, long max_rows) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char line[1 << 16];
+  long row = 0;
+  long lineno = 0;
+  while (fgets(line, sizeof line, f)) {
+    if (lineno++ < skip_header) continue;
+    // skip blank lines
+    char* p = line;
+    while (*p == ' ' || *p == '\t') ++p;
+    if (*p == '\n' || *p == '\r' || *p == '\0') continue;
+    if (row >= max_rows) break;
+    long col = 0;
+    char* tok = p;
+    for (char* c = p;; ++c) {
+      if (*c == sep || *c == '\n' || *c == '\r' || *c == '\0') {
+        char saved = *c;
+        *c = '\0';
+        if (col < n_cols) out[row * n_cols + col] = strtof(tok, nullptr);
+        ++col;
+        if (saved == '\0' || saved == '\n' || saved == '\r') break;
+        tok = c + 1;
+      }
+    }
+    if (col != n_cols) { fclose(f); return -5; }
+    ++row;
+  }
+  fclose(f);
+  return row;
+}
+
+// Counts data rows and columns: dims[0]=rows (after skip_header),
+// dims[1]=cols of the first data row.
+long dl4j_csv_dims(const char* path, char sep, long skip_header, long* dims) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char line[1 << 16];
+  long rows = 0, cols = 0, lineno = 0;
+  while (fgets(line, sizeof line, f)) {
+    if (lineno++ < skip_header) continue;
+    char* p = line;
+    while (*p == ' ' || *p == '\t') ++p;
+    if (*p == '\n' || *p == '\r' || *p == '\0') continue;
+    if (rows == 0) {
+      cols = 1;
+      for (char* c = p; *c && *c != '\n' && *c != '\r'; ++c)
+        if (*c == sep) ++cols;
+    }
+    ++rows;
+  }
+  fclose(f);
+  dims[0] = rows;
+  dims[1] = cols;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Shuffled batch assembler: producer thread + bounded ring buffer
+// ---------------------------------------------------------------------------
+//
+// The reference streams DataSets through iterators on the JVM thread; here
+// batch gather (the memcpy-heavy part) runs on a worker thread so it
+// overlaps device compute.  Epoch order is a Fisher-Yates shuffle seeded
+// per epoch (seed + epoch), matching DataSet.shuffle semantics.
+
+struct Batch {
+  std::vector<float> x;
+  std::vector<float> y;
+};
+
+struct Batcher {
+  const float* x;           // [n, dx] borrowed; caller keeps alive
+  const float* y;           // [n, dy]
+  long n, dx, dy, batch, capacity;
+  uint64_t seed;
+  bool shuffle;
+  long n_batches_per_epoch;
+
+  std::vector<Batch> ring;
+  long head = 0, tail = 0, count = 0;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  void produce() {
+    std::vector<long> order(n);
+    for (long i = 0; i < n; ++i) order[i] = i;
+    uint64_t epoch = 0;
+    while (!stop.load()) {
+      if (shuffle) {
+        std::mt19937_64 rng(seed + epoch);
+        for (long i = n - 1; i > 0; --i) {
+          long j = (long)(rng() % (uint64_t)(i + 1));
+          std::swap(order[i], order[j]);
+        }
+      }
+      for (long b = 0; b < n_batches_per_epoch && !stop.load(); ++b) {
+        Batch batch_data;
+        batch_data.x.resize(batch * dx);
+        batch_data.y.resize(batch * dy);
+        for (long r = 0; r < batch; ++r) {
+          long src = order[(b * batch + r) % n];
+          memcpy(&batch_data.x[r * dx], x + src * dx, dx * sizeof(float));
+          memcpy(&batch_data.y[r * dy], y + src * dy, dy * sizeof(float));
+        }
+        std::unique_lock<std::mutex> lk(mu);
+        not_full.wait(lk, [&] { return count < capacity || stop.load(); });
+        if (stop.load()) return;
+        ring[tail] = std::move(batch_data);
+        tail = (tail + 1) % capacity;
+        ++count;
+        not_empty.notify_one();
+      }
+      ++epoch;
+    }
+  }
+};
+
+// Creates a batcher over borrowed feature/label arrays (float32, row-major).
+// Drops the tail partial batch (BaseDatasetIterator semantics: full batches
+// only when batch divides n; otherwise the last partial batch wraps).
+void* dl4j_batcher_create(const float* x, const float* y, long n, long dx,
+                          long dy, long batch, uint64_t seed, int shuffle,
+                          long capacity) {
+  if (n <= 0 || batch <= 0 || capacity <= 0) return nullptr;
+  Batcher* s = new Batcher();
+  s->x = x;
+  s->y = y;
+  s->n = n;
+  s->dx = dx;
+  s->dy = dy;
+  s->batch = batch;
+  s->capacity = capacity;
+  s->seed = seed;
+  s->shuffle = shuffle != 0;
+  s->n_batches_per_epoch = n / batch > 0 ? n / batch : 1;
+  s->ring.resize(capacity);
+  s->worker = std::thread([s] { s->produce(); });
+  return s;
+}
+
+// Blocking: copies the next batch into out_x [batch*dx] / out_y [batch*dy].
+// Returns 0, or -1 if the batcher was destroyed concurrently.
+long dl4j_batcher_next(void* handle, float* out_x, float* out_y) {
+  Batcher* s = (Batcher*)handle;
+  Batch got;
+  {
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->not_empty.wait(lk, [&] { return s->count > 0 || s->stop.load(); });
+    if (s->stop.load() && s->count == 0) return -1;
+    got = std::move(s->ring[s->head]);
+    s->head = (s->head + 1) % s->capacity;
+    --s->count;
+    s->not_full.notify_one();
+  }
+  memcpy(out_x, got.x.data(), got.x.size() * sizeof(float));
+  memcpy(out_y, got.y.data(), got.y.size() * sizeof(float));
+  return 0;
+}
+
+long dl4j_batcher_batches_per_epoch(void* handle) {
+  return ((Batcher*)handle)->n_batches_per_epoch;
+}
+
+void dl4j_batcher_destroy(void* handle) {
+  Batcher* s = (Batcher*)handle;
+  s->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->not_full.notify_all();
+    s->not_empty.notify_all();
+  }
+  if (s->worker.joinable()) s->worker.join();
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// Disk-backed queue — util/DiskBasedQueue.java parity
+// ---------------------------------------------------------------------------
+//
+// Unbounded FIFO of byte records that spills to a backing file: the
+// reference uses it to buffer sentence/work streams larger than memory.
+// Single-file layout: [u64 len][bytes]... with a read cursor; compaction
+// happens on clear().
+
+struct DiskQueue {
+  FILE* f;
+  long read_pos = 0;
+  long write_pos = 0;
+  long count = 0;
+  std::mutex mu;
+  std::string path;
+};
+
+void* dl4j_diskqueue_create(const char* path) {
+  FILE* f = fopen(path, "wb+");
+  if (!f) return nullptr;
+  DiskQueue* q = new DiskQueue();
+  q->f = f;
+  q->path = path;
+  return q;
+}
+
+long dl4j_diskqueue_push(void* handle, const unsigned char* data, long len) {
+  DiskQueue* q = (DiskQueue*)handle;
+  std::lock_guard<std::mutex> lk(q->mu);
+  fseek(q->f, q->write_pos, SEEK_SET);
+  uint64_t l = (uint64_t)len;
+  if (fwrite(&l, sizeof l, 1, q->f) != 1) return -1;
+  if (len > 0 && (long)fwrite(data, 1, len, q->f) != len) return -1;
+  q->write_pos += sizeof(uint64_t) + len;
+  ++q->count;
+  fflush(q->f);
+  return 0;
+}
+
+// Peeks the size of the next record (so the caller can size its buffer);
+// -1 when empty.
+long dl4j_diskqueue_peek_size(void* handle) {
+  DiskQueue* q = (DiskQueue*)handle;
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->count == 0) return -1;
+  fseek(q->f, q->read_pos, SEEK_SET);
+  uint64_t l = 0;
+  if (fread(&l, sizeof l, 1, q->f) != 1) return -1;
+  return (long)l;
+}
+
+long dl4j_diskqueue_pop(void* handle, unsigned char* out, long capacity) {
+  DiskQueue* q = (DiskQueue*)handle;
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->count == 0) return -1;
+  fseek(q->f, q->read_pos, SEEK_SET);
+  uint64_t l = 0;
+  if (fread(&l, sizeof l, 1, q->f) != 1) return -2;
+  if ((long)l > capacity) return -3;
+  if (l > 0 && fread(out, 1, l, q->f) != l) return -2;
+  q->read_pos += sizeof(uint64_t) + l;
+  --q->count;
+  return (long)l;
+}
+
+long dl4j_diskqueue_size(void* handle) {
+  DiskQueue* q = (DiskQueue*)handle;
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->count;
+}
+
+void dl4j_diskqueue_destroy(void* handle, int unlink_file) {
+  DiskQueue* q = (DiskQueue*)handle;
+  fclose(q->f);
+  if (unlink_file) remove(q->path.c_str());
+  delete q;
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+"""Native runtime (C++ via ctypes): idx/CSV parsing parity with the Python
+readers, threaded batcher invariants, disk-backed queue FIFO semantics.
+
+Skips cleanly when the toolchain can't build the library (the framework
+must work without it)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import mnist
+from deeplearning4j_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def test_idx_parse_matches_python(tmp_path):
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (32, 8, 8), dtype=np.uint8)
+    labels = rng.integers(0, 10, 32).astype(np.uint8)
+    ipath = str(tmp_path / "img.idx3-ubyte")
+    lpath = str(tmp_path / "lab.idx1-ubyte")
+    mnist.write_idx_images(ipath, images)
+    mnist.write_idx_labels(lpath, labels)
+
+    nx = native.parse_idx_images(ipath)
+    ny = native.parse_idx_labels(lpath)
+    px = mnist.read_idx_images(ipath).reshape(32, -1).astype(np.float32) / 255.0
+    np.testing.assert_allclose(nx, px, rtol=1e-6)
+    np.testing.assert_array_equal(ny, labels.astype(np.int32))
+
+
+def test_idx_bad_magic(tmp_path):
+    p = str(tmp_path / "bogus.bin")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        native.parse_idx_images(p)
+
+
+def test_csv_parse_matches_numpy(tmp_path):
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(50, 7)).astype(np.float32)
+    p = str(tmp_path / "d.csv")
+    np.savetxt(p, data, delimiter=",", header="a,b,c,d,e,f,g")
+    out = native.parse_csv(p, skip_header=1)
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_csv_ragged_row_rejected(tmp_path):
+    p = str(tmp_path / "bad.csv")
+    with open(p, "w") as f:
+        f.write("1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        native.parse_csv(p)
+
+
+def test_batcher_covers_epoch_exactly():
+    n, dx, dy, bs = 64, 5, 3, 16
+    x = np.arange(n * dx, dtype=np.float32).reshape(n, dx)
+    y = np.arange(n * dy, dtype=np.float32).reshape(n, dy)
+    b = native.NativeBatcher(x, y, bs, seed=7, shuffle=True)
+    try:
+        assert b.batches_per_epoch == n // bs
+        seen_rows = []
+        for _ in range(b.batches_per_epoch):
+            bx, by = b.next()
+            assert bx.shape == (bs, dx) and by.shape == (bs, dy)
+            # row identity: features and labels must stay aligned
+            rows = (bx[:, 0] / dx).astype(int)
+            np.testing.assert_allclose(by, y[rows], rtol=0, atol=0)
+            seen_rows.extend(rows.tolist())
+        # one epoch = a permutation of all rows
+        assert sorted(seen_rows) == list(range(n))
+    finally:
+        b.close()
+
+
+def test_batcher_epochs_differ_when_shuffled():
+    n, bs = 32, 8
+    x = np.arange(n, dtype=np.float32)[:, None]
+    y = np.zeros((n, 1), np.float32)
+    b = native.NativeBatcher(x, y, bs, seed=3, shuffle=True)
+    try:
+        e1 = [tuple(b.next()[0][:, 0]) for _ in range(b.batches_per_epoch)]
+        e2 = [tuple(b.next()[0][:, 0]) for _ in range(b.batches_per_epoch)]
+        assert e1 != e2
+    finally:
+        b.close()
+
+
+def test_batcher_unshuffled_is_sequential():
+    n, bs = 12, 4
+    x = np.arange(n, dtype=np.float32)[:, None]
+    y = x.copy()
+    b = native.NativeBatcher(x, y, bs, shuffle=False)
+    try:
+        bx, _ = b.next()
+        np.testing.assert_allclose(bx[:, 0], [0, 1, 2, 3])
+    finally:
+        b.close()
+
+
+def test_disk_queue_fifo(tmp_path):
+    q = native.DiskBasedQueue(str(tmp_path / "q.bin"))
+    try:
+        items = [b"alpha", b"", b"x" * 10000, b"last"]
+        for it in items:
+            q.push(it)
+        assert len(q) == 4
+        assert [q.pop() for _ in range(4)] == items
+        assert q.pop() is None
+        q.push(b"again")
+        assert q.pop() == b"again"
+    finally:
+        q.close()
+
+
+def test_native_batch_iterator_end_to_end():
+    from deeplearning4j_tpu.datasets.iterator import NativeBatchIterator
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(40, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 40)]
+    it = NativeBatchIterator(x, y, batch_size=10, seed=1)
+    try:
+        assert it.uses_native
+        n = 0
+        while it.has_next():
+            ds = it.next()
+            assert ds.features.shape == (10, 6)
+            assert ds.labels.shape == (10, 4)
+            n += 1
+        assert n == 4
+        it.reset()
+        assert it.has_next()
+    finally:
+        it.close()
+
+
+def test_native_mnist_load_parity(tmp_path):
+    """load_mnist via the native reader must equal the Python readers."""
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 256, (16, 28, 28), dtype=np.uint8)
+    labels = rng.integers(0, 10, 16).astype(np.uint8)
+    mnist.write_idx_images(str(tmp_path / "train-images-idx3-ubyte"), images)
+    mnist.write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte"), labels)
+    gi, gl = mnist.load_mnist(str(tmp_path), train=True)
+    np.testing.assert_array_equal(gi, images)
+    np.testing.assert_array_equal(gl, labels)
