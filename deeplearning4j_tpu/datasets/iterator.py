@@ -340,15 +340,19 @@ class NativeBatchIterator(DataSetIterator):
         self._seed = seed
         self._shuffle = shuffle
         self._native = None
+        self._closed = False
+        # fallback state is always initialized: next() routes here both
+        # when the library is absent AND after close()
+        self.batches_per_epoch = max(len(self._x) // batch_size, 1)
+        self._epoch = 0
+        self._order = self._make_order()
         try:
             from deeplearning4j_tpu.runtime.native import NativeBatcher
             self._native = NativeBatcher(self._x, self._y, batch_size,
                                          seed=seed, shuffle=shuffle)
             self.batches_per_epoch = self._native.batches_per_epoch
         except (RuntimeError, ImportError):
-            self.batches_per_epoch = max(len(self._x) // batch_size, 1)
-            self._epoch = 0
-            self._order = self._make_order()
+            pass
         self._cursor = 0
 
     def _make_order(self) -> np.ndarray:
@@ -365,11 +369,14 @@ class NativeBatchIterator(DataSetIterator):
         return self._cursor < self.batches_per_epoch
 
     def next(self, num: Optional[int] = None) -> DataSet:
+        if self._closed:
+            raise RuntimeError("NativeBatchIterator is closed")
         if self._native is not None:
             bx, by = self._native.next()
         else:
-            b, n = self.batch_size, len(self._x)
-            idx = [self._order[(self._cursor * b + r) % n] for r in range(b)]
+            b, n = self.batch, len(self._x)
+            idx = self._order[
+                (self._cursor * b + np.arange(b)) % n]
             bx, by = self._x[idx], self._y[idx]
             if self._cursor + 1 >= self.batches_per_epoch:
                 self._epoch += 1
@@ -390,6 +397,7 @@ class NativeBatchIterator(DataSetIterator):
         return self._y.shape[1]
 
     def close(self) -> None:
+        self._closed = True
         if self._native is not None:
             self._native.close()
             self._native = None

@@ -104,13 +104,10 @@ def load_mnist(data_dir: str, train: bool = True
         from deeplearning4j_tpu.runtime import native
 
         if native.available():
-            imgs = native.parse_idx_images(img_path)    # [N, r*c] in [0,1]
+            imgs = native.parse_idx_images_u8(img_path)  # [N, rows, cols]
             lbls = native.parse_idx_labels(lbl_path)
             if imgs is not None and lbls is not None:
-                n = imgs.shape[0]
-                side = int(round((imgs.shape[1]) ** 0.5))
-                imgs_u8 = np.round(imgs * 255.0).astype(np.uint8)
-                return imgs_u8.reshape(n, side, side), lbls.astype(np.uint8)
+                return imgs, lbls.astype(np.uint8)
     return read_idx_images(img_path), read_idx_labels(lbl_path)
 
 

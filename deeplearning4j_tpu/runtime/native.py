@@ -53,8 +53,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dl4j_parse_idx_images.restype = ctypes.c_long
     lib.dl4j_parse_idx_images.argtypes = [ctypes.c_char_p, _f32p,
                                           ctypes.c_long]
+    lib.dl4j_parse_idx_images_u8.restype = ctypes.c_long
+    lib.dl4j_parse_idx_images_u8.argtypes = [ctypes.c_char_p, _u8p,
+                                             ctypes.c_long]
     lib.dl4j_idx_image_dims.restype = ctypes.c_long
     lib.dl4j_idx_image_dims.argtypes = [ctypes.c_char_p, _longp]
+    lib.dl4j_idx_label_count.restype = ctypes.c_long
+    lib.dl4j_idx_label_count.argtypes = [ctypes.c_char_p]
     lib.dl4j_parse_idx_labels.restype = ctypes.c_long
     lib.dl4j_parse_idx_labels.argtypes = [ctypes.c_char_p, _i32p,
                                           ctypes.c_long]
@@ -118,15 +123,19 @@ def available() -> bool:
 # parsing wrappers
 # ---------------------------------------------------------------------------
 
+def _idx_image_dims(lib, path: str):
+    dims = (ctypes.c_long * 3)()
+    if lib.dl4j_idx_image_dims(path.encode(), dims) != 0:
+        raise ValueError(f"{path}: not an idx3 image file")
+    return dims[0], dims[1], dims[2]
+
+
 def parse_idx_images(path: str) -> Optional[np.ndarray]:
     """float32 [N, rows*cols] in [0,1], or None if native is unavailable."""
     lib = get_lib()
     if lib is None:
         return None
-    dims = (ctypes.c_long * 3)()
-    if lib.dl4j_idx_image_dims(path.encode(), dims) != 0:
-        raise ValueError(f"{path}: not an idx3 image file")
-    n, rows, cols = dims[0], dims[1], dims[2]
+    n, rows, cols = _idx_image_dims(lib, path)
     out = np.empty(n * rows * cols, dtype=np.float32)
     got = lib.dl4j_parse_idx_images(path.encode(),
                                     out.ctypes.data_as(_f32p), out.size)
@@ -135,17 +144,33 @@ def parse_idx_images(path: str) -> Optional[np.ndarray]:
     return out.reshape(n, rows * cols)
 
 
+def parse_idx_images_u8(path: str) -> Optional[np.ndarray]:
+    """Raw uint8 [N, rows, cols] — no conversion (cheapest load path)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n, rows, cols = _idx_image_dims(lib, path)
+    out = np.empty(n * rows * cols, dtype=np.uint8)
+    got = lib.dl4j_parse_idx_images_u8(path.encode(),
+                                       out.ctypes.data_as(_u8p), out.size)
+    if got != n:
+        raise ValueError(f"{path}: idx parse failed (code {got})")
+    return out.reshape(n, rows, cols)
+
+
 def parse_idx_labels(path: str) -> Optional[np.ndarray]:
     lib = get_lib()
     if lib is None:
         return None
-    cap = 10_000_000
-    out = np.empty(cap, dtype=np.int32)
+    n = lib.dl4j_idx_label_count(path.encode())
+    if n < 0:
+        raise ValueError(f"{path}: not an idx1 label file (code {n})")
+    out = np.empty(max(n, 1), dtype=np.int32)
     got = lib.dl4j_parse_idx_labels(path.encode(),
-                                    out.ctypes.data_as(_i32p), cap)
+                                    out.ctypes.data_as(_i32p), out.size)
     if got < 0:
         raise ValueError(f"{path}: idx label parse failed (code {got})")
-    return out[:got].copy()
+    return out[:got]
 
 
 def parse_csv(path: str, sep: str = ",",

@@ -35,9 +35,27 @@ static uint32_t read_be32(FILE* f) {
          (uint32_t(b[2]) << 8) | uint32_t(b[3]);
 }
 
-// Parses an idx3-ubyte image file into caller-provided float32 [n*rows*cols],
-// scaled to [0,1].  Returns n on success, -1 on open failure, -2 on bad
-// magic, -3 on short read, -4 if the caller capacity is too small.
+// Parses an idx3-ubyte image file into caller-provided uint8 [n*rows*cols]
+// (raw pixels, no conversion — the cheapest representation; callers scale).
+// Returns n on success, -1 on open failure, -2 on bad magic, -3 on short
+// read, -4 if the caller capacity is too small.
+long dl4j_parse_idx_images_u8(const char* path, unsigned char* out,
+                              long capacity) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t magic = read_be32(f);
+  if (magic != 2051) { fclose(f); return -2; }
+  long n = (long)read_be32(f);
+  long rows = (long)read_be32(f);
+  long cols = (long)read_be32(f);
+  long total = n * rows * cols;
+  if (total > capacity) { fclose(f); return -4; }
+  if ((long)fread(out, 1, total, f) != total) { fclose(f); return -3; }
+  fclose(f);
+  return n;
+}
+
+// As above but into float32 scaled to [0,1] (feature-ready).
 long dl4j_parse_idx_images(const char* path, float* out, long capacity) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
@@ -69,6 +87,17 @@ long dl4j_idx_image_dims(const char* path, long* dims) {
   return 0;
 }
 
+// idx1 header only: returns the label count, or <0.
+long dl4j_idx_label_count(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t magic = read_be32(f);
+  if (magic != 2049) { fclose(f); return -2; }
+  long n = (long)read_be32(f);
+  fclose(f);
+  return n;
+}
+
 // idx1-ubyte labels into caller int32 [n].  Returns n or <0 (codes above).
 long dl4j_parse_idx_labels(const char* path, int32_t* out, long capacity) {
   FILE* f = fopen(path, "rb");
@@ -91,15 +120,19 @@ long dl4j_parse_idx_labels(const char* path, int32_t* out, long capacity) {
 // Parses a numeric CSV (one record per line, `sep`-separated) into
 // caller float32 [max_rows * n_cols].  Skips `skip_header` lines.  Cells
 // that fail to parse become 0.  Returns rows parsed, or -1 (open),
-// -5 (row with wrong column count).
+// -5 (row with wrong column count).  Lines are read with getline(3), so
+// arbitrarily long records parse correctly (a fixed fgets buffer would
+// silently split wide rows).
 long dl4j_parse_csv(const char* path, char sep, long skip_header,
                     long n_cols, float* out, long max_rows) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
-  char line[1 << 16];
+  char* line = nullptr;
+  size_t cap = 0;
   long row = 0;
   long lineno = 0;
-  while (fgets(line, sizeof line, f)) {
+  long rc = 0;
+  while (getline(&line, &cap, f) != -1) {
     if (lineno++ < skip_header) continue;
     // skip blank lines
     char* p = line;
@@ -118,11 +151,12 @@ long dl4j_parse_csv(const char* path, char sep, long skip_header,
         tok = c + 1;
       }
     }
-    if (col != n_cols) { fclose(f); return -5; }
+    if (col != n_cols) { rc = -5; break; }
     ++row;
   }
+  free(line);
   fclose(f);
-  return row;
+  return rc < 0 ? rc : row;
 }
 
 // Counts data rows and columns: dims[0]=rows (after skip_header),
@@ -130,9 +164,10 @@ long dl4j_parse_csv(const char* path, char sep, long skip_header,
 long dl4j_csv_dims(const char* path, char sep, long skip_header, long* dims) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
-  char line[1 << 16];
+  char* line = nullptr;
+  size_t cap = 0;
   long rows = 0, cols = 0, lineno = 0;
-  while (fgets(line, sizeof line, f)) {
+  while (getline(&line, &cap, f) != -1) {
     if (lineno++ < skip_header) continue;
     char* p = line;
     while (*p == ' ' || *p == '\t') ++p;
@@ -144,6 +179,7 @@ long dl4j_csv_dims(const char* path, char sep, long skip_header, long* dims) {
     }
     ++rows;
   }
+  free(line);
   fclose(f);
   dims[0] = rows;
   dims[1] = cols;
@@ -174,8 +210,9 @@ struct Batcher {
 
   std::vector<Batch> ring;
   long head = 0, tail = 0, count = 0;
+  long consumers_inflight = 0;   // next() callers inside the object
   std::mutex mu;
-  std::condition_variable not_full, not_empty;
+  std::condition_variable not_full, not_empty, drained;
   std::atomic<bool> stop{false};
   std::thread worker;
 
@@ -243,12 +280,19 @@ long dl4j_batcher_next(void* handle, float* out_x, float* out_y) {
   Batch got;
   {
     std::unique_lock<std::mutex> lk(s->mu);
+    ++s->consumers_inflight;
     s->not_empty.wait(lk, [&] { return s->count > 0 || s->stop.load(); });
-    if (s->stop.load() && s->count == 0) return -1;
+    if (s->stop.load() && s->count == 0) {
+      --s->consumers_inflight;
+      s->drained.notify_all();
+      return -1;
+    }
     got = std::move(s->ring[s->head]);
     s->head = (s->head + 1) % s->capacity;
     --s->count;
     s->not_full.notify_one();
+    --s->consumers_inflight;
+    s->drained.notify_all();
   }
   memcpy(out_x, got.x.data(), got.x.size() * sizeof(float));
   memcpy(out_y, got.y.data(), got.y.size() * sizeof(float));
@@ -263,9 +307,13 @@ void dl4j_batcher_destroy(void* handle) {
   Batcher* s = (Batcher*)handle;
   s->stop.store(true);
   {
-    std::lock_guard<std::mutex> lk(s->mu);
+    // wake everyone, then wait until no consumer is still inside next()
+    // (deleting while a thread is blocked on our condvar/mutex would be a
+    // use-after-free)
+    std::unique_lock<std::mutex> lk(s->mu);
     s->not_full.notify_all();
     s->not_empty.notify_all();
+    s->drained.wait(lk, [&] { return s->consumers_inflight == 0; });
   }
   if (s->worker.joinable()) s->worker.join();
   delete s;

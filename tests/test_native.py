@@ -149,3 +149,53 @@ def test_native_mnist_load_parity(tmp_path):
     gi, gl = mnist.load_mnist(str(tmp_path), train=True)
     np.testing.assert_array_equal(gi, images)
     np.testing.assert_array_equal(gl, labels)
+
+
+def test_non_square_idx_images(tmp_path):
+    """Native path must honor true rows/cols, not assume square."""
+    rng = np.random.default_rng(4)
+    images = rng.integers(0, 256, (5, 2, 8), dtype=np.uint8)
+    p = str(tmp_path / "ns.idx3-ubyte")
+    mnist.write_idx_images(p, images)
+    got = native.parse_idx_images_u8(p)
+    np.testing.assert_array_equal(got, images)
+
+
+def test_csv_very_long_line(tmp_path):
+    """Rows longer than any fixed stdio buffer must parse as ONE row."""
+    cols = 20000  # ~140KB line, far beyond a 64KB fgets buffer
+    row = np.arange(cols, dtype=np.float32)
+    p = str(tmp_path / "wide.csv")
+    with open(p, "w") as f:
+        f.write(",".join(str(int(v)) for v in row) + "\n")
+        f.write(",".join(str(int(v) + 1) for v in row) + "\n")
+    out = native.parse_csv(p)
+    assert out.shape == (2, cols)
+    np.testing.assert_allclose(out[0], row)
+    np.testing.assert_allclose(out[1], row + 1)
+
+
+def test_batch_iterator_python_fallback(monkeypatch):
+    """The fallback path must work when the native library is absent."""
+    from deeplearning4j_tpu.runtime import native as nat
+    from deeplearning4j_tpu.datasets.iterator import NativeBatchIterator
+
+    class Unavailable:
+        def __init__(self, *a, **k):
+            raise RuntimeError("native library unavailable")
+
+    monkeypatch.setattr(nat, "NativeBatcher", Unavailable)
+    x = np.arange(24, dtype=np.float32)[:, None]
+    y = x * 2
+    it = NativeBatchIterator(x, y, batch_size=6, seed=0)
+    assert not it.uses_native
+    seen = []
+    while it.has_next():
+        ds = it.next()
+        fx = np.asarray(ds.features)[:, 0]
+        np.testing.assert_allclose(np.asarray(ds.labels)[:, 0], fx * 2)
+        seen.extend(fx.tolist())
+    assert sorted(seen) == list(range(24))
+    it.close()
+    with pytest.raises(RuntimeError):
+        it.next()
