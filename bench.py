@@ -73,5 +73,50 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
     }
 
 
+def bench_resnet(batch_size: int = 64, image_size: int = 224,
+                 steps: int = 20, warmup: int = 3):
+    """Secondary benchmark (BASELINE.json configs): ResNet-50 training
+    throughput.  A100 anchor ~2900 img/s/GPU (fp16, MLPerf-era)."""
+    import jax
+    from deeplearning4j_tpu.models import resnet
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        cfg = resnet.resnet_tiny()
+        batch_size, image_size, steps = 8, 32, 3
+    else:
+        cfg = resnet.resnet50()
+
+    mesh = make_mesh(MeshSpec(data=len(jax.devices())),
+                     devices=jax.devices())
+    init_fn, step_fn = resnet.make_train_step(cfg, mesh)
+    state = init_fn(jax.random.key(0))
+    x, y = resnet.synthetic_batch(jax.random.key(1), cfg, batch_size,
+                                  image_size)
+    for _ in range(warmup):
+        state, loss = step_fn(state, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step_fn(state, x, y)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    sps = batch_size * steps / dt / len(jax.devices())
+    return {
+        "metric": f"resnet{'50' if platform != 'cpu' else '_tiny'}"
+                  f"_train_images_per_sec_per_chip_{image_size}px",
+        "value": round(sps, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(sps / 2900.0, 3),
+        "platform": platform,
+        "n_devices": len(jax.devices()),
+        "final_loss": round(final_loss, 4),
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(bench_bert()))
+    import sys
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    print(json.dumps(bench_resnet() if which == "resnet" else bench_bert()))
