@@ -1,0 +1,86 @@
+"""Moving-window featurization for sequence labeling.
+
+Reference parity: ``text/movingwindow/{Window,Windows,WindowConverter,
+WordConverter}.java`` — slide a fixed window over a token sequence, embed
+each window as the concatenation of its word vectors, classify the center
+token, then decode the label sequence with ``utils/viterbi``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+
+PAD = "<PAD>"
+
+
+@dataclasses.dataclass
+class Window:
+    """One window (Window.java parity): tokens with the focus word in the
+    middle, padded at sequence edges."""
+    words: List[str]
+    focus_index: int
+    begin: int
+    end: int
+
+    @property
+    def focus(self) -> str:
+        return self.words[self.focus_index]
+
+
+def windows(tokens_or_text, window_size: int = 5,
+            tokenizer=None) -> List[Window]:
+    """All center-aligned windows over a sentence (Windows.java parity).
+    ``window_size`` must be odd (a center word needs symmetric context)."""
+    if window_size % 2 == 0:
+        raise ValueError(f"window_size must be odd, got {window_size}")
+    if isinstance(tokens_or_text, str):
+        tokenizer = tokenizer or DefaultTokenizerFactory()
+        tokens = tokenizer.create(tokens_or_text)
+    else:
+        tokens = list(tokens_or_text)
+    half = window_size // 2
+    out = []
+    for i in range(len(tokens)):
+        ws = []
+        for j in range(i - half, i + half + 1):
+            ws.append(tokens[j] if 0 <= j < len(tokens) else PAD)
+        out.append(Window(words=ws, focus_index=half,
+                          begin=max(i - half, 0),
+                          end=min(i + half, len(tokens) - 1)))
+    return out
+
+
+class WindowConverter:
+    """Window -> concatenated word-vector features (WindowConverter.java).
+
+    Uses a WordVectors-like object (``word_vector(w)`` + ``dim``); unknown
+    words and PAD map to zeros.
+    """
+
+    def __init__(self, word_vectors):
+        self.wv = word_vectors
+
+    def to_features(self, window: Window) -> np.ndarray:
+        d = self.wv.dim
+        parts = []
+        for w in window.words:
+            vec = None if w == PAD else self.wv.word_vector(w)
+            parts.append(np.zeros(d, np.float32) if vec is None
+                         else np.asarray(vec, np.float32))
+        return np.concatenate(parts)
+
+    def to_matrix(self, wins: Sequence[Window]) -> np.ndarray:
+        return np.stack([self.to_features(w) for w in wins])
+
+
+def sentence_features(text_or_tokens, word_vectors, window_size: int = 5,
+                      tokenizer=None) -> np.ndarray:
+    """[T, window_size*dim] feature matrix for a whole sentence — the input
+    to a per-position classifier whose outputs feed utils/viterbi.decode."""
+    wins = windows(text_or_tokens, window_size, tokenizer)
+    return WindowConverter(word_vectors).to_matrix(wins)

@@ -1,0 +1,265 @@
+"""RNTN — Recursive Neural Tensor Network (Socher 2013) over parse trees.
+
+Reference parity: ``models/rntn/RNTN.java:66`` — per-node tensor
+composition (``forwardPropagateTree:359``), manual tree backprop
+(``backpropDerivativesAndError:574``), AdaGrad updates; trees come from
+PTB-style s-expressions (text/corpora/treeparser).
+
+TPU-native design: the reference recurses host-side per node.  Here a tree
+compiles ONCE to flat arrays (post-order node list with child indices) and
+the whole forward pass is a ``lax.scan`` writing a node-activation buffer —
+so arbitrary tree shapes run as one fixed-shape XLA program, trees batch by
+padding to max_nodes, and the backward pass is ``jax.grad`` of the scan
+(no hand-rolled tree backprop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# trees (Tree.java + treeparser parity, minimal)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Tree:
+    label: int
+    word: Optional[str] = None            # leaves only
+    left: Optional["Tree"] = None
+    right: Optional["Tree"] = None
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.word is not None
+
+    def leaves(self) -> List[str]:
+        if self.is_leaf:
+            return [self.word]
+        return self.left.leaves() + self.right.leaves()
+
+    def size(self) -> int:
+        return 1 if self.is_leaf else 1 + self.left.size() + self.right.size()
+
+
+_TOKEN_RE = re.compile(r"\(|\)|[^\s()]+")
+
+
+def parse_tree(s: str) -> Tree:
+    """PTB-style s-expression: ``(3 (2 nice) (3 movie))`` — label then
+    either a word (leaf) or exactly two subtrees."""
+    tokens = _TOKEN_RE.findall(s)
+    pos = 0
+
+    def parse() -> Tree:
+        nonlocal pos
+        if tokens[pos] != "(":
+            raise ValueError(f"expected '(' at token {pos}")
+        pos += 1
+        label = int(tokens[pos]); pos += 1
+        if tokens[pos] != "(":                       # leaf: (label word)
+            word = tokens[pos]; pos += 1
+            if tokens[pos] != ")":
+                raise ValueError("leaf must close after its word")
+            pos += 1
+            return Tree(label=label, word=word)
+        left = parse()
+        right = parse()
+        if tokens[pos] != ")":
+            raise ValueError("internal node must have exactly 2 children")
+        pos += 1
+        return Tree(label=label, left=left, right=right)
+
+    t = parse()
+    if pos != len(tokens):
+        raise ValueError("trailing tokens after tree")
+    return t
+
+
+def compile_tree(tree: Tree, vocab: Dict[str, int], max_nodes: int
+                 ) -> Dict[str, np.ndarray]:
+    """Post-order flattening: children always precede parents, so a single
+    forward scan over node indices sees resolved child activations."""
+    n = tree.size()
+    if n > max_nodes:
+        raise ValueError(f"tree has {n} nodes > max_nodes={max_nodes}")
+    word = np.zeros(max_nodes, np.int32)
+    left = np.zeros(max_nodes, np.int32)
+    right = np.zeros(max_nodes, np.int32)
+    is_leaf = np.zeros(max_nodes, np.float32)
+    label = np.zeros(max_nodes, np.int32)
+    mask = np.zeros(max_nodes, np.float32)
+    idx = 0
+
+    def walk(t: Tree) -> int:
+        nonlocal idx
+        if t.is_leaf:
+            me = idx; idx += 1
+            word[me] = vocab.get(t.word, 0)
+            is_leaf[me] = 1.0
+        else:
+            l = walk(t.left)
+            r = walk(t.right)
+            me = idx; idx += 1
+            left[me], right[me] = l, r
+        label[me] = t.label
+        mask[me] = 1.0
+        return me
+
+    walk(tree)
+    return {"word": word, "left": left, "right": right, "is_leaf": is_leaf,
+            "label": label, "mask": mask}
+
+
+def build_vocab(trees: Sequence[Tree]) -> Dict[str, int]:
+    vocab: Dict[str, int] = {"<UNK>": 0}
+    for t in trees:
+        for w in t.leaves():
+            vocab.setdefault(w, len(vocab))
+    return vocab
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RNTNConfig:
+    vocab_size: int = 1000
+    dim: int = 25                 # reference default numHidden=25
+    n_classes: int = 5            # sentiment treebank granularity
+    max_nodes: int = 64
+    adagrad_lr: float = 0.01      # reference trains with AdaGrad
+    reg: float = 1e-4
+
+
+def init_params(key: Array, cfg: RNTNConfig) -> PyTree:
+    d, k = cfg.dim, cfg.n_classes
+    ke, kw, kv, ku = jax.random.split(key, 4)
+    return {
+        "embed": jax.random.normal(ke, (cfg.vocab_size, d)) * 0.1,
+        "W": jax.random.normal(kw, (2 * d, d)) * (1.0 / np.sqrt(2 * d)),
+        "b": jnp.zeros((d,)),
+        # the tensor: output dim k gets cᵀ V[k] c
+        "V": jax.random.normal(kv, (d, 2 * d, 2 * d)) * (1.0 / (2 * d)),
+        "U": jax.random.normal(ku, (d, k)) * (1.0 / np.sqrt(d)),
+        "bc": jnp.zeros((k,)),
+    }
+
+
+def _compose(params: PyTree, hl: Array, hr: Array) -> Array:
+    """tanh(Wc + b + cᵀVc) — the tensor composition (RNTN.java:359)."""
+    c = jnp.concatenate([hl, hr])                        # [2d]
+    linear = c @ params["W"] + params["b"]               # [d]
+    tensor = jnp.einsum("i,kij,j->k", c, params["V"], c)
+    return jnp.tanh(linear + tensor)
+
+
+def forward_tree(params: PyTree, tree_arrays: Dict[str, Array]) -> Array:
+    """Node activations H [max_nodes, d] via one scan (children precede
+    parents in the post-order layout, so H is resolved when read)."""
+    d = params["b"].shape[0]
+    max_nodes = tree_arrays["word"].shape[0]
+    H0 = jnp.zeros((max_nodes, d))
+
+    def step(H, inputs):
+        i, word, l, r, leaf = inputs
+        h_leaf = params["embed"][word]
+        h_int = _compose(params, H[l], H[r])
+        h = leaf * h_leaf + (1.0 - leaf) * h_int
+        return H.at[i].set(h), None
+
+    idxs = jnp.arange(max_nodes)
+    H, _ = lax.scan(step, H0, (idxs, tree_arrays["word"],
+                               tree_arrays["left"], tree_arrays["right"],
+                               tree_arrays["is_leaf"]))
+    return H
+
+
+def tree_loss(params: PyTree, tree_arrays: Dict[str, Array],
+              cfg: RNTNConfig) -> Array:
+    """Summed per-node softmax cross-entropy (every node is labeled —
+    RNTN trains sentiment at all constituents), masked over padding."""
+    H = forward_tree(params, tree_arrays)
+    logits = H @ params["U"] + params["bc"]              # [N, K]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tree_arrays["label"][:, None],
+                             axis=-1)[:, 0]
+    mask = tree_arrays["mask"]
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def batch_loss(params: PyTree, batch: Dict[str, Array],
+               cfg: RNTNConfig) -> Array:
+    per_tree = jax.vmap(lambda t: tree_loss(params, t, cfg))(batch)
+    reg = sum(jnp.sum(p ** 2) for name, p in params.items()
+              if name in ("W", "V", "U"))
+    return jnp.mean(per_tree) + cfg.reg * reg
+
+
+def predict_root(params: PyTree, tree_arrays: Dict[str, Array]) -> Array:
+    """Root sentiment: the root is the LAST real node in post-order."""
+    H = forward_tree(params, tree_arrays)
+    root = jnp.sum(tree_arrays["mask"]).astype(jnp.int32) - 1
+    logits = H[root] @ params["U"] + params["bc"]
+    return jnp.argmax(logits)
+
+
+class RNTN:
+    """Trainer facade (RNTN.java API shape): fit(trees), predict(tree)."""
+
+    def __init__(self, cfg: Optional[RNTNConfig] = None,
+                 trees: Optional[Sequence[Tree]] = None, seed: int = 0):
+        trees = list(trees or [])
+        self.vocab = build_vocab(trees) if trees else {"<UNK>": 0}
+        self.cfg = cfg or RNTNConfig(vocab_size=max(len(self.vocab), 2))
+        if self.cfg.vocab_size < len(self.vocab):
+            raise ValueError("vocab_size smaller than actual vocabulary")
+        self.trees = trees
+        self.params = init_params(jax.random.key(seed), self.cfg)
+        self._accum = jax.tree.map(jnp.zeros_like, self.params)  # AdaGrad
+
+        cfg_ = self.cfg
+
+        @jax.jit
+        def step(params, accum, batch):
+            loss, grads = jax.value_and_grad(batch_loss)(params, batch, cfg_)
+            accum = jax.tree.map(lambda a, g: a + g * g, accum, grads)
+            params = jax.tree.map(
+                lambda p, g, a: p - cfg_.adagrad_lr * g /
+                (jnp.sqrt(a) + 1e-8),
+                params, grads, accum)
+            return params, accum, loss
+
+        self._step = step
+
+    def _batch_arrays(self, trees: Sequence[Tree]) -> Dict[str, Array]:
+        compiled = [compile_tree(t, self.vocab, self.cfg.max_nodes)
+                    for t in trees]
+        return {k: jnp.asarray(np.stack([c[k] for c in compiled]))
+                for k in compiled[0]}
+
+    def fit(self, epochs: int = 30,
+            trees: Optional[Sequence[Tree]] = None) -> List[float]:
+        batch = self._batch_arrays(trees or self.trees)
+        losses = []
+        for _ in range(epochs):
+            self.params, self._accum, loss = self._step(
+                self.params, self._accum, batch)
+            losses.append(float(loss))
+        return losses
+
+    def predict(self, tree: Tree) -> int:
+        arrays = {k: jnp.asarray(v) for k, v in
+                  compile_tree(tree, self.vocab, self.cfg.max_nodes).items()}
+        return int(predict_root(self.params, arrays))

@@ -30,18 +30,21 @@ class OutputLayer(Layer):
     def init(self, key: Array) -> Params:
         return P.default_params(key, self.conf)
 
+    def loss_from_logits(self, z: Array, labels: Array) -> Array:
+        """Convex head: loss as a function of PRE-activation logits — the
+        factorization Hessian-free needs (Gauss-Newton requires a convex
+        loss-of-logits; optimize/hessian_free.GNObjective)."""
+        lf = L.LossFunction(self.conf.loss_function)
+        act = self.conf.activation
+        if act == "softmax" and lf in (L.LossFunction.MCXENT,
+                                       L.LossFunction.NEGATIVELOGLIKELIHOOD):
+            return L.softmax_cross_entropy_with_logits(labels, z)
+        if act == "sigmoid" and lf is L.LossFunction.XENT:
+            return L.sigmoid_binary_cross_entropy_with_logits(labels, z)
+        return L.score(labels, lf, self.activation(z))
+
     def loss(self, params: Params, x: Array, labels: Array) -> Array:
         """Score on (input, labels): activation -> LossFunctions.score
         (OutputLayer.java:68-92).  L2 regularization is NOT added here — it
         is applied once, by the updater's GradientAdjustment chain."""
-        lf = L.LossFunction(self.conf.loss_function)
-        act = self.conf.activation
-        z = self.pre_output(params, x)
-        if act == "softmax" and lf in (L.LossFunction.MCXENT,
-                                       L.LossFunction.NEGATIVELOGLIKELIHOOD):
-            base = L.softmax_cross_entropy_with_logits(labels, z)
-        elif act == "sigmoid" and lf is L.LossFunction.XENT:
-            base = L.sigmoid_binary_cross_entropy_with_logits(labels, z)
-        else:
-            base = L.score(labels, lf, self.activation(z))
-        return base
+        return self.loss_from_logits(self.pre_output(params, x), labels)

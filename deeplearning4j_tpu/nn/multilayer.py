@@ -222,9 +222,47 @@ class MultiLayerNetwork:
                     log.debug("pretrain layer %d batch %d done", i, b)
         self.params = params
 
+    # -- Hessian-free (fit:1006-1009 + backPropGradient2:856 parity) -------
+    def fit_hessian_free(self, data: DataSet,
+                         num_iterations: Optional[int] = None) -> None:
+        """Whole-network Hessian-free optimization: Gauss-Newton products
+        through the full stack (the autodiff equivalent of the reference's
+        R-operator backPropGradient2/getBackPropRGradient)."""
+        from deeplearning4j_tpu.optimize.hessian_free import (
+            GNObjective, StochasticHessianFree)
+
+        params = self._require_params()
+        out = self.output_layer
+        last = len(self.layers) - 1
+
+        def logits_fn(p):
+            h = self.hidden_activations(p, data.features)
+            if last in self._in_pre:
+                h = self._in_pre[last](h, None)
+            return out.pre_output(p[last], h)
+
+        obj = GNObjective(
+            logits_fn=logits_fn,
+            loss_from_logits=lambda z: out.loss_from_logits(z, data.labels))
+        hf = StochasticHessianFree(
+            obj,
+            num_iterations=num_iterations
+            or self.conf.confs[-1].num_iterations,
+            listeners=self.listeners)
+        self.params = hf.optimize(params)
+
     # -- finetune (finetune:987 parity) ------------------------------------
     def finetune(self, data: DataSet, seed: int = 1) -> None:
-        """Train ONLY the output layer on last-hidden activations."""
+        """Train ONLY the output layer on last-hidden activations; with
+        HESSIAN_FREE configured, optimize the WHOLE network instead (the
+        reference's finetune does exactly this split, fit:1006-1009)."""
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            OptimizationAlgorithm)
+
+        if (self.conf.confs[-1].optimization_algo
+                is OptimizationAlgorithm.HESSIAN_FREE):
+            self.fit_hessian_free(data)
+            return
         params = self._require_params()
         h = self.hidden_activations(params, data.features)
         # Same boundary transform as loss(): the output layer must train on

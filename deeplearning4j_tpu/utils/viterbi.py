@@ -1,0 +1,75 @@
+"""Viterbi sequence decoding.
+
+Reference parity: ``util/Viterbi.java:31`` — used with the moving-window
+NLP featurization (text/movingwindow) for sequence labeling: per-position
+label probabilities from a classifier + a label-transition matrix.
+
+TPU-native design: the forward pass is a ``lax.scan`` over time with a
+max-product recurrence (log space), the backpointer unwind a second scan —
+one compiled program for any sequence length, batched over leading dims by
+``jax.vmap`` in ``decode_batch``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def decode(emission_logp: Array, transition_logp: Array,
+           prior_logp: Optional[Array] = None) -> Tuple[Array, Array]:
+    """Most likely label path.
+
+    emission_logp [T, K]: per-position log P(label) from the classifier;
+    transition_logp [K, K]: log P(next | prev); prior_logp [K] initial.
+    Returns (path int32 [T], path log-probability scalar).
+    """
+    T, K = emission_logp.shape
+    if prior_logp is None:
+        prior_logp = jnp.zeros((K,)) - jnp.log(K)
+
+    def forward(delta, em_t):
+        # delta [K]: best log-prob ending in each label at t-1
+        scores = delta[:, None] + transition_logp           # [K_prev, K]
+        best_prev = jnp.argmax(scores, axis=0)              # [K]
+        delta_t = jnp.max(scores, axis=0) + em_t
+        return delta_t, best_prev
+
+    delta0 = prior_logp + emission_logp[0]
+    delta_T, backptrs = lax.scan(forward, delta0, emission_logp[1:])
+
+    last = jnp.argmax(delta_T)
+
+    def unwind(state, bp_t):
+        # y_t = label at time t; carry becomes the label at t-1
+        prev = bp_t[state]
+        return prev, state
+
+    first, tail = lax.scan(unwind, last, backptrs, reverse=True)
+    # tail[t-1] = label at time t (t = 1..T-1); the final carry is t=0
+    path = jnp.concatenate([first[None].astype(jnp.int32),
+                            tail.astype(jnp.int32)])
+    return path, jnp.max(delta_T)
+
+
+def decode_batch(emission_logp: Array, transition_logp: Array,
+                 prior_logp: Optional[Array] = None) -> Tuple[Array, Array]:
+    """vmapped decode: emission_logp [B, T, K] -> (paths [B, T], logp [B])."""
+    return jax.vmap(lambda e: decode(e, transition_logp, prior_logp))(
+        emission_logp)
+
+
+def transitions_from_labels(label_seqs, num_labels: int,
+                            smoothing: float = 1.0) -> Array:
+    """Count-based transition log-probs from training label sequences
+    (the reference estimates transitions the same way, Viterbi.java)."""
+    counts = jnp.full((num_labels, num_labels), smoothing)
+    for seq in label_seqs:
+        for a, b in zip(seq[:-1], seq[1:]):
+            counts = counts.at[a, b].add(1.0)
+    return jnp.log(counts / jnp.sum(counts, axis=1, keepdims=True))

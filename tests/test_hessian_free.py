@@ -1,0 +1,105 @@
+"""Hessian-free: Gauss-Newton product correctness against dense jacobians,
+PSD-ness, and end-to-end convergence through MultiLayerNetwork.finetune
+(the reference exercises HF on the curves dataset; Iris serves the same
+role as a small convergence check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import IrisDataFetcher
+from deeplearning4j_tpu.nn.conf import (
+    LayerKind, NeuralNetConfiguration, OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.hessian_free import (
+    GNObjective, StochasticHessianFree, _tdot,
+)
+
+
+def _toy_objective(key):
+    """2-layer MLP, softmax head, as GNObjective over a dict pytree."""
+    k1, k2, kx, ky = jax.random.split(key, 4)
+    params = {"w1": jax.random.normal(k1, (5, 4)) * 0.3,
+              "w2": jax.random.normal(k2, (4, 3)) * 0.3}
+    x = jax.random.normal(kx, (16, 5))
+    labels = jax.nn.one_hot(jax.random.randint(ky, (16,), 0, 3), 3)
+
+    def logits_fn(p):
+        return jnp.tanh(x @ p["w1"]) @ p["w2"]
+
+    def loss_from_logits(z):
+        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(z), axis=-1))
+
+    return GNObjective(logits_fn, loss_from_logits), params
+
+
+def _dense_gn(obj, params):
+    """Explicit G = Jᵀ H J over the flattened parameter vector."""
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+
+    def logits_flat(f):
+        return obj.logits_fn(unravel(f)).ravel()
+
+    J = jax.jacobian(logits_flat)(flat)                    # [L, P]
+    z = obj.logits_fn(params)
+
+    def head_flat(zf):
+        return obj.loss_from_logits(zf.reshape(z.shape))
+
+    H = jax.hessian(head_flat)(z.ravel())                  # [L, L]
+    return J.T @ H @ J
+
+
+def test_gnvp_matches_dense_gauss_newton():
+    obj, params = _toy_objective(jax.random.key(0))
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    G = _dense_gn(obj, params)
+    v = jax.random.normal(jax.random.key(1), flat.shape)
+    gv_auto, _ = jax.flatten_util.ravel_pytree(obj.gnvp(params, unravel(v)))
+    np.testing.assert_allclose(np.asarray(gv_auto), np.asarray(G @ v),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gn_matrix_is_psd_along_random_directions():
+    obj, params = _toy_objective(jax.random.key(2))
+    for i in range(5):
+        v = jax.tree.map(
+            lambda p, i=i: jax.random.normal(jax.random.key(10 + i), p.shape),
+            params)
+        quad = float(_tdot(v, obj.gnvp(params, v)))
+        assert quad >= -1e-6, quad
+
+
+def test_hf_optimizer_reduces_loss():
+    obj, params = _toy_objective(jax.random.key(3))
+    before = float(obj.value(params))
+    hf = StochasticHessianFree(obj, num_iterations=8, max_cg_iters=30)
+    params = hf.optimize(params)
+    after = float(obj.value(params))
+    assert after < before * 0.7, (before, after)
+    # scores are monotone non-increasing by construction (backtracking)
+    assert all(b <= a + 1e-9 for a, b in
+               zip(hf.score_history, hf.score_history[1:]))
+
+
+def test_multilayer_hessian_free_on_iris():
+    f = IrisDataFetcher()
+    f.fetch(150)
+    data = f.next().normalize_zero_mean_unit_variance().shuffle(0)
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).num_iterations(15)
+            .optimization_algo(OptimizationAlgorithm.HESSIAN_FREE)
+            .activation("tanh")
+            .list(2)
+            .hidden_layer_sizes(10)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(False)
+            .build())
+    net = MultiLayerNetwork(conf).init(seed=5)
+    before = net.score(data)
+    net.finetune(data)
+    after = net.score(data)
+    assert after < before * 0.6, (before, after)
+    assert net.evaluate(data).accuracy() > 0.85

@@ -1,0 +1,135 @@
+"""RNTN (tree parsing, scan forward, training) and Viterbi/moving-window
+sequence labeling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import moving_window as mw
+from deeplearning4j_tpu.nlp import rntn
+from deeplearning4j_tpu.utils import viterbi
+
+
+# -- trees ------------------------------------------------------------------
+
+def test_parse_tree_roundtrip_structure():
+    t = rntn.parse_tree("(3 (2 (2 very) (2 nice)) (2 movie))")
+    assert not t.is_leaf and t.label == 3
+    assert t.leaves() == ["very", "nice", "movie"]
+    assert t.size() == 5
+
+
+def test_parse_tree_rejects_malformed():
+    for bad in ["(3 (2 a) (2 b) (2 c))", "(3", "(3 (2 a) (2 b)) junk"]:
+        try:
+            rntn.parse_tree(bad)
+            assert False, f"accepted: {bad}"
+        except (ValueError, IndexError):
+            pass
+
+
+def test_forward_scan_matches_recursion():
+    """The scan over the post-order layout must equal direct recursion."""
+    t = rntn.parse_tree("(1 (0 (0 bad) (1 not)) (1 (1 good) (1 ending)))")
+    vocab = rntn.build_vocab([t])
+    cfg = rntn.RNTNConfig(vocab_size=len(vocab), dim=4, n_classes=2,
+                          max_nodes=16)
+    params = rntn.init_params(jax.random.key(0), cfg)
+
+    def rec(node):
+        if node.is_leaf:
+            return params["embed"][vocab[node.word]]
+        return rntn._compose(params, rec(node.left), rec(node.right))
+
+    arrays = {k: jnp.asarray(v)
+              for k, v in rntn.compile_tree(t, vocab, 16).items()}
+    H = rntn.forward_tree(params, arrays)
+    root_idx = t.size() - 1
+    np.testing.assert_allclose(np.asarray(H[root_idx]),
+                               np.asarray(rec(t)), rtol=1e-5, atol=1e-6)
+
+
+def test_rntn_learns_toy_sentiment():
+    pos = ["(1 (1 good) (1 movie))", "(1 (1 great) (1 film))",
+           "(1 (1 nice) (1 story))", "(1 (1 great) (1 movie))"]
+    neg = ["(0 (0 bad) (0 movie))", "(0 (0 awful) (0 film))",
+           "(0 (0 boring) (0 story))", "(0 (0 bad) (0 ending))"]
+    trees = [rntn.parse_tree(s) for s in pos + neg]
+    model = rntn.RNTN(rntn.RNTNConfig(vocab_size=32, dim=6, n_classes=2,
+                                      max_nodes=8, adagrad_lr=0.1),
+                      trees=trees, seed=1)
+    losses = model.fit(epochs=60)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    correct = sum(model.predict(t) == t.label for t in trees)
+    assert correct >= 7, correct
+
+
+# -- viterbi ----------------------------------------------------------------
+
+def test_viterbi_prefers_transition_consistent_path():
+    # emissions slightly prefer label 1 at t=1, but transitions forbid 0->1
+    em = jnp.log(jnp.asarray([[0.9, 0.1],
+                              [0.4, 0.6],
+                              [0.9, 0.1]]))
+    trans = jnp.log(jnp.asarray([[0.99, 0.01],
+                                 [0.5, 0.5]]))
+    path, logp = viterbi.decode(em, trans)
+    assert path.tolist() == [0, 0, 0]
+    assert float(logp) < 0
+
+
+def test_viterbi_follows_strong_emissions():
+    em = jnp.log(jnp.asarray([[0.99, 0.01],
+                              [0.01, 0.99],
+                              [0.01, 0.99]]))
+    trans = jnp.log(jnp.full((2, 2), 0.5))
+    path, _ = viterbi.decode(em, trans)
+    assert path.tolist() == [0, 1, 1]
+
+
+def test_viterbi_batch_and_transition_estimation():
+    seqs = [[0, 0, 1, 1], [0, 1, 1, 1], [0, 0, 0, 1]]
+    trans = viterbi.transitions_from_labels(seqs, 2, smoothing=0.1)
+    assert trans.shape == (2, 2)
+    # estimated transitions: 1 -> 0 never happens, so it must be unlikely
+    assert float(trans[1, 0]) < float(trans[1, 1])
+    em = jnp.log(jnp.full((2, 4, 2), 0.5))
+    paths, logps = viterbi.decode_batch(em, trans)
+    assert paths.shape == (2, 4) and logps.shape == (2,)
+
+
+# -- moving window ----------------------------------------------------------
+
+class _FakeVectors:
+    dim = 3
+
+    def word_vector(self, w):
+        if w == "unknown":
+            return None
+        return np.full(3, float(len(w)), np.float32)
+
+
+def test_windows_edges_padded():
+    wins = mw.windows("the cat sat", window_size=3)
+    assert len(wins) == 3
+    assert wins[0].words == [mw.PAD, "the", "cat"]
+    assert wins[0].focus == "the"
+    assert wins[2].words == ["cat", "sat", mw.PAD]
+
+
+def test_windows_odd_size_required():
+    try:
+        mw.windows("a b", window_size=4)
+        assert False
+    except ValueError:
+        pass
+
+
+def test_window_features_concatenate_vectors():
+    feats = mw.sentence_features("cat sat unknown", _FakeVectors(),
+                                 window_size=3)
+    assert feats.shape == (3, 9)
+    # first window: [PAD, cat, sat] -> [0,0,0, 3,3,3, 3,3,3]
+    np.testing.assert_allclose(feats[0], [0] * 3 + [3] * 3 + [3] * 3)
+    # unknown word maps to zeros
+    np.testing.assert_allclose(feats[2][3:6], [0, 0, 0])
