@@ -1,0 +1,174 @@
+"""CLI train/test/predict end-to-end, plotting outputs, utils parity."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import cli
+from deeplearning4j_tpu.utils import math_utils as mu
+from deeplearning4j_tpu.utils.strings import Index, StringCluster, StringGrid
+
+
+# -- CLI --------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def iris_csv(tmp_path_factory):
+    from deeplearning4j_tpu.datasets.fetchers import IrisDataFetcher
+    f = IrisDataFetcher()
+    f.fetch(150)
+    ds = f.next()
+    x = np.asarray(ds.features)
+    y = np.argmax(np.asarray(ds.labels), axis=1)
+    p = tmp_path_factory.mktemp("cli") / "iris.csv"
+    np.savetxt(p, np.column_stack([x, y]), delimiter=",", fmt="%.5f")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def conf_json(tmp_path_factory):
+    from deeplearning4j_tpu.nn.conf import (
+        LayerKind, NeuralNetConfiguration)
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).num_iterations(40).use_adagrad(False)
+            .activation("tanh")
+            .list(2)
+            .hidden_layer_sizes(12)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True)
+            .build())
+    p = tmp_path_factory.mktemp("conf") / "net.json"
+    p.write_text(conf.to_json())
+    return str(p)
+
+
+def test_cli_train_test_predict_roundtrip(tmp_path, iris_csv, conf_json,
+                                          capsys):
+    model = str(tmp_path / "model.bin")
+    preds = str(tmp_path / "preds.csv")
+
+    assert cli.main(["train", "--input", iris_csv, "--conf", conf_json,
+                     "--output", model, "--epochs", "30", "--batch", "32",
+                     "--log-every", "1000"]) == 0
+    assert os.path.exists(model)
+    out = capsys.readouterr().out
+    assert "train accuracy" in out
+
+    assert cli.main(["test", "--input", iris_csv, "--model", model]) == 0
+    stats = capsys.readouterr().out
+    assert "Accuracy" in stats or "accuracy" in stats
+
+    assert cli.main(["predict", "--input", iris_csv, "--model", model,
+                     "--output", preds]) == 0
+    got = np.loadtxt(preds)
+    assert got.shape == (150,)
+    assert set(np.unique(got)).issubset({0.0, 1.0, 2.0})
+
+
+def test_cli_rejects_unknown_command():
+    with pytest.raises(SystemExit):
+        cli.main(["bogus"])
+
+
+# -- plotting ---------------------------------------------------------------
+
+def test_plotter_outputs(tmp_path):
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.plot.plotter import (
+        FilterRenderer, NeuralNetPlotter, render_embedding_html,
+        render_scalars_html)
+    import jax.numpy as jnp
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(16).activation("tanh")
+            .list(2).hidden_layer_sizes(8)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    net = MultiLayerNetwork(conf).init()
+
+    p1 = NeuralNetPlotter().plot_network_gradient(
+        net, str(tmp_path / "weights.png"))
+    assert os.path.getsize(p1) > 0
+
+    p2 = NeuralNetPlotter().plot_activations(
+        net, jnp.ones((8, 16)), str(tmp_path / "acts.png"))
+    assert os.path.getsize(p2) > 0
+
+    w = np.random.default_rng(0).normal(size=(16, 9)).astype(np.float32)
+    p3 = FilterRenderer().render_filters(w, str(tmp_path / "filters.png"))
+    assert os.path.getsize(p3) > 0
+
+    p4 = render_embedding_html(["cat", "dog"], [[0.0, 1.0], [1.0, 0.0]],
+                               str(tmp_path / "emb.html"))
+    html = open(p4).read()
+    assert "cat" in html and "svg" in html
+
+    from deeplearning4j_tpu.runtime.metrics import ScalarsLogger
+    sl = ScalarsLogger(str(tmp_path / "scalars.jsonl"))
+    for i in range(5):
+        sl.log(i, loss=1.0 / (i + 1))
+    sl.close()
+    p5 = render_scalars_html(str(tmp_path / "scalars.jsonl"),
+                             str(tmp_path / "scalars.png"))
+    assert os.path.getsize(p5) > 0
+
+
+def test_filter_renderer_conv_kernels(tmp_path):
+    from deeplearning4j_tpu.plot.plotter import FilterRenderer
+    w = np.random.default_rng(1).normal(size=(5, 5, 1, 12))
+    p = FilterRenderer().render_filters(w, str(tmp_path / "conv.png"))
+    assert os.path.getsize(p) > 0
+
+
+# -- utils ------------------------------------------------------------------
+
+def test_math_utils():
+    assert abs(mu.entropy([0.5, 0.5]) - np.log(2)) < 1e-12
+    assert mu.entropy([1.0]) == 0.0
+    assert mu.information_gain([0.5, 0.5], [[1.0], [1.0]], [0.5, 0.5]) > 0
+    assert mu.euclidean_distance([0, 0], [3, 4]) == 5.0
+    assert mu.manhattan_distance([0, 0], [3, 4]) == 7.0
+    assert abs(mu.cosine_similarity([1, 0], [1, 0]) - 1.0) < 1e-12
+    assert abs(mu.correlation([1, 2, 3], [2, 4, 6]) - 1.0) < 1e-9
+    np.testing.assert_allclose(mu.normalize([0, 5, 10]), [0, 0.5, 1])
+    assert mu.next_power_of_2(17) == 32
+    assert mu.next_power_of_2(16) == 16
+    assert mu.round_to_nearest(7.3, 0.5) == 7.5
+    s = mu.SummaryStatistics.of([1, 2, 3, 4])
+    assert s.mean == 2.5 and s.n == 4 and s.min == 1 and s.max == 4
+    assert "mean=2.5" in str(s)
+
+
+def test_index_bidirectional():
+    idx = Index()
+    assert idx.add("cat") == 0
+    assert idx.add("dog") == 1
+    assert idx.add("cat") == 0
+    assert idx.index_of("dog") == 1
+    assert idx.index_of("bird") == -1
+    assert idx.get(0) == "cat"
+    assert len(idx) == 2 and "cat" in idx
+
+
+def test_string_cluster_fingerprint_dedup():
+    rows = ["John  Smith", "smith, john", "John Smith", "John Smith",
+            "Alice Wu"]
+    c = StringCluster(rows)
+    dups = c.duplicates()
+    assert len(dups) == 1 and len(dups[0]) == 4
+    assert c.canonical("smith, john") == "John Smith"
+
+
+def test_string_grid():
+    grid = StringGrid.from_lines(["a,John Smith,1", "b,smith  JOHN,2",
+                                  "c,Alice,3"])
+    assert grid.num_rows() == 3 and grid.num_columns() == 3
+    deduped = grid.dedup_column(1)
+    assert deduped.num_rows() == 2
+    filtered = grid.filter_rows_by_column(0, {"a", "c"})
+    assert [r[0] for r in filtered.rows] == ["a", "c"]
+    assert grid.to_lines()[2] == "c,Alice,3"
