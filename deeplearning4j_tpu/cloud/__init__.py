@@ -1,0 +1,18 @@
+"""Cluster provisioning, config registry, and artifact movement.
+
+Reference parity for the ops/infra modules (SURVEY.md §2.7):
+``deeplearning4j-aws`` (EC2 provisioning + S3 IO) and
+``deeplearning4j-scaleout-zookeeper`` (config distribution) — re-targeted
+at TPU infrastructure: provisioning generates TPU-VM/pod bring-up scripts
+(gcloud), config distribution is a file/JSON registry every host can
+mount, artifacts move through a pluggable store.
+"""
+
+from deeplearning4j_tpu.cloud.provision import (  # noqa: F401
+    TpuPodSpec, render_create_script, render_launch_script,
+    render_teardown_script,
+)
+from deeplearning4j_tpu.cloud.registry import ConfigRegistry  # noqa: F401
+from deeplearning4j_tpu.cloud.artifacts import (  # noqa: F401
+    ArtifactStore, LocalArtifactStore,
+)

@@ -1,0 +1,111 @@
+"""Artifact movement: models, datasets, word vectors.
+
+Reference parity: ``deeplearning4j-aws/s3/{reader,uploader,modelsaver}``
+(S3Downloader/S3Uploader/S3ModelSaver) and the HDFS model saver.  One SPI,
+a local-filesystem implementation (shared storage is how TPU pods move
+artifacts), and a ``RemoteModelSaver`` that plugs the store into the
+runtime's ModelSaver contract.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Iterator, List, Optional
+
+
+class ArtifactStore:
+    """put/get/list/delete over opaque byte blobs, keyed by path."""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def put_file(self, key: str, path: str) -> None:
+        with open(path, "rb") as fh:
+            self.put(key, fh.read())
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def get_to_file(self, key: str, path: str) -> str:
+        data = self.get(key)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        return path
+
+    def list(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        return key in self.list()
+
+
+class LocalArtifactStore(ArtifactStore):
+    """Directory-backed store (S3 bucket ≙ root dir, key ≙ relative path)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+        if not parts:
+            raise ValueError(f"bad key: {key!r}")
+        return os.path.join(self.root, *parts)
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> bytes:
+        path = self._path(key)
+        if not os.path.exists(path):
+            raise KeyError(key)
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def list(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                if f.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, f), self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(prefix) or not prefix:
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        path = self._path(key)
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+class RemoteModelSaver:
+    """S3ModelSaver/HdfsModelSaver parity: persist a MultiLayerNetwork (or
+    any to_bytes() model) into an ArtifactStore, rotating the previous blob
+    to a timestamped key (DefaultModelSaver's rolling behavior)."""
+
+    def __init__(self, store: ArtifactStore, key: str):
+        self.store = store
+        self.key = key
+        self._generation = 0
+
+    def save(self, net) -> None:
+        if self.key in self.store.list():
+            self._generation += 1
+            self.store.put(f"{self.key}.{self._generation}",
+                           self.store.get(self.key))
+        self.store.put(self.key, net.to_bytes())
+
+    def load_bytes(self) -> bytes:
+        return self.store.get(self.key)

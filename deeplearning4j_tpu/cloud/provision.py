@@ -1,0 +1,113 @@
+"""TPU cluster provisioning script generation.
+
+Reference parity: ``deeplearning4j-aws/ec2/Ec2BoxCreator.java`` +
+``ec2/provision/{ClusterSetup,HostProvisioner,
+DistributedDeepLearningTrainer}.java`` — which spin up EC2 boxes over the
+AWS SDK and push the Akka runtime onto them over jsch/ssh.
+
+The TPU equivalent is declarative: a pod spec renders to gcloud scripts the
+operator runs (zero-egress build: we GENERATE the commands, we never call
+the cloud).  The launch script starts the SAME training entry point on
+every host with ``jax.distributed`` coordinator wiring
+(parallel/mesh.initialize_distributed), which replaces the reference's
+master-URL cluster join.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuPodSpec:
+    """What the reference's ClusterSetup took as worker count/AMI, as a TPU
+    pod: accelerator type encodes chips, hosts derive from topology."""
+
+    name: str = "dl4j-tpu"
+    accelerator_type: str = "v5litepod-8"      # e.g. v5litepod-64 for pods
+    zone: str = "us-central1-a"
+    runtime_version: str = "v2-alpha-tpuv5-lite"
+    project: Optional[str] = None
+    network: Optional[str] = None
+    preemptible: bool = False
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def n_hosts(self) -> int:
+        """v5e packs 8 chips/host: v5litepod-N => max(N//8, 1) hosts."""
+        try:
+            chips = int(self.accelerator_type.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return 1
+        return max(chips // 8, 1)
+
+
+def render_create_script(spec: TpuPodSpec) -> str:
+    """gcloud bring-up (Ec2BoxCreator.create equivalent)."""
+    args = [
+        "gcloud", "compute", "tpus", "tpu-vm", "create", spec.name,
+        f"--zone={spec.zone}",
+        f"--accelerator-type={spec.accelerator_type}",
+        f"--version={spec.runtime_version}",
+    ]
+    if spec.project:
+        args.append(f"--project={spec.project}")
+    if spec.network:
+        args.append(f"--network={spec.network}")
+    if spec.preemptible:
+        args.append("--preemptible")
+    return "#!/usr/bin/env bash\nset -euo pipefail\n" + \
+        " ".join(shlex.quote(a) for a in args) + "\n"
+
+
+def render_launch_script(spec: TpuPodSpec, train_cmd: str,
+                         coordinator_port: int = 8476) -> str:
+    """Run ``train_cmd`` on EVERY host (HostProvisioner/
+    DistributedDeepLearningTrainer equivalent).  gcloud's --worker=all is
+    the jsch loop; JAX process wiring comes from env vars consumed by
+    parallel/mesh.initialize_distributed."""
+    env = dict(spec.env)
+    env.setdefault("DL4J_TPU_COORDINATOR_PORT", str(coordinator_port))
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    inner = f"{exports} {train_cmd}".strip()
+    args = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", spec.name,
+        f"--zone={spec.zone}", "--worker=all",
+        f"--command={inner}",
+    ]
+    if spec.project:
+        args.insert(6, f"--project={spec.project}")
+    return ("#!/usr/bin/env bash\nset -euo pipefail\n"
+            f"# {spec.n_hosts} host(s), {spec.accelerator_type}\n"
+            + " ".join(shlex.quote(a) for a in args) + "\n")
+
+
+def render_teardown_script(spec: TpuPodSpec) -> str:
+    args = ["gcloud", "compute", "tpus", "tpu-vm", "delete", spec.name,
+            f"--zone={spec.zone}", "--quiet"]
+    if spec.project:
+        args.append(f"--project={spec.project}")
+    return "#!/usr/bin/env bash\nset -euo pipefail\n" + \
+        " ".join(shlex.quote(a) for a in args) + "\n"
+
+
+def write_cluster_scripts(spec: TpuPodSpec, train_cmd: str,
+                          directory: str) -> List[str]:
+    """ClusterSetup equivalent: create/launch/teardown scripts on disk."""
+    import os
+    import stat
+
+    os.makedirs(directory, exist_ok=True)
+    out = []
+    for name, content in [
+            ("create.sh", render_create_script(spec)),
+            ("launch.sh", render_launch_script(spec, train_cmd)),
+            ("teardown.sh", render_teardown_script(spec))]:
+        path = os.path.join(directory, name)
+        with open(path, "w") as fh:
+            fh.write(content)
+        os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
+        out.append(path)
+    return out

@@ -1,0 +1,98 @@
+"""Cloud/infra parity: provisioning script generation, config registry,
+artifact store + rolling remote model saver."""
+
+import os
+import subprocess
+
+import pytest
+
+from deeplearning4j_tpu.cloud import (
+    ConfigRegistry, LocalArtifactStore, TpuPodSpec,
+    render_create_script, render_launch_script, render_teardown_script,
+)
+from deeplearning4j_tpu.cloud.artifacts import RemoteModelSaver
+from deeplearning4j_tpu.cloud.provision import write_cluster_scripts
+
+
+def test_pod_spec_host_math():
+    assert TpuPodSpec(accelerator_type="v5litepod-8").n_hosts == 1
+    assert TpuPodSpec(accelerator_type="v5litepod-64").n_hosts == 8
+    assert TpuPodSpec(accelerator_type="weird").n_hosts == 1
+
+
+def test_scripts_render_and_are_shell_clean(tmp_path):
+    spec = TpuPodSpec(name="mypod", accelerator_type="v5litepod-16",
+                      zone="us-east5-b", project="proj",
+                      env={"BATCH": "128"})
+    create = render_create_script(spec)
+    launch = render_launch_script(spec, "python -m train --epochs 3")
+    down = render_teardown_script(spec)
+    assert "tpu-vm create mypod" in create.replace("'", "")
+    assert "--worker=all" in launch
+    assert "BATCH=128" in launch
+    assert "delete" in down
+    # bash -n: syntax check only, runs nothing
+    for script in (create, launch, down):
+        p = tmp_path / "s.sh"
+        p.write_text(script)
+        subprocess.run(["bash", "-n", str(p)], check=True)
+
+
+def test_write_cluster_scripts_executable(tmp_path):
+    paths = write_cluster_scripts(TpuPodSpec(), "python train.py",
+                                  str(tmp_path / "cluster"))
+    assert len(paths) == 3
+    for p in paths:
+        assert os.access(p, os.X_OK)
+
+
+def test_config_registry_roundtrip(tmp_path):
+    reg = ConfigRegistry(str(tmp_path / "reg"))
+    conf = {"lr": 0.1, "layers": [4, 3]}
+    reg.register("jobs/run1/conf", conf)
+    assert reg.retrieve("jobs/run1/conf") == conf
+    assert reg.exists("jobs/run1/conf")
+    assert reg.keys() == ["jobs/run1/conf"]
+    reg.register("jobs/run2/conf", {"lr": 0.2})
+    assert reg.keys("jobs") == ["jobs/run1/conf", "jobs/run2/conf"]
+    reg.delete("jobs/run1/conf")
+    assert not reg.exists("jobs/run1/conf")
+    with pytest.raises(KeyError):
+        reg.retrieve("jobs/run1/conf")
+
+
+def test_config_registry_rejects_traversal(tmp_path):
+    reg = ConfigRegistry(str(tmp_path / "reg"))
+    reg.register("../escape", {"x": 1})      # sanitized, stays inside root
+    assert reg.keys() == ["escape"]
+    with pytest.raises(ValueError):
+        reg.register("", {})
+
+
+def test_artifact_store_and_model_saver(tmp_path):
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    store.put("models/a.bin", b"v1")
+    assert store.get("models/a.bin") == b"v1"
+    assert store.list() == ["models/a.bin"]
+    assert store.list("models/") == ["models/a.bin"]
+
+    class FakeNet:
+        def __init__(self, blob):
+            self.blob = blob
+
+        def to_bytes(self):
+            return self.blob
+
+    saver = RemoteModelSaver(store, "models/net.bin")
+    saver.save(FakeNet(b"gen0"))
+    saver.save(FakeNet(b"gen1"))
+    saver.save(FakeNet(b"gen2"))
+    assert saver.load_bytes() == b"gen2"
+    # rolling history kept (DefaultModelSaver timestamp-rotation parity)
+    assert store.get("models/net.bin.1") == b"gen0"
+    assert store.get("models/net.bin.2") == b"gen1"
+
+    store.delete("models/a.bin")
+    assert "models/a.bin" not in store.list()
+    with pytest.raises(KeyError):
+        store.get("models/a.bin")
