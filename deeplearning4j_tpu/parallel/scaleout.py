@@ -1,0 +1,359 @@
+"""Transport-agnostic scaleout SPI + in-process distributed runner.
+
+Reference parity, three pieces:
+
+1. The SPI of ``deeplearning4j-scaleout-api`` (SURVEY.md §2.2): ``Job`` /
+   ``JobIterator`` (scaleout/job/JobIterator.java:24), ``WorkerPerformer``
+   (scaleout/perform/WorkerPerformer.java:27), ``JobAggregator`` +
+   ``WorkAccumulator`` (scaleout/aggregator/), ``UpdateSaver`` /
+   ``WorkRetriever`` (param blobs / per-worker datasets stored off-tracker),
+   ``WorkRouter`` policies (IterativeReduce = synchronous rounds, HogWild =
+   always-send async), ``Updateable``.
+
+2. ``DistributedRunner`` — the in-process equivalent of the Akka topology
+   (DeepLearning4jDistributed.setup:205 + MasterActor/WorkerActor/
+   BatchActor): a master pump thread and N worker threads polling the
+   StateTracker, exactly the reference's steady-state loop (§3.2), minus
+   the network.  This is ALSO the test-support pattern (§4
+   BaseTestDistributed: boot the real runtime in one process with a
+   pluggable performer).
+
+3. ``IRUnitDriver`` — the YARN IterativeReduce simulation
+   (runtime/irunit/IRUnitDriver.java): ComputableMaster + N
+   ComputableWorkers in BSP supersteps, no cluster.
+
+The DATA plane for real training remains XLA collectives
+(parallel/data_parallel.py); this module is the CONTROL plane and the
+orchestration-testing harness.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.parallel.coordinator import Job, StateTracker
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# SPI (§2.2)
+# ---------------------------------------------------------------------------
+
+class JobIterator:
+    """next(worker_id)/has_next/reset (JobIterator.java:24)."""
+
+    def next(self, worker_id: str) -> Job:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class CollectionJobIterator(JobIterator):
+    def __init__(self, items: Sequence[Any]):
+        self.items = list(items)
+        self._i = 0
+
+    def next(self, worker_id: str) -> Job:
+        job = Job(work=self.items[self._i], worker_id=worker_id)
+        self._i += 1
+        return job
+
+    def has_next(self) -> bool:
+        return self._i < len(self.items)
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class WorkerPerformer:
+    """perform(job) mutates job.result; update(*) absorbs new global state
+    (WorkerPerformer.java:27)."""
+
+    def perform(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def update(self, *args: Any) -> None:
+        pass
+
+
+class JobAggregator:
+    """accumulate/aggregate (JobAggregator.java:30)."""
+
+    def accumulate(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def aggregate(self) -> Any:
+        raise NotImplementedError
+
+
+class WorkAccumulator(JobAggregator):
+    """Running average of numeric results (WorkAccumulator.java:29)."""
+
+    def __init__(self):
+        self._avg = None
+        self._n = 0
+
+    def accumulate(self, job: Job) -> None:
+        import jax
+
+        if job.result is None:
+            return
+        self._n += 1
+        if self._avg is None:
+            self._avg = job.result
+        else:
+            n = self._n
+            self._avg = jax.tree.map(
+                lambda a, r: a + (r - a) / n, self._avg, job.result)
+
+    def aggregate(self) -> Any:
+        return self._avg
+
+
+class UpdateSaver:
+    """Param blobs stored OFF the tracker (UpdateSaver.java:28) — the
+    tracker holds ids, the saver holds bytes."""
+
+    def __init__(self):
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def save(self, worker_id: str, value: Any) -> None:
+        with self._lock:
+            self._store[worker_id] = pickle.dumps(value)
+
+    def load(self, worker_id: str) -> Any:
+        with self._lock:
+            blob = self._store.pop(worker_id, None)
+        return None if blob is None else pickle.loads(blob)
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return list(self._store)
+
+
+class WorkRetriever:
+    """Per-worker dataset storage (WorkRetriever.java:33)."""
+
+    def __init__(self):
+        self._store: Dict[str, List[Any]] = {}
+        self._lock = threading.Lock()
+
+    def save(self, worker_id: str, data: Any) -> None:
+        with self._lock:
+            self._store.setdefault(worker_id, []).append(data)
+
+    def load(self, worker_id: str) -> Optional[Any]:
+        with self._lock:
+            queue = self._store.get(worker_id)
+            return queue.pop(0) if queue else None
+
+
+class Updateable:
+    """Typed update envelope (api/ir/Updateable.java:26)."""
+
+    def get(self) -> Any:
+        raise NotImplementedError
+
+    def set(self, value: Any) -> None:
+        raise NotImplementedError
+
+    def to_bytes(self) -> bytes:
+        return pickle.dumps(self.get())
+
+    def from_bytes(self, blob: bytes) -> None:
+        self.set(pickle.loads(blob))
+
+
+class ParameterVectorUpdateable(Updateable):
+    """Array-pytree payload (ParameterVectorUpdateable.java:34)."""
+
+    def __init__(self, value: Any = None):
+        self._value = value
+
+    def get(self) -> Any:
+        return self._value
+
+    def set(self, value: Any) -> None:
+        self._value = value
+
+
+class WorkRouter:
+    """When should the master push more work / re-replicate?
+    (api/workrouter/WorkRouter.java:29)"""
+
+    def __init__(self, tracker: StateTracker):
+        self.tracker = tracker
+
+    def send_work(self) -> bool:
+        raise NotImplementedError
+
+
+class IterativeReduceWorkRouter(WorkRouter):
+    """Synchronous rounds: only send new work when every outstanding job
+    reported back (IterativeReduceWorkRouter.java:32)."""
+
+    def send_work(self) -> bool:
+        return not self.tracker.has_pending()
+
+
+class HogWildWorkRouter(WorkRouter):
+    """Always send — async lock-free (HogWildWorkRouter.java:30)."""
+
+    def send_work(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# In-process distributed runner (§2.3 topology, §3.2 steady-state loop)
+# ---------------------------------------------------------------------------
+
+class DistributedRunner:
+    """Master pump + N worker threads over a shared StateTracker.
+
+    The reference flow (§3.2): BatchActor feeds jobs from the JobIterator;
+    workers poll ``job_for``, replicate current params if flagged, run the
+    performer, post results via ``add_update``; the master aggregates a
+    round's updates, sets the new current value, and flags re-replication.
+    """
+
+    def __init__(self, job_iterator: JobIterator,
+                 performer_factory: Callable[[], WorkerPerformer],
+                 aggregator: JobAggregator,
+                 n_workers: int = 2,
+                 router_cls=IterativeReduceWorkRouter,
+                 poll_interval_s: float = 0.005):
+        self.tracker = StateTracker()
+        self.update_saver = UpdateSaver()
+        self.jobs = job_iterator
+        self.performer_factory = performer_factory
+        self.aggregator = aggregator
+        self.router = router_cls(self.tracker)
+        self.n_workers = n_workers
+        self.poll = poll_interval_s
+        self._stop = threading.Event()
+
+    # -- worker loop (WorkerActor.checkJobAvailable:287 parity) ------------
+    def _worker_loop(self, worker_id: str) -> None:
+        performer = self.performer_factory()
+        self.tracker.add_worker(worker_id)
+        while not self._stop.is_set():
+            self.tracker.heartbeat(worker_id)
+            job = self.tracker.job_for(worker_id)
+            if job is None:
+                time.sleep(self.poll)
+                continue
+            if self.tracker.needs_replicate(worker_id):
+                current = self.tracker.get_current()
+                if current is not None:
+                    performer.update(current)
+                self.tracker.done_replicating(worker_id)
+            performer.perform(job)
+            self.update_saver.save(worker_id, job.result)
+            self.tracker.add_update(worker_id, job)
+            self.tracker.clear_job(worker_id)
+            self.tracker.increment("jobs_done")
+
+    # -- master loop (MasterActor 1s pump :104-137 parity) -----------------
+    def run(self, timeout_s: float = 60.0) -> Any:
+        workers = [threading.Thread(target=self._worker_loop,
+                                    args=(f"worker-{i}",), daemon=True)
+                   for i in range(self.n_workers)]
+        for w in workers:
+            w.start()
+
+        deadline = time.time() + timeout_s
+        try:
+            while time.time() < deadline:
+                if self.jobs.has_next():
+                    if self.router.send_work():
+                        # a "round" = up to one job per worker; the
+                        # IterativeReduce router waits for the round to
+                        # drain, HogWild pushes unconditionally
+                        for _ in range(self.n_workers):
+                            if not self.jobs.has_next():
+                                break
+                            self.tracker.add_job(self.jobs.next(""))
+                elif not self.tracker.has_pending():
+                    break
+                # DoneMessage path: fold a completed round into the state
+                for job in self.tracker.drain_updates():
+                    self.aggregator.accumulate(job)
+                agg = self.aggregator.aggregate()
+                if agg is not None:
+                    self.tracker.set_current(agg)
+                time.sleep(self.poll)
+            else:
+                raise TimeoutError("distributed run did not finish")
+            for job in self.tracker.drain_updates():
+                self.aggregator.accumulate(job)
+            agg = self.aggregator.aggregate()
+            if agg is not None:
+                self.tracker.set_current(agg)
+            return self.tracker.get_current()
+        finally:
+            self._stop.set()
+            for w in workers:
+                w.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# IRUnit: YARN IterativeReduce simulation (§2.5)
+# ---------------------------------------------------------------------------
+
+class ComputableMaster:
+    """compute(worker_updates, previous) -> new global (ComputableMaster
+    .java:30)."""
+
+    def compute(self, worker_updates: List[Updateable],
+                previous: Optional[Updateable]) -> Updateable:
+        raise NotImplementedError
+
+    def complete(self) -> Any:
+        return None
+
+
+class ComputableWorker:
+    """compute(records) -> Updateable; update(master) absorbs the round
+    result (ComputableWorker.java:25)."""
+
+    def compute(self, records: Any) -> Updateable:
+        raise NotImplementedError
+
+    def update(self, master_update: Updateable) -> None:
+        pass
+
+
+class IRUnitDriver:
+    """Master + N workers in one process, BSP supersteps over data splits
+    (IRUnitDriver.java parity: the 'IRUnit' test pattern — no cluster)."""
+
+    def __init__(self, master: ComputableMaster,
+                 workers: Sequence[ComputableWorker],
+                 splits: Sequence[Any], iterations: int = 1):
+        if len(workers) != len(splits):
+            raise ValueError(f"{len(workers)} workers for "
+                             f"{len(splits)} splits")
+        self.master = master
+        self.workers = list(workers)
+        self.splits = list(splits)
+        self.iterations = iterations
+
+    def run(self) -> Any:
+        previous: Optional[Updateable] = None
+        for _ in range(self.iterations):
+            updates = [w.compute(split)
+                       for w, split in zip(self.workers, self.splits)]
+            previous = self.master.compute(updates, previous)
+            for w in self.workers:       # fetch + update per superstep
+                w.update(previous)
+        return self.master.complete() or previous

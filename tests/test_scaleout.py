@@ -1,0 +1,192 @@
+"""Scaleout SPI + in-process runner + IRUnit simulation — the reference's
+distributed test strategy (SURVEY.md §4): boot the real orchestration in
+one process with a FAKE performer (TestPerformer pattern), then with a real
+MultiLayerNetwork performer, then the YARN-sim BSP driver on Iris."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import IrisDataFetcher
+from deeplearning4j_tpu.parallel import scaleout as so
+from deeplearning4j_tpu.parallel.coordinator import Job, StateTracker
+
+
+# -- fake-workload e2e (BaseTestDistributed/TestPerformer parity) -----------
+
+class DoublePerformer(so.WorkerPerformer):
+    """Fake workload: result = 2 * work; counts update() replications."""
+
+    def __init__(self):
+        self.replications = 0
+
+    def perform(self, job: Job) -> None:
+        job.result = 2.0 * job.work
+
+    def update(self, *args) -> None:
+        self.replications += 1
+
+
+class MeanAggregator(so.JobAggregator):
+    def __init__(self):
+        self.vals = []
+
+    def accumulate(self, job):
+        if job.result is not None:
+            self.vals.append(job.result)
+
+    def aggregate(self):
+        return sum(self.vals) / len(self.vals) if self.vals else None
+
+
+def test_runner_fake_workload_iterative_reduce():
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+        DoublePerformer, MeanAggregator(), n_workers=3)
+    result = runner.run(timeout_s=30)
+    assert result == pytest.approx(7.0)          # mean(2,4,6,8,10,12)
+    assert runner.tracker.count("jobs_done") == 6
+    assert len(runner.tracker.workers()) == 3
+
+
+def test_runner_hogwild_router_completes():
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(list(map(float, range(1, 9)))),
+        DoublePerformer, MeanAggregator(), n_workers=2,
+        router_cls=so.HogWildWorkRouter)
+    result = runner.run(timeout_s=30)
+    assert result == pytest.approx(9.0)
+
+
+def test_state_tracker_stale_reaper_requeues():
+    t = StateTracker(stale_after_s=0.0)          # everything is stale
+    t.add_worker("w1")
+    t.add_job(Job(work="x"))
+    job = t.job_for("w1")
+    assert job is not None
+    removed = t.remove_stale_workers()
+    assert removed == ["w1"]
+    t.add_worker("w2")
+    again = t.job_for("w2")                      # re-queued in-flight job
+    assert again is not None and again.work == "x"
+
+
+def test_update_saver_and_work_retriever():
+    s = so.UpdateSaver()
+    s.save("w1", {"a": np.ones(3)})
+    assert s.ids() == ["w1"]
+    got = s.load("w1")
+    np.testing.assert_allclose(got["a"], np.ones(3))
+    assert s.load("w1") is None                  # consumed
+
+    r = so.WorkRetriever()
+    r.save("w1", "d1")
+    r.save("w1", "d2")
+    assert r.load("w1") == "d1"
+    assert r.load("w1") == "d2"
+    assert r.load("w1") is None
+
+
+# -- real-model runner: parameter averaging over Iris -----------------------
+
+def _iris_conf():
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+    return (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).num_iterations(30).use_adagrad(False)
+            .activation("tanh")
+            .list(2).hidden_layer_sizes(10)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+
+
+class MLNPerformer(so.WorkerPerformer):
+    """BaseMultiLayerNetworkWorkPerformer parity: rebuild from conf JSON,
+    fit on the job's DataSet, ship params back; update() = set params."""
+
+    def __init__(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        self.net = MultiLayerNetwork(_iris_conf()).init(seed=0)
+
+    def perform(self, job: Job) -> None:
+        self.net.fit_backprop(job.work, num_epochs=10)
+        job.result = self.net.params
+
+    def update(self, params) -> None:
+        self.net.params = params
+
+
+class ParamAverager(so.JobAggregator):
+    def __init__(self):
+        self.acc = so.WorkAccumulator()
+
+    def accumulate(self, job):
+        self.acc.accumulate(job)
+
+    def aggregate(self):
+        return self.acc.aggregate()
+
+
+def test_runner_trains_multilayer_network_param_averaging():
+    f = IrisDataFetcher()
+    f.fetch(150)
+    data = f.next().normalize_zero_mean_unit_variance().shuffle(0)
+    shards = data.batch_by(50)                   # 3 jobs of 50 examples
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(shards), MLNPerformer, ParamAverager(),
+        n_workers=3)
+    averaged = runner.run(timeout_s=120)
+    assert averaged is not None
+
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(_iris_conf()).init(seed=0)
+    net.params = averaged
+    acc = net.evaluate(data).accuracy()
+    assert acc > 0.7, acc
+
+
+# -- IRUnit (YARN simulation) ----------------------------------------------
+
+class IrisWorker(so.ComputableWorker):
+    def __init__(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        self.net = MultiLayerNetwork(_iris_conf()).init(seed=1)
+
+    def compute(self, split) -> so.ParameterVectorUpdateable:
+        self.net.fit_backprop(split, num_epochs=10)
+        return so.ParameterVectorUpdateable(self.net.params)
+
+    def update(self, master_update) -> None:
+        self.net.params = master_update.get()
+
+
+class AveragingMaster(so.ComputableMaster):
+    """impl/multilayer/Master.java:64 parity: average param vectors."""
+
+    def compute(self, updates, previous):
+        n = float(len(updates))
+        avg = jax.tree.map(lambda *ps: sum(ps) / n,
+                           *[u.get() for u in updates])
+        return so.ParameterVectorUpdateable(avg)
+
+
+def test_irunit_iris_bsp_convergence():
+    f = IrisDataFetcher()
+    f.fetch(150)
+    data = f.next().normalize_zero_mean_unit_variance().shuffle(0)
+    splits = data.batch_by(50)
+    driver = so.IRUnitDriver(AveragingMaster(),
+                             [IrisWorker() for _ in splits],
+                             splits, iterations=3)
+    final = driver.run()
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(_iris_conf()).init(seed=1)
+    net.params = final.get()
+    assert net.evaluate(data).accuracy() > 0.8
+
+
+def test_irunit_rejects_mismatched_splits():
+    with pytest.raises(ValueError):
+        so.IRUnitDriver(AveragingMaster(), [IrisWorker()], [1, 2])
