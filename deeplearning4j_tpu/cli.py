@@ -36,7 +36,7 @@ def _load_dataset(spec: str, batch: int = 0):
         f.fetch(150)
     elif spec == "mnist":
         f = MnistDataFetcher()
-        f.fetch(f.total_examples() if hasattr(f, "total_examples") else 2048)
+        f.fetch(f.total)
     else:
         f = CSVDataFetcher(spec)
         f.fetch(10 ** 9)

@@ -98,7 +98,16 @@ class RemoteModelSaver:
     def __init__(self, store: ArtifactStore, key: str):
         self.store = store
         self.key = key
-        self._generation = 0
+        # resume the generation counter from existing backups so a new
+        # process EXTENDS the rolling history instead of clobbering it
+        prefix = key + "."
+        gens = []
+        for k in store.list():
+            if k.startswith(prefix):
+                suffix = k[len(prefix):]
+                if suffix.isdigit():
+                    gens.append(int(suffix))
+        self._generation = max(gens, default=0)
 
     def save(self, net) -> None:
         if self.key in self.store.list():

@@ -25,9 +25,11 @@ class ConfigRegistry:
 
     def _path(self, key: str) -> str:
         key = key.strip("/")
-        if not key:
-            raise ValueError("empty registry key")
         parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+        if not parts:
+            # '', '.', '..' and slash-only keys would collapse to a path
+            # OUTSIDE the registry root ('<root>.json') — refuse instead
+            raise ValueError(f"empty or traversal-only registry key: {key!r}")
         return os.path.join(self.root, *parts) + ".json"
 
     def register(self, key: str, conf: Dict[str, Any]) -> None:

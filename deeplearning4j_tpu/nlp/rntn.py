@@ -244,6 +244,8 @@ class RNTN:
         self._step = step
 
     def _batch_arrays(self, trees: Sequence[Tree]) -> Dict[str, Array]:
+        if not trees:
+            raise ValueError("no training trees provided")
         compiled = [compile_tree(t, self.vocab, self.cfg.max_nodes)
                     for t in trees]
         return {k: jnp.asarray(np.stack([c[k] for c in compiled]))

@@ -86,7 +86,8 @@ class WorkerPerformer:
 
 
 class JobAggregator:
-    """accumulate/aggregate (JobAggregator.java:30)."""
+    """accumulate/aggregate (JobAggregator.java:30); ``reset`` starts a
+    fresh round for synchronous routers."""
 
     def accumulate(self, job: Job) -> None:
         raise NotImplementedError
@@ -94,11 +95,18 @@ class JobAggregator:
     def aggregate(self) -> Any:
         raise NotImplementedError
 
+    def reset(self) -> None:
+        pass
+
 
 class WorkAccumulator(JobAggregator):
     """Running average of numeric results (WorkAccumulator.java:29)."""
 
     def __init__(self):
+        self._avg = None
+        self._n = 0
+
+    def reset(self) -> None:
         self._avg = None
         self._n = 0
 
@@ -191,6 +199,11 @@ class WorkRouter:
     """When should the master push more work / re-replicate?
     (api/workrouter/WorkRouter.java:29)"""
 
+    #: synchronous routers aggregate a whole round at once and REPLACE the
+    #: global state with that round's aggregate; async routers fold updates
+    #: in as they arrive
+    synchronous_rounds = True
+
     def __init__(self, tracker: StateTracker):
         self.tracker = tracker
 
@@ -202,12 +215,16 @@ class IterativeReduceWorkRouter(WorkRouter):
     """Synchronous rounds: only send new work when every outstanding job
     reported back (IterativeReduceWorkRouter.java:32)."""
 
+    synchronous_rounds = True
+
     def send_work(self) -> bool:
         return not self.tracker.has_pending()
 
 
 class HogWildWorkRouter(WorkRouter):
     """Always send — async lock-free (HogWildWorkRouter.java:30)."""
+
+    synchronous_rounds = False
 
     def send_work(self) -> bool:
         return True
@@ -258,7 +275,6 @@ class DistributedRunner:
                     performer.update(current)
                 self.tracker.done_replicating(worker_id)
             performer.perform(job)
-            self.update_saver.save(worker_id, job.result)
             self.tracker.add_update(worker_id, job)
             self.tracker.clear_job(worker_id)
             self.tracker.increment("jobs_done")
@@ -272,10 +288,36 @@ class DistributedRunner:
             w.start()
 
         deadline = time.time() + timeout_s
+        sync = self.router.synchronous_rounds
+        round_jobs: List[Job] = []
+
+        def publish(jobs_done: List[Job]) -> None:
+            """Fold finished jobs into the global state.  Synchronous
+            rounds REPLACE current with the round aggregate (the
+            reference's IterativeReduce); async folds incrementally."""
+            if not jobs_done:
+                return
+            if sync:
+                self.aggregator.reset()
+            for job in jobs_done:
+                self.aggregator.accumulate(job)
+            agg = self.aggregator.aggregate()
+            if agg is not None:
+                self.tracker.set_current(agg)
+
         try:
             while time.time() < deadline:
+                # 1) collect results; sync publishes only at the round
+                #    boundary, async as soon as anything arrived
+                round_jobs.extend(self.tracker.drain_updates())
+                if round_jobs and (not sync
+                                   or not self.tracker.has_pending()):
+                    publish(round_jobs)
+                    round_jobs = []
+                # 2) only then push new work — never start round N+1 while
+                #    round N results are drained-but-unpublished
                 if self.jobs.has_next():
-                    if self.router.send_work():
+                    if self.router.send_work() and not (sync and round_jobs):
                         # a "round" = up to one job per worker; the
                         # IterativeReduce router waits for the round to
                         # drain, HogWild pushes unconditionally
@@ -283,22 +325,13 @@ class DistributedRunner:
                             if not self.jobs.has_next():
                                 break
                             self.tracker.add_job(self.jobs.next(""))
-                elif not self.tracker.has_pending():
+                elif not self.tracker.has_pending() and not round_jobs:
                     break
-                # DoneMessage path: fold a completed round into the state
-                for job in self.tracker.drain_updates():
-                    self.aggregator.accumulate(job)
-                agg = self.aggregator.aggregate()
-                if agg is not None:
-                    self.tracker.set_current(agg)
                 time.sleep(self.poll)
             else:
                 raise TimeoutError("distributed run did not finish")
-            for job in self.tracker.drain_updates():
-                self.aggregator.accumulate(job)
-            agg = self.aggregator.aggregate()
-            if agg is not None:
-                self.tracker.set_current(agg)
+            round_jobs.extend(self.tracker.drain_updates())
+            publish(round_jobs)
             return self.tracker.get_current()
         finally:
             self._stop.set()

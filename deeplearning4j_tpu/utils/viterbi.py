@@ -67,9 +67,13 @@ def decode_batch(emission_logp: Array, transition_logp: Array,
 def transitions_from_labels(label_seqs, num_labels: int,
                             smoothing: float = 1.0) -> Array:
     """Count-based transition log-probs from training label sequences
-    (the reference estimates transitions the same way, Viterbi.java)."""
-    counts = jnp.full((num_labels, num_labels), smoothing)
+    (the reference estimates transitions the same way, Viterbi.java).
+    Counting is host-side numpy — a device op per transition would
+    dispatch O(corpus) kernels for a bookkeeping job."""
+    import numpy as np
+
+    counts = np.full((num_labels, num_labels), float(smoothing))
     for seq in label_seqs:
-        for a, b in zip(seq[:-1], seq[1:]):
-            counts = counts.at[a, b].add(1.0)
-    return jnp.log(counts / jnp.sum(counts, axis=1, keepdims=True))
+        s = np.asarray(seq)
+        np.add.at(counts, (s[:-1], s[1:]), 1.0)
+    return jnp.log(jnp.asarray(counts / counts.sum(axis=1, keepdims=True)))

@@ -65,8 +65,11 @@ def test_config_registry_rejects_traversal(tmp_path):
     reg = ConfigRegistry(str(tmp_path / "reg"))
     reg.register("../escape", {"x": 1})      # sanitized, stays inside root
     assert reg.keys() == ["escape"]
-    with pytest.raises(ValueError):
-        reg.register("", {})
+    for bad in ("", ".", "..", "../..", "/"):
+        with pytest.raises(ValueError):
+            reg.register(bad, {})
+    import os
+    assert not os.path.exists(str(tmp_path / "reg.json"))
 
 
 def test_artifact_store_and_model_saver(tmp_path):
@@ -96,3 +99,24 @@ def test_artifact_store_and_model_saver(tmp_path):
     assert "models/a.bin" not in store.list()
     with pytest.raises(KeyError):
         store.get("models/a.bin")
+
+
+def test_model_saver_resumes_generations(tmp_path):
+    """A fresh saver instance must extend, not clobber, backup history."""
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+
+    class FakeNet:
+        def __init__(self, blob):
+            self.blob = blob
+
+        def to_bytes(self):
+            return self.blob
+
+    s1 = RemoteModelSaver(store, "m.bin")
+    s1.save(FakeNet(b"a"))
+    s1.save(FakeNet(b"b"))
+    s2 = RemoteModelSaver(store, "m.bin")   # new process
+    s2.save(FakeNet(b"c"))
+    assert store.get("m.bin") == b"c"
+    assert store.get("m.bin.1") == b"a"
+    assert store.get("m.bin.2") == b"b"     # preserved, not clobbered

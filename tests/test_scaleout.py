@@ -40,13 +40,18 @@ class MeanAggregator(so.JobAggregator):
     def aggregate(self):
         return sum(self.vals) / len(self.vals) if self.vals else None
 
+    def reset(self):
+        self.vals = []
+
 
 def test_runner_fake_workload_iterative_reduce():
     runner = so.DistributedRunner(
         so.CollectionJobIterator([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
         DoublePerformer, MeanAggregator(), n_workers=3)
     result = runner.run(timeout_s=30)
-    assert result == pytest.approx(7.0)          # mean(2,4,6,8,10,12)
+    # sync rounds REPLACE current: final = mean of the LAST round's
+    # results (jobs 4,5,6 doubled), the IterativeReduce semantics
+    assert result == pytest.approx(10.0)
     assert runner.tracker.count("jobs_done") == 6
     assert len(runner.tracker.workers()) == 3
 
@@ -127,6 +132,9 @@ class ParamAverager(so.JobAggregator):
 
     def aggregate(self):
         return self.acc.aggregate()
+
+    def reset(self):
+        self.acc.reset()
 
 
 def test_runner_trains_multilayer_network_param_averaging():
