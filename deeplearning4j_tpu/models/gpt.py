@@ -1,0 +1,241 @@
+"""GPT-style causal language model + KV-cache autoregressive decoding.
+
+New capability (the reference's only generative sequence model is the
+char-LSTM, models/classifiers/lstm/LSTM.java); the causal LM reuses the
+transformer encoder stack with ``causal=True`` and adds the TPU-native
+decode path:
+
+- Training: next-token cross-entropy over the full sequence (one MXU-dense
+  forward, shifted labels) — ``make_train_step`` shards dp/tp over the
+  mesh exactly like models/bert.
+- Generation: a KV cache [L, B, T_max, NH, D] carried through a
+  ``lax.scan`` — one compiled program generates N tokens with no
+  per-token retracing or host round trips; each step attends over the
+  cache prefix with a position mask (static shapes, as XLA wants).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models import transformer as tfm
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+PyTree = Any
+
+
+def gpt_config(vocab_size: int = 50257, max_len: int = 1024,
+               hidden: int = 768, n_layers: int = 12, n_heads: int = 12
+               ) -> TransformerConfig:
+    return TransformerConfig(vocab_size=vocab_size, max_len=max_len,
+                             hidden=hidden, n_layers=n_layers,
+                             n_heads=n_heads, ffn_dim=4 * hidden,
+                             causal=True, type_vocab_size=1)
+
+
+def gpt_tiny(vocab_size: int = 256, max_len: int = 128) -> TransformerConfig:
+    return TransformerConfig(vocab_size=vocab_size, max_len=max_len,
+                             hidden=64, n_layers=2, n_heads=4, ffn_dim=128,
+                             dropout=0.0, causal=True, type_vocab_size=1)
+
+
+def init_params(key: Array, cfg: TransformerConfig) -> PyTree:
+    if not cfg.causal:
+        raise ValueError("GPT config must be causal")
+    return tfm.init_params(key, cfg)
+
+
+def lm_logits(cfg: TransformerConfig, params: PyTree, hidden: Array) -> Array:
+    """Tied-embedding readout [B, T, H] -> [B, T, vocab]."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    return jnp.einsum("bth,vh->btv", hidden.astype(cdt),
+                      params["embed"]["tok"].astype(cdt),
+                      preferred_element_type=jnp.float32)
+
+
+def lm_loss(cfg: TransformerConfig, params: PyTree, token_ids: Array,
+            mask: Optional[Array] = None,
+            dropout_key: Optional[Array] = None,
+            attn_fn=tfm.attention) -> Array:
+    """Next-token CE: predict token_ids[:, 1:] from positions [:, :-1]."""
+    hidden = tfm.encode(cfg, params, token_ids, mask, None, dropout_key,
+                        attn_fn=attn_fn)
+    logits = lm_logits(cfg, params, hidden[:, :-1])
+    targets = token_ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        w = mask[:, 1:]
+        return -jnp.sum(ll * w) / jnp.maximum(jnp.sum(w), 1.0)
+    return -jnp.mean(ll)
+
+
+class TrainState(NamedTuple):
+    params: PyTree
+    opt_state: PyTree
+    step: Array
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh,
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    attn_fn=tfm.attention) -> Tuple[Callable, Callable]:
+    """Same sharding scheme as models/bert.make_train_step: params over
+    the model axis (tp), batch over data."""
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                          tfm.param_specs(cfg))
+    dsh = NamedSharding(mesh, P(DATA_AXIS, None))
+    repl = NamedSharding(mesh, P())
+
+    def init_fn(key: Array) -> TrainState:
+        params = init_params(key, cfg)
+        return TrainState(params, optimizer.init(params),
+                          jnp.zeros((), jnp.int32))
+
+    def _step(state: TrainState, token_ids: Array, key: Array):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(cfg, p, token_ids, None, key, attn_fn)
+        )(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    cache: Dict[str, Callable] = {}
+
+    def step_fn(state: TrainState, token_ids: Array, key: Array):
+        if "fn" not in cache:
+            osh = jax.tree.map(
+                lambda x: repl,
+                jax.eval_shape(optimizer.init,
+                               jax.eval_shape(lambda: state.params)))
+            st_sh = TrainState(pshard, osh, repl)
+            cache["fn"] = jax.jit(_step,
+                                  in_shardings=(st_sh, dsh, repl),
+                                  out_shardings=(st_sh, repl))
+        return cache["fn"](state, token_ids, key)
+
+    return init_fn, step_fn
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding
+# ---------------------------------------------------------------------------
+
+class KVCache(NamedTuple):
+    k: Array            # [L, B, T_max, NH, D]
+    v: Array
+
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               max_len: Optional[int] = None) -> KVCache:
+    T = max_len or cfg.max_len
+    shape = (cfg.n_layers, batch, T, cfg.n_heads, cfg.head_dim)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    return KVCache(jnp.zeros(shape, cdt), jnp.zeros(shape, cdt))
+
+
+def _decode_step(cfg: TransformerConfig, params: PyTree, cache: KVCache,
+                 token: Array, pos: Array) -> Tuple[KVCache, Array]:
+    """One token through the stack, reading/extending the cache.
+
+    token [B] int32; pos scalar int32 (current position).  Returns
+    (cache', logits [B, vocab]).
+    """
+    cdt = jnp.dtype(cfg.compute_dtype)
+    B = token.shape[0]
+    T_max = cache.k.shape[2]
+    x = tfm.embed(cfg, params, token[:, None], None, pos)     # [B, 1, H]
+
+    valid = (jnp.arange(T_max) <= pos)                        # attend <= pos
+    new_k, new_v = [], []
+    blocks = params["blocks"]
+    for layer in range(cfg.n_layers):
+        p = jax.tree.map(lambda a, l=layer: a[l], blocks)
+        h = x.astype(cdt)
+        q = jnp.einsum("bth,hnd->btnd", h, p["wq"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bq"]
+        k1 = jnp.einsum("bth,hnd->btnd", h, p["wk"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bk"]
+        v1 = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bv"]
+        k_cache = lax.dynamic_update_slice(
+            cache.k[layer], k1.astype(cdt), (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            cache.v[layer], v1.astype(cdt), (0, pos, 0, 0))
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_cache,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid[None, None, None, :], s, -1e9)
+        probs = jax.nn.softmax(s, axis=-1).astype(cdt)
+        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_cache,
+                       preferred_element_type=jnp.float32)
+        a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bo"]
+        x = tfm.layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+
+        h = x.astype(cdt)
+        f = jnp.einsum("bth,hf->btf", h, p["w1"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b1"]
+        f = jax.nn.gelu(f).astype(cdt)
+        f = jnp.einsum("btf,fh->bth", f, p["w2"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b2"]
+        x = tfm.layer_norm(x + f, p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
+
+    logits = lm_logits(cfg, params, x)[:, 0, :]
+    return KVCache(jnp.stack(new_k), jnp.stack(new_v)), logits
+
+
+def generate(cfg: TransformerConfig, params: PyTree, prompt: Array,
+             n_tokens: int, key: Array, temperature: float = 1.0,
+             max_len: Optional[int] = None) -> Array:
+    """Sample ``n_tokens`` continuations for ``prompt`` [B, T_p] int32.
+
+    Prefill walks the prompt through the cache, then one lax.scan emits
+    the continuation — the whole thing is two compiled programs total.
+    """
+    B, T_p = prompt.shape
+    T_max = max_len or cfg.max_len
+    if T_p + n_tokens > T_max:
+        raise ValueError(f"prompt {T_p} + {n_tokens} exceeds max {T_max}")
+    cache = init_cache(cfg, B, T_max)
+
+    def prefill_step(carry, inputs):
+        cache, _ = carry
+        tok, pos = inputs
+        cache, logits = _decode_step(cfg, params, cache, tok, pos)
+        return (cache, logits), None
+
+    (cache, logits), _ = lax.scan(
+        prefill_step, (cache, jnp.zeros((B, cfg.vocab_size))),
+        (jnp.moveaxis(prompt, 1, 0), jnp.arange(T_p)))
+
+    def gen_step(carry, inputs):
+        cache, logits = carry
+        k, pos = inputs
+        nxt = jax.random.categorical(k, logits / temperature, axis=-1)
+        cache, logits = _decode_step(cfg, params, cache, nxt, pos)
+        return (cache, logits), nxt
+
+    keys = jax.random.split(key, n_tokens)
+    _, out = lax.scan(gen_step, (cache, logits),
+                      (keys, T_p + jnp.arange(n_tokens)))
+    return jnp.moveaxis(out, 0, 1)                            # [B, n_tokens]
+
+
+def forward_logits(cfg: TransformerConfig, params: PyTree,
+                   token_ids: Array) -> Array:
+    """Dense (non-cached) forward for parity checks: [B, T] -> [B, T, V]."""
+    hidden = tfm.encode(cfg, params, token_ids)
+    return lm_logits(cfg, params, hidden)

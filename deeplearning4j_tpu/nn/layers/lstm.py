@@ -84,3 +84,32 @@ class LSTMLayer(Layer):
         logits = self.decode(params, self.scan_sequence(params, xs))
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.mean(jnp.sum(ys * logp, axis=-1))
+
+    def sample(self, params: Params, key: Array, length: int,
+               start_id: int = 0, temperature: float = 1.0) -> Array:
+        """Autoregressive char sampling (the reference LSTM is a GENERATIVE
+        char model: softmax decode :449-456 feeds the next input).
+
+        Requires one-hot char inputs (n_in == n_out == vocab).  The whole
+        generation loop is one ``lax.scan`` — no per-token host round
+        trips.  Returns sampled ids [length].
+        """
+        vocab = self.conf.n_out
+        if self.conf.n_in != vocab:
+            raise ValueError(
+                f"sampling needs one-hot io: n_in={self.conf.n_in} != "
+                f"n_out={vocab}")
+        h0 = jnp.zeros((1, self.hidden), jnp.float32)
+        c0 = jnp.zeros((1, self.hidden), jnp.float32)
+        x0 = jax.nn.one_hot(jnp.asarray([start_id]), vocab)
+
+        def step(carry, k):
+            (h, c), x = carry
+            (h, c), _ = self._step(params, (h, c), x)
+            logits = self.decode(params, h[:, None, :])[:, 0, :]
+            nxt = jax.random.categorical(k, logits / temperature, axis=-1)
+            return (((h, c), jax.nn.one_hot(nxt, vocab)), nxt[0])
+
+        keys = jax.random.split(key, length)
+        _, ids = lax.scan(step, ((h0, c0), x0), keys)
+        return ids
