@@ -120,7 +120,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
             st_sh = TrainState(pshard, osh, repl)
             cache["fn"] = jax.jit(_step,
                                   in_shardings=(st_sh, dsh, repl),
-                                  out_shardings=(st_sh, repl))
+                                  out_shardings=(st_sh, repl),
+                                  donate_argnums=(0,))
         return cache["fn"](state, token_ids, key)
 
     return init_fn, step_fn

@@ -261,7 +261,8 @@ def make_train_step(cfg: ResNetConfig, mesh: Mesh,
             state_sh = jax.tree.map(lambda _: repl, state)
             cache["fn"] = jax.jit(_step,
                                   in_shardings=(state_sh, xsh, ysh),
-                                  out_shardings=(state_sh, repl))
+                                  out_shardings=(state_sh, repl),
+                                  donate_argnums=(0,))
         return cache["fn"](state, x, labels)
 
     return init_fn, step_fn

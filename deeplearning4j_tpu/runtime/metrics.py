@@ -111,3 +111,49 @@ def device_memory_stats() -> Dict[str, Any]:
         except Exception:
             stats[str(d)] = None
     return stats
+
+
+class Profiler:
+    """Profiling hooks (SURVEY.md §5.1: the reference has none — only
+    score-logging listeners; jax.profiler + XLA dumps are the TPU-native
+    upgrade slot).
+
+    - ``trace(logdir)``: context manager capturing a jax.profiler trace
+      viewable in TensorBoard/Perfetto.
+    - ``annotate(name)``: TraceAnnotation for custom spans inside a step.
+    - ``step_timer()``: lightweight wall-clock step timing when a full
+      trace is too heavy (host-side; device sync is the caller's job).
+    """
+
+    @staticmethod
+    def trace(logdir: str):
+        import jax
+        return jax.profiler.trace(logdir)
+
+    @staticmethod
+    def annotate(name: str):
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+
+    @staticmethod
+    def step_timer():
+        import time
+
+        class _Timer:
+            def __init__(self):
+                self.times = []
+                self._t0 = None
+
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                self.times.append(time.perf_counter() - self._t0)
+                return False
+
+            @property
+            def mean_s(self):
+                return sum(self.times) / len(self.times) if self.times else 0.0
+
+        return _Timer()

@@ -112,3 +112,22 @@ def test_throughput_meter():
     for _ in range(5):
         r = m.tick(32)
     assert r is not None and r > 0
+
+
+def test_profiler_helpers(tmp_path):
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.runtime.metrics import Profiler
+
+    t = Profiler.step_timer()
+    for _ in range(3):
+        with t:
+            jnp.ones(8).sum().block_until_ready()
+    assert len(t.times) == 3 and t.mean_s > 0
+
+    with Profiler.annotate("test-span"):
+        jnp.ones(4).sum().block_until_ready()
+
+    with Profiler.trace(str(tmp_path / "prof")):
+        jnp.ones(16).sum().block_until_ready()
+    import os
+    assert os.path.isdir(str(tmp_path / "prof"))
