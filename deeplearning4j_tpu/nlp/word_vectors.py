@@ -119,3 +119,49 @@ def load_word_vectors(path: str) -> WordVectors:
         cache.vocab[w].index = i
     assert len(rows) == v, f"expected {v} rows, got {len(rows)}"
     return WordVectors(cache, jnp.asarray(np.stack(rows)))
+
+
+def write_word_vectors_binary(wv: WordVectors, path: str) -> None:
+    """word2vec C BINARY format (WordVectorSerializer's other half):
+    ascii header 'V dim\\n', then per word: 'word ' + dim float32 LE."""
+    vecs = np.asarray(wv.vectors, dtype=np.float32)
+    with open(path, "wb") as f:
+        f.write(f"{vecs.shape[0]} {vecs.shape[1]}\n".encode())
+        for i in range(vecs.shape[0]):
+            word = wv.cache.word_for(i)
+            if " " in word:
+                # the C binary layout delimits the word with the FIRST
+                # space, so spaced vocab entries (n-grams) cannot
+                # round-trip — the text format handles those
+                raise ValueError(
+                    f"binary format cannot store spaced word {word!r}; "
+                    f"use write_word_vectors (text) instead")
+            f.write(word.encode("utf-8") + b" ")
+            f.write(vecs[i].astype("<f4").tobytes())
+            f.write(b"\n")
+
+
+def load_word_vectors_binary(path: str) -> WordVectors:
+    import jax.numpy as jnp
+
+    cache = VocabCache()
+    rows: List[np.ndarray] = []
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        v, dim = int(header[0]), int(header[1])
+        for _ in range(v):
+            word = bytearray()
+            while True:
+                c = f.read(1)
+                if not c or c == b" ":
+                    break
+                word.extend(c)
+            vec = np.frombuffer(f.read(4 * dim), dtype="<f4").copy()
+            f.read(1)                                    # trailing '\n'
+            cache.add_token(word.decode("utf-8"))
+            rows.append(vec)
+    # preserve file order as the index (rows align with words)
+    cache.index = [w for w in cache.vocab]
+    for i, w in enumerate(cache.index):
+        cache.vocab[w].index = i
+    return WordVectors(cache, jnp.asarray(np.stack(rows)))

@@ -106,3 +106,41 @@ def test_word_vectors_serialization(tmp_path):
     sims1 = wv.words_nearest("the", 3)
     sims2 = wv2.words_nearest("the", 3)
     assert [w for w, _ in sims1] == [w for w, _ in sims2]
+
+
+def test_word_vectors_binary_roundtrip(tmp_path):
+    import numpy as np
+    from deeplearning4j_tpu.nlp.word_vectors import (
+        WordVectors, load_word_vectors_binary, write_word_vectors_binary)
+    from deeplearning4j_tpu.nlp.vocab import VocabCache
+    import jax.numpy as jnp
+
+    import pytest
+
+    cache = VocabCache()
+    for w in ["alpha", "beta", "gamma"]:
+        cache.add_token(w)
+    cache.index = [w for w in cache.vocab]
+    for i, w in enumerate(cache.index):
+        cache.vocab[w].index = i
+    vecs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(3, 8)).astype(np.float32))
+    wv = WordVectors(cache, vecs)
+    p = str(tmp_path / "vecs.bin")
+    write_word_vectors_binary(wv, p)
+    back = load_word_vectors_binary(p)
+    np.testing.assert_allclose(np.asarray(back.vectors),
+                               np.asarray(vecs), rtol=1e-6)
+    assert back.has_word("gamma")
+    assert abs(back.similarity("alpha", "beta")
+               - wv.similarity("alpha", "beta")) < 1e-6
+
+    # spaced (n-gram) vocab entries can't survive the C binary layout —
+    # the writer must refuse rather than corrupt the stream
+    cache2 = VocabCache()
+    cache2.add_token("multi word")
+    cache2.index = ["multi word"]
+    cache2.vocab["multi word"].index = 0
+    wv2 = WordVectors(cache2, vecs[:1])
+    with pytest.raises(ValueError):
+        write_word_vectors_binary(wv2, str(tmp_path / "bad.bin"))
