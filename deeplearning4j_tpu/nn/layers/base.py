@@ -82,6 +82,18 @@ class Layer:
 
     def activate(self, params: Params, x: Array,
                  key: Optional[Array] = None, train: bool = False) -> Array:
+        if (train and key is not None and self.conf.drop_connect
+                and self.conf.dropout > 0.0):
+            # DropConnect (useDropConnect parity): bernoulli-mask the
+            # WEIGHTS instead of the activations; inverted scaling keeps
+            # the expected pre-activation unchanged
+            key, wkey = jax.random.split(key)
+            keep = 1.0 - self.conf.dropout
+            mask = jax.random.bernoulli(wkey, keep, params["W"].shape)
+            params = dict(params,
+                          W=params["W"] * mask.astype(params["W"].dtype)
+                          / keep)
+            return self.activation(self.pre_output(params, x))
         z = self.pre_output(params, x)
         y = self.activation(z)
         if train and self.conf.dropout > 0.0 and key is not None:

@@ -54,6 +54,11 @@ class MultiLayerNetwork:
                  params: Optional[Params] = None):
         self.conf = conf
         self._wire_layer_sizes()
+        if conf.use_drop_connect:
+            # net-level useDropConnect flips every layer's dropout from
+            # activation masking to weight masking (DropConnect)
+            for c in conf.confs:
+                c.drop_connect = True
         self.layers: List[Layer] = [make_layer(c) for c in conf.confs]
         self.params: Optional[Params] = params
         self.listeners: List[IterationListener] = []

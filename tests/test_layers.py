@@ -132,3 +132,35 @@ def test_recursive_autoencoder_folds():
     assert root.shape == (4, 8)
     score, grads = layer.pretrain_value_and_grad(params, jax.random.key(2), xs)
     assert np.isfinite(float(score))
+
+
+def test_drop_connect_masks_weights():
+    """use_drop_connect: train-mode forward masks WEIGHTS (stochastic per
+    key), inference stays deterministic and unmasked."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(6).activation("tanh").dropout(0.5)
+            .list(2).hidden_layer_sizes(8)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    conf.use_drop_connect = True
+    net = MultiLayerNetwork(conf).init()
+    assert all(c.drop_connect for c in net.conf.confs)
+
+    x = jnp.ones((4, 6))
+    params = net.params
+    a1 = net.layers[0].activate(params[0], x, key=jax.random.key(1),
+                                train=True)
+    a2 = net.layers[0].activate(params[0], x, key=jax.random.key(2),
+                                train=True)
+    assert not np.allclose(np.asarray(a1), np.asarray(a2))
+    # inference: no masking, identical across calls
+    e1 = net.layers[0].activate(params[0], x, train=False)
+    e2 = net.layers[0].activate(params[0], x, train=False)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
