@@ -200,3 +200,54 @@ def load_model(path: str):
     flat = jnp.asarray(np.load(path + ".params.npy"))
     net.set_params_flat(flat)
     return net
+
+
+class OrbaxCheckpointManager:
+    """Orbax-backed alternative to CheckpointManager — same save/restore/
+    retention surface, but using the JAX ecosystem's checkpointing library
+    (async-capable, sharding-aware for multi-host pods where each process
+    must write only its shards).  Falls back is the caller's choice; this
+    class raises ImportError when orbax is unavailable.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, step: int, tree: PyTree,
+             meta: Optional[Dict] = None) -> None:
+        args = self._ocp.args.Composite(
+            state=self._ocp.args.StandardSave(tree),
+            **({"meta": self._ocp.args.JsonSave(meta)} if meta else {}))
+        self._mgr.save(step, args=args)
+        self._mgr.wait_until_finished()
+
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None,
+                like: Optional[PyTree] = None) -> Tuple[PyTree, Dict]:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if like is not None:
+            args = self._ocp.args.Composite(
+                state=self._ocp.args.StandardRestore(like))
+        else:
+            args = self._ocp.args.Composite(
+                state=self._ocp.args.StandardRestore())
+        out = self._mgr.restore(step, args=args)
+        meta = dict(out.get("meta") or {}) if hasattr(out, "get") else {}
+        return out["state"], meta
+
+    def close(self) -> None:
+        self._mgr.close()

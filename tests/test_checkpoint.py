@@ -131,3 +131,27 @@ def test_profiler_helpers(tmp_path):
         jnp.ones(16).sum().block_until_ready()
     import os
     assert os.path.isdir(str(tmp_path / "prof"))
+
+
+def test_orbax_manager_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+    pytest = __import__("pytest")
+    try:
+        from deeplearning4j_tpu.runtime.checkpoint import (
+            OrbaxCheckpointManager)
+        mgr = OrbaxCheckpointManager(str(tmp_path / "orbax"), max_to_keep=2)
+    except ImportError:
+        pytest.skip("orbax unavailable")
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)}
+    for step in (1, 2, 3):
+        mgr.save(step, jax.tree.map(lambda x, s=step: x + s, tree))
+    assert mgr.latest_step() == 3
+    assert mgr.all_steps() == [2, 3]          # retention kept 2
+    got, _ = mgr.restore(like=tree)
+    np.testing.assert_allclose(np.asarray(got["w"]),
+                               np.asarray(tree["w"]) + 3)
+    mgr.close()
+
+
+import jax  # noqa: E402  (used by the orbax test's tree.map)
