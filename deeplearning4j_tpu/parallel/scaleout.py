@@ -274,7 +274,18 @@ class DistributedRunner:
                 if current is not None:
                     performer.update(current)
                 self.tracker.done_replicating(worker_id)
-            performer.perform(job)
+            try:
+                performer.perform(job)
+            except Exception:
+                # JobFailed parity: requeue the work for another worker
+                # instead of dying silently and stranding the job
+                log.exception("worker %s failed job; requeueing", worker_id)
+                self.tracker.clear_job(worker_id)
+                job.worker_id = ""
+                job.result = None
+                self.tracker.add_job(job)
+                self.tracker.increment("jobs_failed")
+                continue
             self.tracker.add_update(worker_id, job)
             self.tracker.clear_job(worker_id)
             self.tracker.increment("jobs_done")

@@ -198,3 +198,24 @@ def test_irunit_iris_bsp_convergence():
 def test_irunit_rejects_mismatched_splits():
     with pytest.raises(ValueError):
         so.IRUnitDriver(AveragingMaster(), [IrisWorker()], [1, 2])
+
+
+def test_worker_failure_requeues_job():
+    """A performer that crashes must not strand its job: the work is
+    requeued and eventually completes on a retry (JobFailed parity)."""
+    import itertools
+    counter = itertools.count()
+
+    class FlakyPerformer(so.WorkerPerformer):
+        def perform(self, job):
+            if next(counter) < 2:          # first two attempts die
+                raise RuntimeError("injected fault")
+            job.result = 2.0 * job.work
+
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator([1.0, 2.0, 3.0]),
+        FlakyPerformer, MeanAggregator(), n_workers=2)
+    result = runner.run(timeout_s=30)
+    assert result is not None
+    assert runner.tracker.count("jobs_done") == 3
+    assert runner.tracker.count("jobs_failed") == 2
