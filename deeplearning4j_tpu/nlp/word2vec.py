@@ -191,7 +191,11 @@ class Word2Vec:
         if cfg.negative > 0:
             self.syn1neg = jnp.zeros((V, D))
 
-    def fit(self) -> WordVectors:
+    def fit(self, initial_weights=None) -> WordVectors:
+        """Train; ``initial_weights=(syn0, syn1, syn1neg|None)`` resumes
+        from given tables instead of re-initializing — the hook the
+        distributed performers use to absorb the current global state
+        (scaleout word2vec job parity)."""
         cfg = self.config
         if not cfg.use_hs and cfg.negative <= 0:
             raise ValueError(
@@ -199,7 +203,14 @@ class Word2Vec:
         self.build_vocab()
         if len(self.cache) == 0:
             raise ValueError("empty vocabulary")
-        self._reset_weights()
+        if initial_weights is not None:
+            self.syn0, self.syn1, self.syn1neg = (
+                jnp.asarray(initial_weights[0]),
+                jnp.asarray(initial_weights[1]),
+                None if initial_weights[2] is None
+                else jnp.asarray(initial_weights[2]))
+        else:
+            self._reset_weights()
         codes_t, points_t, lengths_t = encode_hs_tables(self.cache)
         codes_t = jnp.asarray(codes_t)
         points_t = jnp.asarray(points_t)

@@ -219,3 +219,27 @@ def test_worker_failure_requeues_job():
     assert result is not None
     assert runner.tracker.count("jobs_done") == 3
     assert runner.tracker.count("jobs_failed") == 2
+
+
+def test_distributed_word2vec_e2e():
+    """DistributedWord2VecTest parity: sharded sentence training through
+    the runner produces usable vectors (similar words closer than
+    unrelated ones)."""
+    from deeplearning4j_tpu.nlp.distributed import (
+        train_word2vec_distributed)
+    from deeplearning4j_tpu.nlp.word2vec import Word2VecConfig
+
+    corpus = (["the beach has sand and sea",
+               "waves crash on the beach near the sea",
+               "sand and sea meet at the shore",
+               "the cat sat on the mat",
+               "the dog sat on the rug",
+               "cats and dogs are pets"] * 30)
+    wv = train_word2vec_distributed(
+        corpus, Word2VecConfig(vector_size=24, window=3, epochs=3,
+                               seed=11, batch_size=256),
+        n_workers=2, n_shards=4, timeout_s=240)
+    assert wv.has_word("beach") and wv.has_word("cat")
+    related = wv.similarity("sand", "sea")
+    unrelated = wv.similarity("sand", "pets")
+    assert related > unrelated, (related, unrelated)
