@@ -84,3 +84,55 @@ def sentence_features(text_or_tokens, word_vectors, window_size: int = 5,
     to a per-position classifier whose outputs feed utils/viterbi.decode."""
     wins = windows(text_or_tokens, window_size, tokenizer)
     return WindowConverter(word_vectors).to_matrix(wins)
+
+
+class Word2VecDataSetIterator:
+    """Labeled windows -> DataSets (models/word2vec/iterator/
+    Word2VecDataSetIterator.java parity): each window of a labeled
+    sentence becomes (concatenated word vectors, one-hot label of the
+    focus token) — the featurization feeding a per-position classifier
+    (+ utils/viterbi for decoding).
+    """
+
+    def __init__(self, word_vectors, labeled_sentences, labels: Sequence[str],
+                 batch_size: int = 32, window_size: int = 5,
+                 tokenizer=None):
+        """labeled_sentences: iterable of (tokens_or_text, token_labels)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        self.labels = list(labels)
+        label_ix = {l: i for i, l in enumerate(self.labels)}
+        conv = WindowConverter(word_vectors)
+        feats, ys = [], []
+        for sent, sent_labels in labeled_sentences:
+            wins = windows(sent, window_size, tokenizer)
+            if len(wins) != len(sent_labels):
+                raise ValueError(
+                    f"{len(sent_labels)} labels for {len(wins)} tokens")
+            for w, lab in zip(wins, sent_labels):
+                feats.append(conv.to_features(w))
+                ys.append(label_ix[lab])
+        x = np.stack(feats)
+        y = np.eye(len(self.labels), dtype=np.float32)[np.asarray(ys)]
+        self._batches = [
+            DataSet(jnp.asarray(x[i:i + batch_size]),
+                    jnp.asarray(y[i:i + batch_size]))
+            for i in range(0, len(x), batch_size)]
+        self._cursor = 0
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self._batches)
+
+    def next(self):
+        ds = self._batches[self._cursor]
+        self._cursor += 1
+        return ds
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()

@@ -133,3 +133,24 @@ def test_window_features_concatenate_vectors():
     np.testing.assert_allclose(feats[0], [0] * 3 + [3] * 3 + [3] * 3)
     # unknown word maps to zeros
     np.testing.assert_allclose(feats[2][3:6], [0, 0, 0])
+
+
+def test_word2vec_dataset_iterator_labeled_windows():
+    from deeplearning4j_tpu.nlp.moving_window import Word2VecDataSetIterator
+
+    data = [("the cat sat", ["DET", "NOUN", "VERB"]),
+            ("a dog ran", ["DET", "NOUN", "VERB"])]
+    it = Word2VecDataSetIterator(_FakeVectors(), data,
+                                 labels=["DET", "NOUN", "VERB"],
+                                 batch_size=4, window_size=3)
+    batches = list(it)
+    n = sum(b.features.shape[0] for b in batches)
+    assert n == 6
+    assert batches[0].features.shape[1] == 9      # 3 words x dim 3
+    assert batches[0].labels.shape[1] == 3
+    # first window's focus is 'the' -> DET
+    assert int(np.argmax(np.asarray(batches[0].labels[0]))) == 0
+    import pytest
+    with pytest.raises(ValueError):
+        Word2VecDataSetIterator(_FakeVectors(), [("a b", ["X"])],
+                                labels=["X"])
