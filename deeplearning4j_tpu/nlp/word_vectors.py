@@ -153,11 +153,18 @@ def load_word_vectors_binary(path: str) -> WordVectors:
             word = bytearray()
             while True:
                 c = f.read(1)
-                if not c or c == b" ":
+                if not c:
                     break
+                if c in (b" ", b"\t", b"\n", b"\r"):
+                    # skip record-separator whitespace BEFORE the word (the
+                    # word2vec C writer emits '\n' after each vector; gensim
+                    # emits none) instead of consuming a fixed byte after —
+                    # the robust-loader convention, so both layouts parse
+                    if word:
+                        break
+                    continue
                 word.extend(c)
             vec = np.frombuffer(f.read(4 * dim), dtype="<f4").copy()
-            f.read(1)                                    # trailing '\n'
             cache.add_token(word.decode("utf-8"))
             rows.append(vec)
     # preserve file order as the index (rows align with words)

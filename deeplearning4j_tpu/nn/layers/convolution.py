@@ -36,13 +36,17 @@ class ConvolutionLayer(Layer):
 
     def pre_output(self, params: Params, x: Array) -> Array:
         cdt = jnp.dtype(self.conf.compute_dtype)
+        # Uniform-bf16 conv + f32 upcast after: keeping the conv's operands
+        # and output in one dtype keeps the VJP convs (dx = conv(dy, W),
+        # dW = conv(x, dy)) type-consistent — with preferred_element_type=
+        # f32 the f32 cotangent would meet the bf16 operands and fail.  The
+        # MXU accumulates bf16 products in f32 internally regardless.
         y = lax.conv_general_dilated(
             x.astype(cdt), params["W"].astype(cdt),
             window_strides=self.conf.stride,
             padding=self.conf.padding,
             dimension_numbers=_DIMS,
-            preferred_element_type=jnp.float32,
-        )
+        ).astype(jnp.float32)
         return y + params["b"].astype(jnp.float32)
 
     def activate(self, params, x, key=None, train=False):

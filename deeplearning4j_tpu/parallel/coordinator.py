@@ -87,10 +87,7 @@ class StateTracker:
                     removed.append(wid)
                     del self._workers[wid]
                     self._needs_replicate.pop(wid, None)
-                    job = self._jobs.pop(wid, None)
-                    if job is not None:
-                        job.worker_id = ""
-                        self._pending.append(job)
+                    self._requeue_locked(wid)
         return removed
 
     def worker_enabled(self, worker_id: str) -> bool:
@@ -126,6 +123,24 @@ class StateTracker:
     def clear_job(self, worker_id: str) -> None:
         with self._lock:
             self._jobs.pop(worker_id, None)
+
+    def _requeue_locked(self, worker_id: str) -> None:
+        """Requeue body; caller must hold the lock.  Resets any partial
+        result so the next worker starts the job clean."""
+        job = self._jobs.pop(worker_id, None)
+        if job is not None:
+            job.worker_id = ""
+            job.result = None
+            self._pending.append(job)
+
+    def requeue(self, worker_id: str) -> None:
+        """Atomically move a worker's assigned job back to the pending
+        queue (JobFailed parity).  Single lock acquisition so a concurrent
+        ``has_pending()`` can never observe the job missing from both
+        ``_jobs`` and ``_pending`` mid-requeue — which would let the master
+        finish the round and drop the failed job's work."""
+        with self._lock:
+            self._requeue_locked(worker_id)
 
     def has_pending(self) -> bool:
         with self._lock:

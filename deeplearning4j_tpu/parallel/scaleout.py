@@ -280,10 +280,10 @@ class DistributedRunner:
                 # JobFailed parity: requeue the work for another worker
                 # instead of dying silently and stranding the job
                 log.exception("worker %s failed job; requeueing", worker_id)
-                self.tracker.clear_job(worker_id)
-                job.worker_id = ""
-                job.result = None
-                self.tracker.add_job(job)
+                # single-lock requeue: clear_job-then-add_job opens a window
+                # where has_pending() is False and the master can end the
+                # round without this job's work
+                self.tracker.requeue(worker_id)
                 self.tracker.increment("jobs_failed")
                 continue
             self.tracker.add_update(worker_id, job)

@@ -239,13 +239,23 @@ class OrbaxCheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        if like is not None:
-            args = self._ocp.args.Composite(
-                state=self._ocp.args.StandardRestore(like))
-        else:
-            args = self._ocp.args.Composite(
-                state=self._ocp.args.StandardRestore())
-        out = self._mgr.restore(step, args=args)
+        state_arg = (self._ocp.args.StandardRestore(like)
+                     if like is not None
+                     else self._ocp.args.StandardRestore())
+        # Ask for the meta item too when the checkpoint has one — without
+        # it in the Composite, orbax never returns saved metadata and the
+        # (tree, meta) signature silently loses what save() wrote.  Detect
+        # the item from the checkpoint's own metadata rather than trying
+        # and catching (a transient failure must not degrade to meta={}).
+        try:
+            items = set(self._mgr.item_metadata(step).keys())
+        except Exception:
+            items = {"state"}
+        kwargs = {"state": state_arg}
+        if "meta" in items:
+            kwargs["meta"] = self._ocp.args.JsonRestore()
+        out = self._mgr.restore(step,
+                                args=self._ocp.args.Composite(**kwargs))
         meta = dict(out.get("meta") or {}) if hasattr(out, "get") else {}
         return out["state"], meta
 

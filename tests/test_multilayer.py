@@ -113,3 +113,32 @@ def test_merge_parameter_averaging():
     a.merge([b])
     np.testing.assert_allclose(np.asarray(a.params_flat()), (fa + fb) / 2,
                                rtol=1e-6)
+
+
+def test_batchnorm_running_stats_update_in_fit_backprop():
+    """BN running stats must refresh from the (single) loss-side training
+    forward — the trainer harvests batch statistics as an aux output of the
+    loss rather than paying a second feed_forward per step."""
+    conf = MultiLayerConfiguration(confs=[
+        (NeuralNetConfiguration.builder().kind(LayerKind.DENSE)
+         .n_in(4).n_out(8).activation("tanh").lr(0.1)
+         .use_adagrad(False).build()),
+        (NeuralNetConfiguration.builder().kind(LayerKind.BATCH_NORM)
+         .n_in(8).n_out(8).build()),
+        (NeuralNetConfiguration.builder().kind(LayerKind.OUTPUT)
+         .n_in(8).n_out(3).activation("softmax").loss_function("mcxent")
+         .lr(0.1).use_adagrad(False).build()),
+    ], pretrain=False, backprop=True)
+    net = MultiLayerNetwork(conf).init(seed=0)
+    rm0 = np.asarray(net.params[1]["running_mean"]).copy()
+    rv0 = np.asarray(net.params[1]["running_var"]).copy()
+
+    data = _iris()
+    net.fit_backprop(DataSet(data.features, data.labels), num_epochs=3)
+
+    rm1 = np.asarray(net.params[1]["running_mean"])
+    rv1 = np.asarray(net.params[1]["running_var"])
+    assert not np.allclose(rm0, rm1), "running_mean never updated"
+    assert not np.allclose(rv0, rv1), "running_var never updated"
+    # EMA of finite batch stats stays finite and var positive
+    assert np.all(np.isfinite(rm1)) and np.all(rv1 > 0)

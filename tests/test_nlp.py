@@ -144,3 +144,22 @@ def test_word_vectors_binary_roundtrip(tmp_path):
     wv2 = WordVectors(cache2, vecs[:1])
     with pytest.raises(ValueError):
         write_word_vectors_binary(wv2, str(tmp_path / "bad.bin"))
+
+
+def test_word_vectors_binary_no_trailing_newline(tmp_path):
+    """Binaries written WITHOUT the per-record newline (gensim's
+    save_word2vec_format layout) must parse identically — the loader skips
+    leading separator whitespace instead of consuming a fixed byte."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp.word_vectors import load_word_vectors_binary
+
+    vecs = np.random.default_rng(1).normal(size=(3, 5)).astype("<f4")
+    words = ["alpha", "beta", "gamma"]
+    p = tmp_path / "gensim.bin"
+    with open(p, "wb") as f:
+        f.write(b"3 5\n")
+        for w, v in zip(words, vecs):
+            f.write(w.encode() + b" " + v.tobytes())  # no trailing '\n'
+    back = load_word_vectors_binary(str(p))
+    np.testing.assert_allclose(np.asarray(back.vectors), vecs, rtol=1e-6)
+    assert back.has_word("beta")
