@@ -105,3 +105,86 @@ def train_word2vec_distributed(sentences: Sequence[str],
         Word2VecJobAggregator(), n_workers=n_workers)
     syn0, syn1, syn1neg = runner.run(timeout_s=timeout_s)
     return WordVectors(cache, jnp.asarray(syn0))
+
+
+class GlovePerformer(so.WorkerPerformer):
+    """Distributed GloVe workload (scaleout/perform/models/glove/
+    GlovePerformer.java parity): each job is a sentence shard; the
+    performer counts the shard's co-occurrences and runs the AdaGrad WLS
+    fit starting from the current globally-averaged tables, then ships the
+    full (w, w~, b, b~, AdaGrad accumulators) state back."""
+
+    def __init__(self, cache: VocabCache, config: "GloveConfig",
+                 tokenizer=None):
+        self.cache = cache
+        self.config = config
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self._current: Optional[Tuple] = None
+
+    def perform(self, job: Job) -> None:
+        from deeplearning4j_tpu.nlp.glove import Glove
+
+        glove = Glove(job.work, self.config, self.tokenizer,
+                      cache=self.cache)
+        glove.fit(initial_weights=self._current)
+        job.result = tuple(np.asarray(t) for t in glove.state)
+
+    def update(self, current) -> None:
+        self._current = current
+
+
+class GloveJobAggregator(so.JobAggregator):
+    """Running average of the 8-tuple GloVe state
+    (GloveJobAggregator.java parity)."""
+
+    def __init__(self):
+        self._sum = None
+        self._n = 0
+
+    def accumulate(self, job: Job) -> None:
+        if job.result is None:
+            return
+        self._n += 1
+        if self._sum is None:
+            self._sum = [t.copy() for t in job.result]
+        else:
+            self._sum = [a + b for a, b in zip(self._sum, job.result)]
+
+    def aggregate(self):
+        if self._sum is None:
+            return None
+        return tuple(t / self._n for t in self._sum)
+
+    def reset(self) -> None:
+        self._sum = None
+        self._n = 0
+
+
+def train_glove_distributed(sentences: Sequence[str],
+                            config=None,
+                            n_workers: int = 2,
+                            n_shards: Optional[int] = None,
+                            tokenizer=None,
+                            timeout_s: float = 300.0) -> WordVectors:
+    """DistributedGloveTest parity: shard sentences, run the runner with
+    GloVe performers, return vectors from the averaged tables."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.glove import GloveConfig
+
+    config = config or GloveConfig()
+    tokenizer = tokenizer or DefaultTokenizerFactory()
+    cache = build_vocab(sentences, tokenizer, config.min_word_frequency)
+
+    n_shards = n_shards or n_workers
+    shards: List[List[str]] = [[] for _ in range(n_shards)]
+    for i, s in enumerate(sentences):
+        shards[i % n_shards].append(s)
+    shards = [s for s in shards if s]
+
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(shards),
+        lambda: GlovePerformer(cache, config, tokenizer),
+        GloveJobAggregator(), n_workers=n_workers)
+    state = runner.run(timeout_s=timeout_s)
+    return WordVectors(cache, jnp.asarray(state[0]) + jnp.asarray(state[1]))

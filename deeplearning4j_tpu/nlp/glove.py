@@ -119,9 +119,14 @@ class Glove:
         self.sentences = sentences
         self.cache = cache
         self._wv: Optional[WordVectors] = None
+        self.state: Optional[Tuple] = None
         self.losses: list = []
 
-    def fit(self) -> WordVectors:
+    def fit(self, initial_weights: Optional[Tuple] = None) -> WordVectors:
+        """Train; ``initial_weights`` (an 8-tuple of w/w~/b/b~ tables plus
+        their AdaGrad accumulators, as produced in ``self.state``) warm-
+        starts from a previous or globally-averaged state — the hook the
+        distributed GloVe performer uses (GlovePerformer.java parity)."""
         cfg = self.config
         if self.cache is None:
             self.cache = build_vocab(self.sentences, self.tokenizer,
@@ -135,12 +140,19 @@ class Glove:
         if rows.size == 0:
             raise ValueError("no co-occurrences")
 
-        key = jax.random.key(cfg.seed)
-        k1, k2 = jax.random.split(key)
-        init = lambda k: (jax.random.uniform(k, (V, D)) - 0.5) / D
-        state = (init(k1), init(k2), jnp.zeros(V), jnp.zeros(V),
-                 jnp.full((V, D), 1e-8), jnp.full((V, D), 1e-8),
-                 jnp.full(V, 1e-8), jnp.full(V, 1e-8))
+        if initial_weights is not None:
+            state = tuple(jnp.asarray(t) for t in initial_weights)
+            if state[0].shape != (V, D):
+                raise ValueError(
+                    f"initial weights shaped {state[0].shape}, "
+                    f"vocab expects {(V, D)}")
+        else:
+            key = jax.random.key(cfg.seed)
+            k1, k2 = jax.random.split(key)
+            init = lambda k: (jax.random.uniform(k, (V, D)) - 0.5) / D
+            state = (init(k1), init(k2), jnp.zeros(V), jnp.zeros(V),
+                     jnp.full((V, D), 1e-8), jnp.full((V, D), 1e-8),
+                     jnp.full(V, 1e-8), jnp.full(V, 1e-8))
 
         B = min(cfg.batch_size, max(64, rows.size))
         rng = np.random.RandomState(cfg.seed)
@@ -161,6 +173,7 @@ class Glove:
                     state, jnp.asarray(rb), jnp.asarray(cb),
                     jnp.asarray(vb), m, alpha, cfg.x_max, cfg.weight_power)
             self.losses.append(float(loss))
+        self.state = state
         w, wt = state[0], state[1]
         self._wv = WordVectors(self.cache, w + wt)
         return self._wv

@@ -107,44 +107,21 @@ def _iris_conf():
             .pretrain(False).backward(True).build())
 
 
-class MLNPerformer(so.WorkerPerformer):
-    """BaseMultiLayerNetworkWorkPerformer parity: rebuild from conf JSON,
-    fit on the job's DataSet, ship params back; update() = set params."""
-
-    def __init__(self):
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        self.net = MultiLayerNetwork(_iris_conf()).init(seed=0)
-
-    def perform(self, job: Job) -> None:
-        self.net.fit_backprop(job.work, num_epochs=10)
-        job.result = self.net.params
-
-    def update(self, params) -> None:
-        self.net.params = params
-
-
-class ParamAverager(so.JobAggregator):
-    def __init__(self):
-        self.acc = so.WorkAccumulator()
-
-    def accumulate(self, job):
-        self.acc.accumulate(job)
-
-    def aggregate(self):
-        return self.acc.aggregate()
-
-    def reset(self):
-        self.acc.reset()
-
-
 def test_runner_trains_multilayer_network_param_averaging():
+    """Flagship workload through the LIBRARY performer (rebuild from conf
+    JSON, fit, ship params — BaseMultiLayerNetworkWorkPerformer parity)."""
+    from deeplearning4j_tpu.parallel.performers import (
+        MultiLayerNetworkPerformer, ParameterAveragingAggregator)
+
     f = IrisDataFetcher()
     f.fetch(150)
     data = f.next().normalize_zero_mean_unit_variance().shuffle(0)
     shards = data.batch_by(50)                   # 3 jobs of 50 examples
+    conf_json = _iris_conf().to_json()           # serialized conf, as shipped
     runner = so.DistributedRunner(
-        so.CollectionJobIterator(shards), MLNPerformer, ParamAverager(),
-        n_workers=3)
+        so.CollectionJobIterator(shards),
+        lambda: MultiLayerNetworkPerformer(conf_json, num_epochs=10),
+        ParameterAveragingAggregator(), n_workers=3)
     averaged = runner.run(timeout_s=120)
     assert averaged is not None
 
@@ -238,6 +215,28 @@ def test_distributed_word2vec_e2e():
     wv = train_word2vec_distributed(
         corpus, Word2VecConfig(vector_size=24, window=3, epochs=3,
                                seed=11, batch_size=256),
+        n_workers=2, n_shards=4, timeout_s=240)
+    assert wv.has_word("beach") and wv.has_word("cat")
+    related = wv.similarity("sand", "sea")
+    unrelated = wv.similarity("sand", "pets")
+    assert related > unrelated, (related, unrelated)
+
+
+def test_distributed_glove_e2e():
+    """DistributedGloveTest parity: sharded co-occurrence training through
+    the runner converges to usable vectors."""
+    from deeplearning4j_tpu.nlp.distributed import train_glove_distributed
+    from deeplearning4j_tpu.nlp.glove import GloveConfig
+
+    corpus = (["the beach has sand and sea",
+               "waves crash on the beach near the sea",
+               "sand and sea meet at the shore",
+               "the cat sat on the mat",
+               "the dog sat on the rug",
+               "cats and dogs are pets"] * 30)
+    wv = train_glove_distributed(
+        corpus, GloveConfig(vector_size=16, window=3, epochs=4,
+                            batch_size=512, seed=7),
         n_workers=2, n_shards=4, timeout_s=240)
     assert wv.has_word("beach") and wv.has_word("cat")
     related = wv.similarity("sand", "sea")
