@@ -26,6 +26,17 @@ from deeplearning4j_tpu.parallel import scaleout as so
 from deeplearning4j_tpu.parallel.coordinator import Job
 
 
+def shard_sentences(sentences: Sequence[str], n_shards: int
+                    ) -> List[List[str]]:
+    """Round-robin the corpus into at most ``n_shards`` non-empty shards
+    (the BatchActor partitioning step, shared by every distributed NLP
+    job)."""
+    shards: List[List[str]] = [[] for _ in range(n_shards)]
+    for i, s in enumerate(sentences):
+        shards[i % n_shards].append(s)
+    return [s for s in shards if s]
+
+
 class Word2VecPerformer(so.WorkerPerformer):
     """Trains the shared-vocab model on a job's sentence shard, starting
     from the current global tables; ships the trained tables back."""
@@ -93,17 +104,17 @@ def train_word2vec_distributed(sentences: Sequence[str],
     tokenizer = tokenizer or DefaultTokenizerFactory()
     cache = build_vocab(sentences, tokenizer, config.min_word_frequency)
 
-    n_shards = n_shards or n_workers
-    shards: List[List[str]] = [[] for _ in range(n_shards)]
-    for i, s in enumerate(sentences):
-        shards[i % n_shards].append(s)
-    shards = [s for s in shards if s]
-
+    shards = shard_sentences(sentences, n_shards or n_workers)
     runner = so.DistributedRunner(
         so.CollectionJobIterator(shards),
         lambda: Word2VecPerformer(cache, config, tokenizer),
         Word2VecJobAggregator(), n_workers=n_workers)
-    syn0, syn1, syn1neg = runner.run(timeout_s=timeout_s)
+    result = runner.run(timeout_s=timeout_s)
+    if result is None:
+        raise ValueError("no worker produced trained tables — every shard "
+                         "was empty of trainable pairs or every job was "
+                         "dropped after repeated failures")
+    syn0, syn1, syn1neg = result
     return WordVectors(cache, jnp.asarray(syn0))
 
 
@@ -122,42 +133,31 @@ class GlovePerformer(so.WorkerPerformer):
         self._current: Optional[Tuple] = None
 
     def perform(self, job: Job) -> None:
-        from deeplearning4j_tpu.nlp.glove import Glove
+        from deeplearning4j_tpu.nlp.glove import Glove, count_cooccurrences
 
         glove = Glove(job.work, self.config, self.tokenizer,
                       cache=self.cache)
-        glove.fit(initial_weights=self._current)
+        cooc = count_cooccurrences(job.work, self.tokenizer, self.cache,
+                                   self.config.window,
+                                   self.config.symmetric)
+        if cooc[0].size == 0:
+            # a shard can legitimately produce no co-occurrences (all its
+            # tokens below min frequency / single-token sentences); report
+            # an empty result instead of failing — a deterministic raise
+            # here would requeue forever and sink the whole run
+            job.result = None
+            return
+        glove.fit(initial_weights=self._current, cooccurrences=cooc)
         job.result = tuple(np.asarray(t) for t in glove.state)
 
     def update(self, current) -> None:
         self._current = current
 
 
-class GloveJobAggregator(so.JobAggregator):
-    """Running average of the 8-tuple GloVe state
-    (GloveJobAggregator.java parity)."""
-
-    def __init__(self):
-        self._sum = None
-        self._n = 0
-
-    def accumulate(self, job: Job) -> None:
-        if job.result is None:
-            return
-        self._n += 1
-        if self._sum is None:
-            self._sum = [t.copy() for t in job.result]
-        else:
-            self._sum = [a + b for a, b in zip(self._sum, job.result)]
-
-    def aggregate(self):
-        if self._sum is None:
-            return None
-        return tuple(t / self._n for t in self._sum)
-
-    def reset(self) -> None:
-        self._sum = None
-        self._n = 0
+class GloveJobAggregator(Word2VecJobAggregator):
+    """Running average of the 8-tuple GloVe state (GloveJobAggregator
+    .java parity).  The math is the word2vec aggregator's elementwise
+    table average — only the tuple arity differs."""
 
 
 def train_glove_distributed(sentences: Sequence[str],
@@ -176,15 +176,14 @@ def train_glove_distributed(sentences: Sequence[str],
     tokenizer = tokenizer or DefaultTokenizerFactory()
     cache = build_vocab(sentences, tokenizer, config.min_word_frequency)
 
-    n_shards = n_shards or n_workers
-    shards: List[List[str]] = [[] for _ in range(n_shards)]
-    for i, s in enumerate(sentences):
-        shards[i % n_shards].append(s)
-    shards = [s for s in shards if s]
-
+    shards = shard_sentences(sentences, n_shards or n_workers)
     runner = so.DistributedRunner(
         so.CollectionJobIterator(shards),
         lambda: GlovePerformer(cache, config, tokenizer),
         GloveJobAggregator(), n_workers=n_workers)
     state = runner.run(timeout_s=timeout_s)
+    if state is None:
+        raise ValueError("no worker produced trained tables — every shard "
+                         "had zero co-occurrences or every job was dropped "
+                         "after repeated failures")
     return WordVectors(cache, jnp.asarray(state[0]) + jnp.asarray(state[1]))

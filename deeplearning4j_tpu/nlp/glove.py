@@ -122,11 +122,16 @@ class Glove:
         self.state: Optional[Tuple] = None
         self.losses: list = []
 
-    def fit(self, initial_weights: Optional[Tuple] = None) -> WordVectors:
+    def fit(self, initial_weights: Optional[Tuple] = None,
+            cooccurrences: Optional[Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]] = None
+            ) -> WordVectors:
         """Train; ``initial_weights`` (an 8-tuple of w/w~/b/b~ tables plus
         their AdaGrad accumulators, as produced in ``self.state``) warm-
         starts from a previous or globally-averaged state — the hook the
-        distributed GloVe performer uses (GlovePerformer.java parity)."""
+        distributed GloVe performer uses (GlovePerformer.java parity).
+        ``cooccurrences`` = precomputed (rows, cols, counts) COO triples;
+        when given, the counting pass is skipped."""
         cfg = self.config
         if self.cache is None:
             self.cache = build_vocab(self.sentences, self.tokenizer,
@@ -134,14 +139,20 @@ class Glove:
         V, D = len(self.cache), cfg.vector_size
         if V == 0:
             raise ValueError("empty vocabulary")
-        rows, cols, x = count_cooccurrences(
-            self.sentences, self.tokenizer, self.cache, cfg.window,
-            cfg.symmetric)
+        if cooccurrences is None:
+            cooccurrences = count_cooccurrences(
+                self.sentences, self.tokenizer, self.cache, cfg.window,
+                cfg.symmetric)
+        rows, cols, x = cooccurrences
         if rows.size == 0:
             raise ValueError("no co-occurrences")
 
         if initial_weights is not None:
-            state = tuple(jnp.asarray(t) for t in initial_weights)
+            # jnp.array (copy), NOT asarray: _glove_step donates its state
+            # argument, so a no-copy view of the caller's arrays would be
+            # deleted by donation on the first step, corrupting the state
+            # tuple the caller warm-started from
+            state = tuple(jnp.array(t) for t in initial_weights)
             if state[0].shape != (V, D):
                 raise ValueError(
                     f"initial weights shaped {state[0].shape}, "

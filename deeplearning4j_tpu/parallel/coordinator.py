@@ -25,10 +25,12 @@ from typing import Any, Dict, List, Optional
 
 @dataclasses.dataclass
 class Job:
-    """Unit of distributable work (scaleout/job/Job.java:24 parity)."""
+    """Unit of distributable work (scaleout/job/Job.java:24 parity).
+    ``retries`` counts requeues after worker failure/death."""
     work: Any
     worker_id: str = ""
     result: Any = None
+    retries: int = 0
 
 
 @dataclasses.dataclass
@@ -46,7 +48,8 @@ class StateTracker:
     worker_enabled:182, increment/count:52-54.
     """
 
-    def __init__(self, stale_after_s: float = 120.0):
+    def __init__(self, stale_after_s: float = 120.0,
+                 max_job_retries: int = 5):
         self._lock = threading.RLock()
         self._workers: Dict[str, WorkerRecord] = {}
         self._jobs: Dict[str, Job] = {}
@@ -55,7 +58,20 @@ class StateTracker:
         self._current: Any = None
         self._counters: Dict[str, int] = {}
         self._needs_replicate: Dict[str, bool] = {}
+        self._done = False
         self.stale_after_s = stale_after_s
+        self.max_job_retries = max_job_retries
+
+    # -- run lifecycle (ShutdownMessage parity) -----------------------------
+    def set_done(self, done: bool = True) -> None:
+        """Master broadcasts end-of-run; polling workers exit their loop
+        (the reference's ShutdownMessage / FinishMessage)."""
+        with self._lock:
+            self._done = done
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return self._done
 
     # -- worker registry + heartbeats --------------------------------------
     def add_worker(self, worker_id: str) -> None:
@@ -63,10 +79,15 @@ class StateTracker:
             self._workers[worker_id] = WorkerRecord(worker_id, time.time())
             self._needs_replicate[worker_id] = True
 
-    def heartbeat(self, worker_id: str) -> None:
+    def heartbeat(self, worker_id: str) -> bool:
+        """Record liveness.  Returns False for an unknown worker (e.g.
+        one the reaper removed) so the caller can re-register — the Akka
+        cluster-membership re-join (WorkerActor.preStart:280-283)."""
         with self._lock:
             if worker_id in self._workers:
                 self._workers[worker_id].last_heartbeat = time.time()
+                return True
+            return False
 
     def heartbeats(self) -> Dict[str, float]:
         with self._lock:
@@ -126,11 +147,21 @@ class StateTracker:
 
     def _requeue_locked(self, worker_id: str) -> None:
         """Requeue body; caller must hold the lock.  Resets any partial
-        result so the next worker starts the job clean."""
+        result so the next worker starts the job clean.  A job that keeps
+        failing is DROPPED after ``max_job_retries`` requeues (counter
+        ``jobs_dropped``) — otherwise one deterministically-failing job
+        (bad shard, poisoned input) requeues forever, ``has_pending``
+        never clears, and the whole run times out discarding every
+        healthy worker's results."""
         job = self._jobs.pop(worker_id, None)
         if job is not None:
             job.worker_id = ""
             job.result = None
+            job.retries += 1
+            if job.retries > self.max_job_retries:
+                self._counters["jobs_dropped"] = (
+                    self._counters.get("jobs_dropped", 0) + 1)
+                return
             self._pending.append(job)
 
     def requeue(self, worker_id: str) -> None:
@@ -169,6 +200,25 @@ class StateTracker:
     def add_update(self, worker_id: str, job: Job) -> None:
         with self._lock:
             self._updates.append(job)
+
+    def complete_job(self, worker_id: str, job: Job) -> bool:
+        """Atomically post the result, clear the assignment, and count the
+        completion — IF the worker still owns a job.  Closes both
+        double-count windows: a worker dying between separate
+        add_update/clear_job calls, and a slow-but-alive worker whose job
+        the reaper already requeued to a peer (its late result is
+        discarded here, since the peer's recompute is the one that
+        counts).  Returns False when the update was discarded as stale."""
+        with self._lock:
+            if worker_id not in self._jobs:
+                self._counters["updates_discarded"] = (
+                    self._counters.get("updates_discarded", 0) + 1)
+                return False
+            self._updates.append(job)
+            del self._jobs[worker_id]
+            self._counters["jobs_done"] = (
+                self._counters.get("jobs_done", 0) + 1)
+            return True
 
     def updates(self) -> List[Job]:
         with self._lock:

@@ -231,6 +231,71 @@ class HogWildWorkRouter(WorkRouter):
 
 
 # ---------------------------------------------------------------------------
+# Master pump (MasterActor steady-state loop, §3.2) — shared by the
+# in-process runner below and the multi-process runner in transport.py
+# ---------------------------------------------------------------------------
+
+def master_pump(tracker: StateTracker, jobs: JobIterator,
+                aggregator: JobAggregator, router: WorkRouter,
+                n_slots: Callable[[], int], poll: float,
+                timeout_s: float, reap: bool = False) -> Any:
+    """Drive the reference's master loop (MasterActor.java:104-137):
+    collect results, publish round aggregates, push new work, optionally
+    reap stale workers (requeueing their in-flight jobs, :139-169).
+
+    ``n_slots`` is how many jobs one "round" may hold — the worker count,
+    read per-iteration because multi-process workers join (and die)
+    dynamically.  Synchronous routers REPLACE the current value with each
+    round's aggregate (IterativeReduce); async routers fold updates in as
+    they arrive (HogWild).
+    """
+    deadline = time.time() + timeout_s
+    sync = router.synchronous_rounds
+    round_jobs: List[Job] = []
+
+    def publish(jobs_done: List[Job]) -> None:
+        if not jobs_done:
+            return
+        if sync:
+            aggregator.reset()
+        for job in jobs_done:
+            aggregator.accumulate(job)
+        agg = aggregator.aggregate()
+        if agg is not None:
+            tracker.set_current(agg)
+
+    while time.time() < deadline:
+        if reap:
+            removed = tracker.remove_stale_workers()
+            if removed:
+                log.warning("reaped stale workers %s; jobs requeued",
+                            removed)
+                tracker.increment("workers_reaped", len(removed))
+        # 1) collect results; sync publishes only at the round boundary,
+        #    async as soon as anything arrived
+        round_jobs.extend(tracker.drain_updates())
+        if round_jobs and (not sync or not tracker.has_pending()):
+            publish(round_jobs)
+            round_jobs = []
+        # 2) only then push new work — never start round N+1 while round
+        #    N results are drained-but-unpublished
+        if jobs.has_next():
+            if router.send_work() and not (sync and round_jobs):
+                for _ in range(max(1, n_slots())):
+                    if not jobs.has_next():
+                        break
+                    tracker.add_job(jobs.next(""))
+        elif not tracker.has_pending() and not round_jobs:
+            break
+        time.sleep(poll)
+    else:
+        raise TimeoutError("distributed run did not finish")
+    round_jobs.extend(tracker.drain_updates())
+    publish(round_jobs)
+    return tracker.get_current()
+
+
+# ---------------------------------------------------------------------------
 # In-process distributed runner (§2.3 topology, §3.2 steady-state loop)
 # ---------------------------------------------------------------------------
 
@@ -286,9 +351,7 @@ class DistributedRunner:
                 self.tracker.requeue(worker_id)
                 self.tracker.increment("jobs_failed")
                 continue
-            self.tracker.add_update(worker_id, job)
-            self.tracker.clear_job(worker_id)
-            self.tracker.increment("jobs_done")
+            self.tracker.complete_job(worker_id, job)
 
     # -- master loop (MasterActor 1s pump :104-137 parity) -----------------
     def run(self, timeout_s: float = 60.0) -> Any:
@@ -297,53 +360,10 @@ class DistributedRunner:
                    for i in range(self.n_workers)]
         for w in workers:
             w.start()
-
-        deadline = time.time() + timeout_s
-        sync = self.router.synchronous_rounds
-        round_jobs: List[Job] = []
-
-        def publish(jobs_done: List[Job]) -> None:
-            """Fold finished jobs into the global state.  Synchronous
-            rounds REPLACE current with the round aggregate (the
-            reference's IterativeReduce); async folds incrementally."""
-            if not jobs_done:
-                return
-            if sync:
-                self.aggregator.reset()
-            for job in jobs_done:
-                self.aggregator.accumulate(job)
-            agg = self.aggregator.aggregate()
-            if agg is not None:
-                self.tracker.set_current(agg)
-
         try:
-            while time.time() < deadline:
-                # 1) collect results; sync publishes only at the round
-                #    boundary, async as soon as anything arrived
-                round_jobs.extend(self.tracker.drain_updates())
-                if round_jobs and (not sync
-                                   or not self.tracker.has_pending()):
-                    publish(round_jobs)
-                    round_jobs = []
-                # 2) only then push new work — never start round N+1 while
-                #    round N results are drained-but-unpublished
-                if self.jobs.has_next():
-                    if self.router.send_work() and not (sync and round_jobs):
-                        # a "round" = up to one job per worker; the
-                        # IterativeReduce router waits for the round to
-                        # drain, HogWild pushes unconditionally
-                        for _ in range(self.n_workers):
-                            if not self.jobs.has_next():
-                                break
-                            self.tracker.add_job(self.jobs.next(""))
-                elif not self.tracker.has_pending() and not round_jobs:
-                    break
-                time.sleep(self.poll)
-            else:
-                raise TimeoutError("distributed run did not finish")
-            round_jobs.extend(self.tracker.drain_updates())
-            publish(round_jobs)
-            return self.tracker.get_current()
+            return master_pump(self.tracker, self.jobs, self.aggregator,
+                               self.router, lambda: self.n_workers,
+                               self.poll, timeout_s)
         finally:
             self._stop.set()
             for w in workers:

@@ -1,0 +1,432 @@
+"""Cross-process control plane: a socket-served StateTracker and a
+multi-process distributed runner.
+
+Reference parity: the Akka runtime's control plane spans OS processes and
+machines — workers join a master by connection string and share job/param
+state through an embedded Hazelcast server
+(``DeepLearning4jDistributed.java:205,301-315``,
+``BaseHazelCastStateTracker.java:495-562`` — server or client mode chosen
+by the connection string).  Here the same split:
+
+- ``StateTrackerServer`` — *embedded server mode*: hosts the real
+  in-process :class:`StateTracker` and serves its method surface over a
+  length-prefixed pickle RPC on a TCP socket.  The master process uses
+  the tracker object directly; remote workers dial in.
+- ``RemoteStateTracker`` — *client mode*: same method surface, every call
+  forwarded over the socket, so ``worker_main`` below and
+  ``DistributedRunner``'s worker loop are written against one API.
+- ``worker_main`` — the worker-process entry point (WorkerActor parity):
+  registers, starts a heartbeat thread (the YARN worker pattern,
+  ``ApplicationWorkerService.java:83-95``), polls ``job_for``, replicates
+  current params when flagged, performs, posts updates; exits when the
+  master sets the done flag (ShutdownMessage parity).
+- ``MultiProcessRunner`` — ``DeepLearning4jDistributed`` parity: embeds
+  the server, spawns N worker processes (or lets external ones join via
+  the connection string), drives the shared ``master_pump`` with stale-
+  worker reaping ON (a killed worker's heartbeats stop; the reaper
+  requeues its in-flight job — MasterActor.java:139-169).
+
+The performer reaches worker processes as a *spec*, not an object: a
+``"module:callable"`` string plus pickled constructor args — the analog
+of the reference's reflective ``WorkerPerformerFactory.WORKER_PERFORMER``
+class-name config key.
+
+Trust model: pickle over TCP, bound to localhost by default — the same
+trusted-cluster assumption as the reference's Java serialization over
+Akka remoting.  Do not expose the port to untrusted networks.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import multiprocessing
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+from deeplearning4j_tpu.parallel.coordinator import StateTracker
+from deeplearning4j_tpu.parallel.scaleout import (
+    IterativeReduceWorkRouter, JobAggregator, JobIterator, WorkerPerformer,
+    master_pump)
+
+log = logging.getLogger(__name__)
+
+# The tracker surface served over the wire.  Everything the worker loop
+# and the pump need; underscore methods stay private to the process.
+_TRACKER_METHODS = frozenset({
+    "add_worker", "heartbeat", "heartbeats", "workers",
+    "remove_stale_workers", "worker_enabled", "enable_worker",
+    "add_job", "job_for", "clear_job", "requeue", "has_pending",
+    "set_current", "get_current", "needs_replicate", "done_replicating",
+    "add_update", "complete_job", "updates", "drain_updates",
+    "increment", "count", "set_done", "is_done",
+})
+
+
+# ---------------------------------------------------------------------------
+# Wire format: 4-byte big-endian length + pickle
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+# ---------------------------------------------------------------------------
+# Server (embedded mode)
+# ---------------------------------------------------------------------------
+
+class _TrackerRequestHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        sock = self.request
+        while True:
+            try:
+                frame = _recv_frame(sock)
+            except (EOFError, ConnectionError, OSError):
+                return                       # client went away (or died)
+            try:
+                name, args, kwargs = pickle.loads(frame)
+                if name not in _TRACKER_METHODS:
+                    raise AttributeError(f"no tracker method {name!r}")
+                result = getattr(self.server.tracker, name)(*args, **kwargs)
+                reply = (True, result)
+            except Exception as exc:  # noqa: BLE001 — forwarded to client
+                reply = (False, exc)
+            try:
+                blob = pickle.dumps(reply)
+            except Exception:                # unpicklable payload/exception
+                blob = pickle.dumps((False, RuntimeError(repr(reply[1]))))
+            try:
+                _send_frame(sock, blob)
+            except (ConnectionError, OSError):
+                return
+
+
+class _TrackerTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, tracker: StateTracker):
+        super().__init__(addr, _TrackerRequestHandler)
+        self.tracker = tracker
+
+
+class StateTrackerServer:
+    """Serve a StateTracker on a TCP port (Hazelcast embedded-server-mode
+    parity).  The hosting process keeps using ``self.tracker`` directly;
+    remote processes connect with :class:`RemoteStateTracker` via
+    ``connection_string``."""
+
+    def __init__(self, tracker: Optional[StateTracker] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.tracker = tracker or StateTracker()
+        self._server = _TrackerTCPServer((host, port), self.tracker)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def connection_string(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "StateTrackerServer":
+        if self._thread is not None and self._thread.is_alive():
+            return self                      # idempotent: already serving
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name="state-tracker-server")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "StateTrackerServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Client (worker mode)
+# ---------------------------------------------------------------------------
+
+class RemoteStateTracker:
+    """StateTracker proxy over a socket: the client-mode counterpart of
+    ``StateTrackerServer`` with the identical method surface (generated
+    below from ``_TRACKER_METHODS``), safe for concurrent use from the
+    worker loop and its heartbeat thread."""
+
+    def __init__(self, connection_string: str, timeout_s: float = 60.0):
+        host, _, port = connection_string.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout_s)
+        self._lock = threading.Lock()
+
+    def _call(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        with self._lock:
+            _send_frame(self._sock, pickle.dumps((name, args, kwargs)))
+            ok, value = pickle.loads(_recv_frame(self._sock))
+        if not ok:
+            raise value
+        return value
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "RemoteStateTracker":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _make_proxy(name: str):
+    def proxy(self, *args, **kwargs):
+        return self._call(name, *args, **kwargs)
+    proxy.__name__ = name
+    proxy.__qualname__ = f"RemoteStateTracker.{name}"
+    proxy.__doc__ = f"Forward ``{name}`` to the remote StateTracker."
+    return proxy
+
+
+for _name in sorted(_TRACKER_METHODS):
+    setattr(RemoteStateTracker, _name, _make_proxy(_name))
+del _name
+
+
+# ---------------------------------------------------------------------------
+# Performer specs (reflective WORKER_PERFORMER parity)
+# ---------------------------------------------------------------------------
+
+PerformerSpec = Union[str, Tuple[str, tuple, dict],
+                      Callable[[], WorkerPerformer]]
+
+
+def resolve_performer_factory(spec: PerformerSpec
+                              ) -> Callable[[], WorkerPerformer]:
+    """``"module:callable"`` or ``("module:callable", args, kwargs)`` →
+    zero-arg factory.  A plain callable passes through (in-process use).
+    String specs are what cross the process boundary — the analog of the
+    reference's ``WORKER_PERFORMER`` class-name key resolved reflectively
+    (BaseWorkPerformerFactory parity)."""
+    if callable(spec):
+        return spec
+    if isinstance(spec, tuple):
+        path, args, kwargs = spec
+    else:
+        path, args, kwargs = spec, (), {}
+    module, sep, attr = path.partition(":")
+    if not sep or not attr:
+        raise ValueError(f"performer spec {path!r} is not 'module:callable'")
+    obj = importlib.import_module(module)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return lambda: obj(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Worker process entry point (WorkerActor parity)
+# ---------------------------------------------------------------------------
+
+def _fix_child_platform() -> None:
+    """A sitecustomize may pre-import jax pinned to the hardware plugin in
+    EVERY new interpreter — including spawned workers.  If the parent
+    chose a platform via JAX_PLATFORMS (the conftest/run_cpu pattern),
+    honor it here before the performer touches a backend."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and "jax" in sys.modules:
+        import jax
+        jax.config.update("jax_platforms", want)
+
+
+def worker_main(connection_string: str, performer_spec: PerformerSpec,
+                worker_id: Optional[str] = None,
+                poll_interval_s: float = 0.01,
+                heartbeat_interval_s: Optional[float] = None) -> None:
+    """Run one worker process against a remote tracker until the master
+    sets the done flag.  The loop is the reference's
+    WorkerActor.checkJobAvailable:287 — poll ``job_for``, replicate
+    current params if flagged, perform, ``add_update`` — plus the YARN
+    worker's dedicated heartbeat thread so a long ``perform`` doesn't
+    look stale, while a killed process stops heartbeating and gets its
+    job requeued by the master's reaper."""
+    _fix_child_platform()
+    worker_id = worker_id or f"worker-{os.getpid()}"
+    tracker = RemoteStateTracker(connection_string)
+    performer = resolve_performer_factory(performer_spec)()
+    tracker.add_worker(worker_id)
+
+    if heartbeat_interval_s is None:
+        heartbeat_interval_s = 0.25
+    stop_beat = threading.Event()
+    # The heartbeat gets its OWN connection: the main loop's socket is
+    # held for a full RPC round-trip, so a large add_update (MLN params)
+    # would otherwise block heartbeats past the stale threshold and get a
+    # healthy worker reaped mid-report.
+    beat_tracker = RemoteStateTracker(connection_string)
+
+    def beat() -> None:
+        while not stop_beat.is_set():
+            try:
+                if not beat_tracker.heartbeat(worker_id):
+                    # reaped (e.g. a long GC-like stall) but still alive:
+                    # re-join, the Akka MemberEvent re-register
+                    beat_tracker.add_worker(worker_id)
+            except Exception:
+                return                        # master gone; main loop exits
+            stop_beat.wait(heartbeat_interval_s)
+
+    beater = threading.Thread(target=beat, daemon=True, name="heartbeat")
+    beater.start()
+    try:
+        while not tracker.is_done():
+            job = tracker.job_for(worker_id)
+            if job is None:
+                time.sleep(poll_interval_s)
+                continue
+            if tracker.needs_replicate(worker_id):
+                current = tracker.get_current()
+                if current is not None:
+                    performer.update(current)
+                tracker.done_replicating(worker_id)
+            try:
+                performer.perform(job)
+            except Exception:
+                log.exception("worker %s failed job; requeueing", worker_id)
+                tracker.requeue(worker_id)
+                tracker.increment("jobs_failed")
+                continue
+            tracker.complete_job(worker_id, job)
+    except (EOFError, ConnectionError, OSError):
+        log.warning("worker %s lost the tracker connection; exiting",
+                    worker_id)
+    finally:
+        stop_beat.set()
+        tracker.close()
+        beat_tracker.close()
+
+
+# ---------------------------------------------------------------------------
+# Multi-process runner (DeepLearning4jDistributed parity)
+# ---------------------------------------------------------------------------
+
+class MultiProcessRunner:
+    """Master pump + N worker *processes* over a socket-served tracker.
+
+    The master embeds the tracker server (Hazelcast embedded-server
+    parity) and runs the same ``master_pump`` as the in-process runner,
+    with the stale-worker reaper ON: when a worker process dies mid-job,
+    its heartbeats stop, the reaper drops it and requeues the job, and a
+    surviving worker completes the work — the fault-tolerance loop of
+    MasterActor.java:139-169.
+
+    External workers (other hosts in a real deployment) can also join by
+    running ``worker_main(connection_string, spec)`` — spawning here is a
+    convenience for tests and single-host runs, exactly the role of the
+    reference's in-process BaseTestDistributed bring-up.
+
+    Worker processes use the ``spawn`` start method, so a script driving
+    this runner must be importable: wrap the driving code in the standard
+    ``if __name__ == "__main__":`` guard.
+    """
+
+    def __init__(self, job_iterator: JobIterator,
+                 performer_spec: PerformerSpec,
+                 aggregator: JobAggregator,
+                 n_workers: int = 2,
+                 router_cls=IterativeReduceWorkRouter,
+                 stale_after_s: float = 2.0,
+                 poll_interval_s: float = 0.01,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.tracker = StateTracker(stale_after_s=stale_after_s)
+        self.server = StateTrackerServer(self.tracker, host=host, port=port)
+        self.jobs = job_iterator
+        self.performer_spec = performer_spec
+        self.aggregator = aggregator
+        self.router = router_cls(self.tracker)
+        self.n_workers = n_workers
+        self.poll = poll_interval_s
+        self.processes: List[multiprocessing.process.BaseProcess] = []
+
+    @property
+    def connection_string(self) -> str:
+        return self.server.connection_string
+
+    def spawn_workers(self, n: Optional[int] = None) -> None:
+        """Start worker processes against this runner's tracker.  Uses
+        the ``spawn`` start method: a fresh interpreter per worker, no
+        inherited JAX backend state (fork would copy a live XLA client)."""
+        ctx = multiprocessing.get_context("spawn")
+        base = len(self.processes)
+        for i in range(self.n_workers if n is None else n):
+            p = ctx.Process(
+                target=worker_main,
+                args=(self.connection_string, self.performer_spec),
+                kwargs={"worker_id": f"proc-worker-{base + i}",
+                        "poll_interval_s": self.poll},
+                daemon=True, name=f"proc-worker-{base + i}")
+            p.start()
+            self.processes.append(p)
+
+    def _wait_for_workers(self, n: int, timeout_s: float) -> None:
+        """Barrier until ``n`` workers registered (cluster-join parity:
+        the reference master waits for worker cluster membership)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if len(self.tracker.workers()) >= n:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"only {len(self.tracker.workers())}/{n} workers joined "
+            f"within {timeout_s}s")
+
+    def run(self, timeout_s: float = 120.0, min_workers: Optional[int] = None,
+            spawn: bool = True, join_timeout_s: float = 30.0) -> Any:
+        self.server.start()
+        try:
+            if spawn:
+                self.spawn_workers()
+            self._wait_for_workers(
+                self.n_workers if min_workers is None else min_workers,
+                timeout_s=min(timeout_s, join_timeout_s))
+            return master_pump(
+                self.tracker, self.jobs, self.aggregator, self.router,
+                n_slots=lambda: max(1, len(self.tracker.workers())),
+                poll=self.poll, timeout_s=timeout_s, reap=True)
+        finally:
+            self.tracker.set_done()
+            for p in self.processes:
+                p.join(timeout=join_timeout_s)
+            for p in self.processes:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=5)
+            self.server.shutdown()

@@ -242,3 +242,77 @@ def test_distributed_glove_e2e():
     related = wv.similarity("sand", "sea")
     unrelated = wv.similarity("sand", "pets")
     assert related > unrelated, (related, unrelated)
+
+
+def test_poisoned_job_dropped_after_retry_cap():
+    """A job that fails deterministically must not requeue forever: after
+    max_job_retries it is dropped (counted) and the run completes with
+    the healthy jobs' results."""
+    class PoisonPerformer(so.WorkerPerformer):
+        def perform(self, job):
+            if job.work == 13.0:
+                raise RuntimeError("always fails")
+            job.result = 2.0 * job.work
+
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator([1.0, 13.0, 3.0]),
+        PoisonPerformer, MeanAggregator(), n_workers=2,
+        router_cls=so.HogWildWorkRouter)
+    runner.tracker.max_job_retries = 3
+    result = runner.run(timeout_s=30)
+    assert result == pytest.approx((2.0 + 6.0) / 2)
+    assert runner.tracker.count("jobs_done") == 2
+    assert runner.tracker.count("jobs_dropped") == 1
+    assert runner.tracker.count("jobs_failed") == 4   # 1 try + 3 retries
+
+
+def test_glove_performer_tolerates_empty_shard():
+    """A shard with no co-occurrences reports an empty result rather than
+    raising (which would requeue the job until the retry cap)."""
+    from deeplearning4j_tpu.nlp.distributed import GlovePerformer
+    from deeplearning4j_tpu.nlp.glove import GloveConfig
+    from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+    from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+    tok = DefaultTokenizerFactory()
+    cache = build_vocab(["alpha beta gamma delta"], tok, 1)
+    p = GlovePerformer(cache, GloveConfig(vector_size=8), tok)
+    job = Job(work=["zzz"])                     # no vocab tokens → no pairs
+    p.perform(job)
+    assert job.result is None
+
+
+def test_complete_job_discards_stale_update():
+    """A slow worker whose job was reaped+requeued must not double-count:
+    its late complete_job is discarded; the peer's completion wins."""
+    t = StateTracker(stale_after_s=0.0)
+    t.add_worker("slow")
+    t.add_job(Job(work="x"))
+    job = t.job_for("slow")
+    t.remove_stale_workers()                     # reaper requeues "x"
+    assert not t.complete_job("slow", job)       # late result: discarded
+    assert t.count("updates_discarded") == 1
+    assert t.count("jobs_done") == 0
+    assert t.drain_updates() == []
+
+    t.add_worker("peer")
+    again = t.job_for("peer")
+    assert t.complete_job("peer", again)
+    assert t.count("jobs_done") == 1
+    assert len(t.drain_updates()) == 1
+
+
+def test_glove_warm_start_preserves_source_state():
+    """fit(initial_weights=other.state) must not invalidate the source
+    arrays (the jitted step donates its buffers; the warm start copies)."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp.glove import Glove, GloveConfig
+
+    corpus = ["the cat sat on the mat", "the dog sat on the rug"] * 10
+    a = Glove(corpus, GloveConfig(vector_size=8, epochs=1, batch_size=128))
+    a.fit()
+    b = Glove(corpus, GloveConfig(vector_size=8, epochs=1, batch_size=128),
+              cache=a.cache)
+    b.fit(initial_weights=a.state)
+    # source state still readable (not donated away)
+    assert np.isfinite(np.asarray(a.state[0])).all()

@@ -1,0 +1,55 @@
+"""Performer workloads for the multi-process runner tests.
+
+Module-level so spawned worker processes can import them from a
+``"module:callable"`` performer spec — the cross-process analog of the
+reference's TestPerformer fake workload (BaseTestDistributed pattern).
+"""
+
+import os
+
+from deeplearning4j_tpu.parallel.coordinator import Job
+from deeplearning4j_tpu.parallel.scaleout import WorkerPerformer
+
+
+class SquarePerformer(WorkerPerformer):
+    """Fake workload: result = work**2."""
+
+    def perform(self, job: Job) -> None:
+        job.result = float(job.work) ** 2
+
+
+class CrashOncePerformer(WorkerPerformer):
+    """Kills its WHOLE PROCESS (no exception handling possible) the first
+    time it sees the poison job, so recovery must come from the master's
+    stale-worker reaper.  A marker file makes the crash once-only: the
+    retry — necessarily in a different process — completes the job."""
+
+    def __init__(self, marker_path: str, poison: float = 13.0):
+        self.marker_path = marker_path
+        self.poison = poison
+
+    def perform(self, job: Job) -> None:
+        if float(job.work) == self.poison and not os.path.exists(
+                self.marker_path):
+            with open(self.marker_path, "w") as f:
+                f.write("crashed")
+            os._exit(3)                      # simulated hard worker death
+        job.result = float(job.work) ** 2
+
+
+class CollectSetAggregator:
+    """Async-router aggregator: the union of every result seen (never
+    reset), so tests can assert exactly which jobs completed."""
+
+    def __init__(self):
+        self.seen = set()
+
+    def accumulate(self, job) -> None:
+        if job.result is not None:
+            self.seen.add(job.result)
+
+    def aggregate(self):
+        return sorted(self.seen) if self.seen else None
+
+    def reset(self) -> None:
+        pass
