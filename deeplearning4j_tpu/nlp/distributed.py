@@ -13,7 +13,10 @@ parameter-averages the (syn0, syn1, syn1neg) tables.
 
 from __future__ import annotations
 
+import logging
 from typing import Iterable, List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
 
 import jax
 import numpy as np
@@ -110,12 +113,21 @@ def train_word2vec_distributed(sentences: Sequence[str],
         lambda: Word2VecPerformer(cache, config, tokenizer),
         Word2VecJobAggregator(), n_workers=n_workers)
     result = runner.run(timeout_s=timeout_s)
+    _warn_dropped(runner)
     if result is None:
         raise ValueError("no worker produced trained tables — every shard "
                          "was empty of trainable pairs or every job was "
                          "dropped after repeated failures")
     syn0, syn1, syn1neg = result
     return WordVectors(cache, jnp.asarray(syn0))
+
+
+def _warn_dropped(runner: "so.DistributedRunner") -> None:
+    """Partial results are a quality change, not just a counter: say so."""
+    dropped = runner.tracker.count("jobs_dropped")
+    if dropped:
+        log.warning("%d shard job(s) were dropped after repeated failures; "
+                    "the returned vectors exclude that data", dropped)
 
 
 class GlovePerformer(so.WorkerPerformer):
@@ -182,6 +194,7 @@ def train_glove_distributed(sentences: Sequence[str],
         lambda: GlovePerformer(cache, config, tokenizer),
         GloveJobAggregator(), n_workers=n_workers)
     state = runner.run(timeout_s=timeout_s)
+    _warn_dropped(runner)
     if state is None:
         raise ValueError("no worker produced trained tables — every shard "
                          "had zero co-occurrences or every job was dropped "

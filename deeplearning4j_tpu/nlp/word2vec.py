@@ -204,11 +204,15 @@ class Word2Vec:
         if len(self.cache) == 0:
             raise ValueError("empty vocabulary")
         if initial_weights is not None:
+            # jnp.array (copy), NOT asarray: the jitted steps donate their
+            # table arguments, so a no-copy view of the caller's arrays
+            # would be deleted by donation on the first step, corrupting
+            # the state the caller warm-started from
             self.syn0, self.syn1, self.syn1neg = (
-                jnp.asarray(initial_weights[0]),
-                jnp.asarray(initial_weights[1]),
+                jnp.array(initial_weights[0]),
+                jnp.array(initial_weights[1]),
                 None if initial_weights[2] is None
-                else jnp.asarray(initial_weights[2]))
+                else jnp.array(initial_weights[2]))
         else:
             self._reset_weights()
         codes_t, points_t, lengths_t = encode_hs_tables(self.cache)

@@ -18,9 +18,12 @@ callers.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -161,6 +164,10 @@ class StateTracker:
             if job.retries > self.max_job_retries:
                 self._counters["jobs_dropped"] = (
                     self._counters.get("jobs_dropped", 0) + 1)
+                log.warning(
+                    "dropping job after %d failed attempts; its work is "
+                    "EXCLUDED from the aggregate (check jobs_dropped)",
+                    job.retries)
                 return
             self._pending.append(job)
 

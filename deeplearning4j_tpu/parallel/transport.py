@@ -31,9 +31,11 @@ The performer reaches worker processes as a *spec*, not an object: a
 of the reference's reflective ``WorkerPerformerFactory.WORKER_PERFORMER``
 class-name config key.
 
-Trust model: pickle over TCP, bound to localhost by default — the same
-trusted-cluster assumption as the reference's Java serialization over
-Akka remoting.  Do not expose the port to untrusted networks.
+Wire layer: stdlib ``multiprocessing.connection`` — length-prefixed
+pickle over TCP with HMAC challenge-response authentication (a shared
+``authkey``), so unauthenticated peers cannot deliver pickles.  Within
+that authenticated channel the trust model matches the reference's Java
+serialization over Akka remoting: peers holding the key are trusted.
 """
 
 from __future__ import annotations
@@ -42,13 +44,11 @@ import importlib
 import logging
 import multiprocessing
 import os
-import pickle
-import socket
-import socketserver
-import struct
+import secrets
 import sys
 import threading
 import time
+from multiprocessing.connection import Client, Connection, Listener
 from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 from deeplearning4j_tpu.parallel.coordinator import StateTracker
@@ -71,98 +71,91 @@ _TRACKER_METHODS = frozenset({
 
 
 # ---------------------------------------------------------------------------
-# Wire format: 4-byte big-endian length + pickle
+# Server (embedded mode) — wire layer is stdlib multiprocessing.connection:
+# length-prefixed pickle over TCP with HMAC challenge-response auth, so an
+# unauthenticated peer can never deliver a pickle to this process.
 # ---------------------------------------------------------------------------
-
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack("!I", len(payload)) + payload)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise EOFError("peer closed connection")
-        buf += chunk
-    return buf
-
-
-def _recv_frame(sock: socket.socket) -> bytes:
-    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
-    return _recv_exact(sock, n)
-
-
-# ---------------------------------------------------------------------------
-# Server (embedded mode)
-# ---------------------------------------------------------------------------
-
-class _TrackerRequestHandler(socketserver.BaseRequestHandler):
-    def handle(self) -> None:
-        sock = self.request
-        while True:
-            try:
-                frame = _recv_frame(sock)
-            except (EOFError, ConnectionError, OSError):
-                return                       # client went away (or died)
-            try:
-                name, args, kwargs = pickle.loads(frame)
-                if name not in _TRACKER_METHODS:
-                    raise AttributeError(f"no tracker method {name!r}")
-                result = getattr(self.server.tracker, name)(*args, **kwargs)
-                reply = (True, result)
-            except Exception as exc:  # noqa: BLE001 — forwarded to client
-                reply = (False, exc)
-            try:
-                blob = pickle.dumps(reply)
-            except Exception:                # unpicklable payload/exception
-                blob = pickle.dumps((False, RuntimeError(repr(reply[1]))))
-            try:
-                _send_frame(sock, blob)
-            except (ConnectionError, OSError):
-                return
-
-
-class _TrackerTCPServer(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
-    daemon_threads = True
-
-    def __init__(self, addr, tracker: StateTracker):
-        super().__init__(addr, _TrackerRequestHandler)
-        self.tracker = tracker
-
 
 class StateTrackerServer:
     """Serve a StateTracker on a TCP port (Hazelcast embedded-server-mode
     parity).  The hosting process keeps using ``self.tracker`` directly;
     remote processes connect with :class:`RemoteStateTracker` via
-    ``connection_string``."""
+    ``connection_string`` + the shared ``authkey``."""
 
     def __init__(self, tracker: Optional[StateTracker] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 authkey: Optional[bytes] = None):
         self.tracker = tracker or StateTracker()
-        self._server = _TrackerTCPServer((host, port), self.tracker)
-        self._thread: Optional[threading.Thread] = None
+        self.authkey = authkey if authkey is not None else (
+            secrets.token_bytes(16))
+        self._listener = Listener((host, port), authkey=self.authkey)
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: List[threading.Thread] = []
+        self._closing = False
 
     @property
     def connection_string(self) -> str:
-        host, port = self._server.server_address[:2]
+        host, port = self._listener.address[:2]
         return f"{host}:{port}"
 
+    def _serve_connection(self, conn: Connection) -> None:
+        with conn:
+            while True:
+                try:
+                    name, args, kwargs = conn.recv()
+                except (EOFError, OSError):
+                    return                   # client went away (or died)
+                try:
+                    if name not in _TRACKER_METHODS:
+                        raise AttributeError(f"no tracker method {name!r}")
+                    reply = (True,
+                             getattr(self.tracker, name)(*args, **kwargs))
+                except Exception as exc:  # noqa: BLE001 — sent to client
+                    reply = (False, exc)
+                try:
+                    conn.send(reply)
+                except (ValueError, TypeError, AttributeError):
+                    # unpicklable payload/exception
+                    conn.send((False, RuntimeError(repr(reply[1]))))
+                except (BrokenPipeError, OSError):
+                    return
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):      # closed, or failed auth
+                if self._closing:
+                    return
+                continue
+            except Exception:
+                if self._closing:
+                    return
+                log.exception("tracker server accept failed")
+                continue
+            t = threading.Thread(target=self._serve_connection,
+                                 args=(conn,), daemon=True,
+                                 name="tracker-conn")
+            t.start()
+            self._conn_threads.append(t)
+
     def start(self) -> "StateTrackerServer":
-        if self._thread is not None and self._thread.is_alive():
+        if self._accept_thread is not None and self._accept_thread.is_alive():
             return self                      # idempotent: already serving
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
-            daemon=True, name="state-tracker-server")
-        self._thread.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="state-tracker-server")
+        self._accept_thread.start()
         return self
 
     def shutdown(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self._closing = True
+        try:
+            self._listener.close()           # accept() unblocks with OSError
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
 
     def __enter__(self) -> "StateTrackerServer":
         return self.start()
@@ -176,28 +169,28 @@ class StateTrackerServer:
 # ---------------------------------------------------------------------------
 
 class RemoteStateTracker:
-    """StateTracker proxy over a socket: the client-mode counterpart of
-    ``StateTrackerServer`` with the identical method surface (generated
-    below from ``_TRACKER_METHODS``), safe for concurrent use from the
-    worker loop and its heartbeat thread."""
+    """StateTracker proxy over an authenticated connection: the
+    client-mode counterpart of ``StateTrackerServer`` with the identical
+    method surface (generated below from ``_TRACKER_METHODS``), safe for
+    concurrent use from the worker loop and its heartbeat thread."""
 
-    def __init__(self, connection_string: str, timeout_s: float = 60.0):
+    def __init__(self, connection_string: str,
+                 authkey: Optional[bytes] = None):
         host, _, port = connection_string.rpartition(":")
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout_s)
+        self._conn = Client((host, int(port)), authkey=authkey)
         self._lock = threading.Lock()
 
     def _call(self, name: str, *args: Any, **kwargs: Any) -> Any:
         with self._lock:
-            _send_frame(self._sock, pickle.dumps((name, args, kwargs)))
-            ok, value = pickle.loads(_recv_frame(self._sock))
+            self._conn.send((name, args, kwargs))
+            ok, value = self._conn.recv()
         if not ok:
             raise value
         return value
 
     def close(self) -> None:
         try:
-            self._sock.close()
+            self._conn.close()
         except OSError:
             pass
 
@@ -270,7 +263,8 @@ def _fix_child_platform() -> None:
 def worker_main(connection_string: str, performer_spec: PerformerSpec,
                 worker_id: Optional[str] = None,
                 poll_interval_s: float = 0.01,
-                heartbeat_interval_s: Optional[float] = None) -> None:
+                heartbeat_interval_s: Optional[float] = None,
+                authkey: Optional[bytes] = None) -> None:
     """Run one worker process against a remote tracker until the master
     sets the done flag.  The loop is the reference's
     WorkerActor.checkJobAvailable:287 — poll ``job_for``, replicate
@@ -280,7 +274,7 @@ def worker_main(connection_string: str, performer_spec: PerformerSpec,
     job requeued by the master's reaper."""
     _fix_child_platform()
     worker_id = worker_id or f"worker-{os.getpid()}"
-    tracker = RemoteStateTracker(connection_string)
+    tracker = RemoteStateTracker(connection_string, authkey=authkey)
     performer = resolve_performer_factory(performer_spec)()
     tracker.add_worker(worker_id)
 
@@ -291,7 +285,7 @@ def worker_main(connection_string: str, performer_spec: PerformerSpec,
     # held for a full RPC round-trip, so a large add_update (MLN params)
     # would otherwise block heartbeats past the stale threshold and get a
     # healthy worker reaped mid-report.
-    beat_tracker = RemoteStateTracker(connection_string)
+    beat_tracker = RemoteStateTracker(connection_string, authkey=authkey)
 
     def beat() -> None:
         while not stop_beat.is_set():
@@ -365,9 +359,11 @@ class MultiProcessRunner:
                  router_cls=IterativeReduceWorkRouter,
                  stale_after_s: float = 2.0,
                  poll_interval_s: float = 0.01,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 authkey: Optional[bytes] = None):
         self.tracker = StateTracker(stale_after_s=stale_after_s)
-        self.server = StateTrackerServer(self.tracker, host=host, port=port)
+        self.server = StateTrackerServer(self.tracker, host=host, port=port,
+                                         authkey=authkey)
         self.jobs = job_iterator
         self.performer_spec = performer_spec
         self.aggregator = aggregator
@@ -391,7 +387,8 @@ class MultiProcessRunner:
                 target=worker_main,
                 args=(self.connection_string, self.performer_spec),
                 kwargs={"worker_id": f"proc-worker-{base + i}",
-                        "poll_interval_s": self.poll},
+                        "poll_interval_s": self.poll,
+                        "authkey": self.server.authkey},
                 daemon=True, name=f"proc-worker-{base + i}")
             p.start()
             self.processes.append(p)

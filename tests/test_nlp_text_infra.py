@@ -1,0 +1,209 @@
+"""NLP text infrastructure: Porter stemmer, perceptron PoS tagger,
+SentiWordNet scorer, raw-sentence tree parsing into RNTN, persistent
+inverted index, annotator pipeline — the reference's UIMA/Lucene/treebank
+suite rebuilt without those dependencies (SURVEY.md §2.6 text infra)."""
+
+import pytest
+
+from deeplearning4j_tpu.nlp.stemmer import PorterStemmer, stem
+
+
+# -- Porter stemmer ---------------------------------------------------------
+
+def test_porter_canonical_vectors():
+    vectors = {
+        "caresses": "caress", "ponies": "poni", "ties": "ti",
+        "cats": "cat", "feed": "feed", "agreed": "agre",
+        "plastered": "plaster", "motoring": "motor", "hopping": "hop",
+        "filing": "file", "happy": "happi", "sky": "sky",
+        "relational": "relat", "conditional": "condit",
+        "digitizer": "digit", "operator": "oper",
+        "decisiveness": "decis", "hopefulness": "hope",
+        "triplicate": "triplic", "formalize": "formal",
+        "electriciti": "electr", "hopeful": "hope", "goodness": "good",
+        "adjustable": "adjust", "defensible": "defens",
+        "replacement": "replac", "adoption": "adopt",
+        "activate": "activ", "effective": "effect", "rate": "rate",
+        "controll": "control", "roll": "roll",
+        "generalizations": "gener", "oscillators": "oscil",
+    }
+    s = PorterStemmer()
+    for word, want in vectors.items():
+        assert s.stem(word) == want, (word, s.stem(word), want)
+    assert stem("Running") == "run"                # case-insensitive
+
+
+# -- PoS tagger -------------------------------------------------------------
+
+def test_pos_tagger_held_out_sentences():
+    from deeplearning4j_tpu.nlp.pos import pos_tag
+
+    got = dict(pos_tag("the happy dog chased a small bird".split()))
+    assert got["the"] == "DT" and got["chased"] == "VBD"
+    assert got["happy"] == "JJ" and got["bird"] == "NN"
+
+    got = dict(pos_tag("she was reading an interesting book".split()))
+    assert got["she"] == "PRP" and got["an"] == "DT"
+    assert got["reading"] == "VBG" and got["book"] == "NN"
+
+
+def test_pos_tagger_train_and_roundtrip():
+    from deeplearning4j_tpu.nlp.pos import (
+        SEED_CORPUS, AveragedPerceptronTagger)
+
+    t = AveragedPerceptronTagger().train(SEED_CORPUS, n_iter=5)
+    total = correct = 0
+    for sent in SEED_CORPUS:
+        tags = t.tag([w for w, _ in sent])
+        for (_, gold), (_, guess) in zip(sent, tags):
+            total += 1
+            correct += gold == guess
+    assert correct / total > 0.97
+
+    clone = AveragedPerceptronTagger.from_json(t.to_json())
+    toks = "engineers design powerful systems".split()
+    assert clone.tag(toks) == t.tag(toks)
+
+
+# -- SentiWordNet -----------------------------------------------------------
+
+def test_sentiwordnet_scoring_and_classes():
+    from deeplearning4j_tpu.nlp.sentiment import SentiWordNet
+
+    s = SentiWordNet()
+    assert len(s) > 100
+    assert s.score_word("good") > 0.5
+    assert s.score_word("terrible") < -0.5
+    assert s.score_word("xylophone") == 0.0
+    assert s.score("the food was delicious and wonderful") > 0.5
+    assert s.score("a terrible awful disaster") < -0.5
+    # negation flips the sentence (SWN3.scoreTokens parity)
+    assert s.score("the results were not good") < 0
+    assert s.classify("wonderful excellent perfect") == "strong_positive"
+    assert s.classify("the train arrives at noon") == "neutral"
+    assert s.class_for_score(-0.3) == "negative"
+    assert s.class_for_score(-0.1) == "weak_negative"
+
+
+def test_sentiwordnet_sense_rank_weighting(tmp_path):
+    """Two senses of one word fold with 1/rank weights over the harmonic
+    sum (SWN3.java:107-117)."""
+    from deeplearning4j_tpu.nlp.sentiment import SentiWordNet
+
+    lex = tmp_path / "mini.txt"
+    lex.write_text("a\t1\t1.0\t0\tmixed#1\tg\n"
+                   "a\t2\t0\t0.5\tmixed#2\tg\n")
+    s = SentiWordNet(str(lex))
+    # (1.0/1 + -0.5/2) / (1 + 1/2) = 0.75/1.5 = 0.5
+    assert s.score_word("mixed", "a") == pytest.approx(0.5)
+
+
+# -- persistent inverted index ---------------------------------------------
+
+def test_sqlite_inverted_index_persists_and_searches(tmp_path):
+    from deeplearning4j_tpu.nlp.inverted_index import SqliteInvertedIndex
+
+    path = str(tmp_path / "index.db")
+    with SqliteInvertedIndex(path) as idx:
+        d0 = idx.add_document("the cat sat on the mat".split(), label="cats")
+        d1 = idx.add_document("the dog sat on the rug".split(), label="dogs")
+        d2 = idx.add_document("cats and dogs are pets".split())
+        assert idx.num_docs() == 3
+        assert idx.documents_containing("sat") == [d0, d1]
+        assert idx.doc_frequency("the") == 2
+        assert idx.term_frequency("the") == 4
+
+    # survives close + reopen — the Lucene-directory persistence contract
+    with SqliteInvertedIndex(path) as idx2:
+        assert idx2.num_docs() == 3
+        tokens, label = idx2.document(d0)
+        assert tokens == "the cat sat on the mat".split()
+        assert label == "cats"
+        hits = idx2.search(["cat", "mat"])
+        assert hits[0][0] == d0                     # both terms hit d0
+        assert [i for i, _ in idx2.search("dogs")] == [d2]
+        assert [i for i, _ in idx2.search(["dog", "dogs"])] == [d1, d2] or \
+               [i for i, _ in idx2.search(["dog", "dogs"])] == [d2, d1]
+        assert "cat" in idx2.vocab()
+        docs = list(idx2.iter_documents())
+        assert len(docs) == 3 and docs[2][2] is None
+
+
+# -- raw-text tree parsing into RNTN ---------------------------------------
+
+def test_treeparser_builds_binary_trees():
+    from deeplearning4j_tpu.nlp.treeparser import TreeParser, tokenize
+
+    parser = TreeParser()
+    sent = "the quick brown fox jumps over the lazy dog"
+    tree = parser.parse(sent, label=4)
+    assert tree.label == 4
+    assert tree.leaves() == tokenize(sent)
+
+    def check_binary(t):
+        if t.is_leaf:
+            return True
+        assert t.left is not None and t.right is not None
+        return check_binary(t.left) and check_binary(t.right)
+
+    assert check_binary(tree)
+
+    # leaves stay neutral; interior nodes carry the propagated label
+    def leaf_labels(t):
+        if t.is_leaf:
+            return [t.label]
+        return leaf_labels(t.left) + leaf_labels(t.right)
+
+    assert set(leaf_labels(tree)) == {2}
+    unlabeled = TreeParser().parse(sent)            # no label → all neutral
+    assert unlabeled.label == 2
+
+
+def test_rntn_trains_from_raw_sentences():
+    """The capability TreeParser.java enables: RNTN sentiment training
+    directly from labeled plain text, no treebank files."""
+    from deeplearning4j_tpu.nlp.rntn import RNTN, RNTNConfig
+    from deeplearning4j_tpu.nlp.treeparser import trees_from_raw
+
+    labeled = [
+        ("a wonderful and excellent movie", 4),
+        ("the film was great and beautiful", 4),
+        ("an amazing story with lovely acting", 4),
+        ("a terrible and awful movie", 0),
+        ("the film was bad and ugly", 0),
+        ("a horrible story with nasty acting", 0),
+    ] * 2
+    trees = trees_from_raw(labeled)
+    cfg = RNTNConfig(vocab_size=64, dim=8, n_classes=5, max_nodes=32,
+                     adagrad_lr=0.05)
+    model = RNTN(cfg, trees, seed=3)
+    losses = model.fit(epochs=60)
+    assert losses[-1] < losses[0] * 0.7
+
+    pos = model.predict(trees_from_raw([("wonderful excellent great", 2)])[0])
+    neg = model.predict(trees_from_raw([("terrible awful bad", 2)])[0])
+    assert pos > neg                                # ordering learned
+
+
+# -- annotator pipeline -----------------------------------------------------
+
+def test_analysis_pipeline_and_tokenizer_factories():
+    from deeplearning4j_tpu.nlp.annotators import (
+        AnalysisPipeline, PosFilterTokenizerFactory,
+        StemmingTokenizerFactory)
+
+    ann = AnalysisPipeline.default().process(
+        "The happy dog chased a bird. It was running quickly.")
+    assert len(ann.sentences) == 2
+    assert ann.tokens[0][0] == "The"
+    tags0 = dict(ann.pos_tags[0])
+    assert tags0["dog"] == "NN"
+    assert "run" in ann.stems[1]                    # running -> run
+
+    nouns_only = PosFilterTokenizerFactory(["NN"])
+    assert nouns_only.create("the happy dog chased a small bird") == [
+        "dog", "bird"]
+
+    stems = StemmingTokenizerFactory()
+    assert stems.create("running horses happily") == ["run", "hors",
+                                                      "happili"]

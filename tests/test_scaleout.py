@@ -316,3 +316,18 @@ def test_glove_warm_start_preserves_source_state():
     b.fit(initial_weights=a.state)
     # source state still readable (not donated away)
     assert np.isfinite(np.asarray(a.state[0])).all()
+
+
+def test_word2vec_warm_start_preserves_source_tables():
+    """Same donation hazard as GloVe: fit(initial_weights=...) must copy,
+    not alias, the source tables (the jitted steps donate buffers)."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig
+
+    corpus = ["the cat sat on the mat", "the dog sat on the rug"] * 10
+    cfg = Word2VecConfig(vector_size=8, epochs=1, batch_size=64, seed=5)
+    a = Word2Vec(corpus, cfg)
+    a.fit()
+    b = Word2Vec(corpus, cfg, cache=a.cache)
+    b.fit(initial_weights=(a.syn0, a.syn1, a.syn1neg))
+    assert np.isfinite(np.asarray(a.syn0)).all()   # source not donated away

@@ -17,7 +17,8 @@ from deeplearning4j_tpu.parallel.coordinator import Job
 def test_remote_tracker_roundtrip():
     """Every tracker primitive works identically through the socket."""
     with tp.StateTrackerServer() as server:
-        with tp.RemoteStateTracker(server.connection_string) as remote:
+        with tp.RemoteStateTracker(server.connection_string,
+                                   authkey=server.authkey) as remote:
             remote.add_worker("w1")
             assert remote.workers() == ["w1"]
             remote.heartbeat("w1")
@@ -54,13 +55,30 @@ def test_remote_tracker_roundtrip():
 
 def test_remote_tracker_rejects_unknown_and_propagates_errors():
     with tp.StateTrackerServer() as server:
-        with tp.RemoteStateTracker(server.connection_string) as remote:
+        with tp.RemoteStateTracker(server.connection_string,
+                                   authkey=server.authkey) as remote:
             with pytest.raises(AttributeError):
                 remote._call("_requeue_locked", "w1")   # private: not served
             with pytest.raises(AttributeError):
                 remote._call("no_such_method")
             with pytest.raises(TypeError):
                 remote.increment()                       # bad arity propagates
+
+
+def test_remote_tracker_requires_authkey():
+    """The channel is HMAC-authenticated: a client with the wrong key is
+    rejected before any payload pickle is exchanged."""
+    import multiprocessing
+
+    with tp.StateTrackerServer() as server:
+        with pytest.raises(multiprocessing.AuthenticationError):
+            tp.RemoteStateTracker(server.connection_string,
+                                  authkey=b"wrong-key")
+        # the right key still works afterwards
+        with tp.RemoteStateTracker(server.connection_string,
+                                   authkey=server.authkey) as remote:
+            remote.increment("ok")
+            assert remote.count("ok") == 1
 
 
 def test_performer_spec_resolution():
