@@ -34,8 +34,13 @@ def _load_dataset(spec: str, batch: int = 0):
     if spec == "iris":
         f = IrisDataFetcher()
         f.fetch(150)
-    elif spec == "mnist":
-        f = MnistDataFetcher()
+    elif spec in ("mnist", "mnist-test", "mnist2d", "mnist2d-test"):
+        # real idx files when $MNIST_DIR (or ./data/mnist) holds them —
+        # MnistDataFetcher.java:37 parity — else the synthetic surrogate.
+        # "2d" keeps [N, 28, 28, 1] images for conv nets (LeNet); plain
+        # "mnist" flattens to [N, 784] for dense nets.
+        f = MnistDataFetcher(train=not spec.endswith("-test"),
+                             flatten=not spec.startswith("mnist2d"))
         f.fetch(f.total)
     else:
         f = CSVDataFetcher(spec)
@@ -100,7 +105,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser("train", help="fit a model from a conf JSON")
     t.add_argument("--input", required=True,
-                   help="labeled CSV path, or 'iris'/'mnist'")
+                   help="labeled CSV path, or 'iris'/'mnist[2d][-test]' "
+                        "(mnist reads $MNIST_DIR idx files when present)")
     t.add_argument("--conf", required=True,
                    help="MultiLayerConfiguration JSON file")
     t.add_argument("--output", required=True, help="model output path")

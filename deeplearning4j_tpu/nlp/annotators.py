@@ -18,9 +18,9 @@ from typing import List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.nlp.pos import AveragedPerceptronTagger, default_tagger
 from deeplearning4j_tpu.nlp.stemmer import PorterStemmer
+from deeplearning4j_tpu.nlp.text import word_punct_tokenize
 
 _SENT_SPLIT = re.compile(r"(?<=[.!?])\s+")
-_TOKEN = re.compile(r"[a-zA-Z']+|[0-9]+|[^\sa-zA-Z0-9]")
 
 
 @dataclasses.dataclass
@@ -56,7 +56,7 @@ class TokenizerAnnotator(Annotator):
     def process(self, ann: Annotation) -> Annotation:
         if ann.sentences is None:
             SentenceAnnotator().process(ann)
-        ann.tokens = [_TOKEN.findall(s) for s in ann.sentences]
+        ann.tokens = [word_punct_tokenize(s) for s in ann.sentences]
         return ann
 
 
@@ -130,7 +130,7 @@ class PosFilterTokenizerFactory:
 
     def create(self, text: str) -> List[str]:
         tagger = self._tagger or default_tagger()
-        toks = _TOKEN.findall(text)
+        toks = word_punct_tokenize(text)
         out = []
         for word, tag in tagger.tag(toks):
             if any(tag.startswith(a) for a in self.allowed):
@@ -150,7 +150,8 @@ class StemmingTokenizerFactory:
         self.lowercase = lowercase
 
     def create(self, text: str) -> List[str]:
-        toks = _TOKEN.findall(text.lower() if self.lowercase else text)
+        toks = word_punct_tokenize(text.lower() if self.lowercase
+                                   else text)
         return [self.stemmer.stem(t) if t.isalpha() else t for t in toks]
 
     __call__ = create

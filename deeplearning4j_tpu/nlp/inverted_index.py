@@ -57,31 +57,41 @@ class SqliteInvertedIndex:
             self._conn.commit()
 
     # -- writing ------------------------------------------------------------
+    def _insert_locked(self, tokens: Sequence[str], label: Optional[str],
+                       doc_id: Optional[int]) -> int:
+        counts: Dict[str, int] = {}
+        for t in tokens:
+            counts[t] = counts.get(t, 0) + 1
+        cur = self._conn.execute(
+            "INSERT INTO docs (id, tokens, label) VALUES (?, ?, ?)",
+            (doc_id, json.dumps(list(tokens)), label))
+        new_id = cur.lastrowid
+        self._conn.executemany(
+            "INSERT OR REPLACE INTO postings (term, doc_id, freq) "
+            "VALUES (?, ?, ?)",
+            [(t, new_id, c) for t, c in counts.items()])
+        return int(new_id)
+
     def add_document(self, tokens: Sequence[str],
                      label: Optional[str] = None,
                      doc_id: Optional[int] = None) -> int:
         """Index one document; returns its id (LuceneInvertedIndex
         ``addWordsToDoc`` parity, with the label-aware variant folded
         in)."""
-        counts: Dict[str, int] = {}
-        for t in tokens:
-            counts[t] = counts.get(t, 0) + 1
         with self._lock:
-            cur = self._conn.execute(
-                "INSERT INTO docs (id, tokens, label) VALUES (?, ?, ?)",
-                (doc_id, json.dumps(list(tokens)), label))
-            new_id = cur.lastrowid
-            self._conn.executemany(
-                "INSERT OR REPLACE INTO postings (term, doc_id, freq) "
-                "VALUES (?, ?, ?)",
-                [(t, new_id, c) for t, c in counts.items()])
+            new_id = self._insert_locked(tokens, label, doc_id)
             self._conn.commit()
-        return int(new_id)
+        return new_id
 
     def add_documents(self, docs: Sequence[Tuple[Sequence[str],
                                                  Optional[str]]]) -> List[int]:
-        """Batched variant (the reference buffers into miniBatches)."""
-        return [self.add_document(tokens, label) for tokens, label in docs]
+        """Batched variant (the reference buffers into miniBatches): ONE
+        transaction/fsync for the whole batch, not one per document."""
+        with self._lock:
+            ids = [self._insert_locked(tokens, label, None)
+                   for tokens, label in docs]
+            self._conn.commit()
+        return ids
 
     # -- reading ------------------------------------------------------------
     def document(self, doc_id: int) -> Tuple[List[str], Optional[str]]:

@@ -26,6 +26,15 @@ Tokenizer = Callable[[str], List[str]]
 _PUNCT = re.compile(r"[\.,:;!\?\"'\(\)\[\]\{\}<>]")
 _WS = re.compile(r"\s+")
 
+#: word / number / single-punctuation tokenization, shared by the
+#: annotator pipeline and the tree parser so both produce the same token
+#: stream for the same text
+WORD_PUNCT = re.compile(r"[a-zA-Z']+|[0-9]+|[^\sa-zA-Z0-9]")
+
+
+def word_punct_tokenize(text: str) -> List[str]:
+    return WORD_PUNCT.findall(text)
+
 
 def common_preprocessor(token: str) -> str:
     """CommonPreprocessor parity: lowercase + strip punctuation."""

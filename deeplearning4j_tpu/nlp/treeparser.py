@@ -17,13 +17,11 @@ label — exactly how the reference pipelines raw text into RNTN training
 
 from __future__ import annotations
 
-import re
 from typing import List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.nlp.pos import AveragedPerceptronTagger, default_tagger
 from deeplearning4j_tpu.nlp.rntn import Tree
-
-_TOKEN = re.compile(r"[a-zA-Z']+|[0-9]+|[^\sa-zA-Z0-9]")
+from deeplearning4j_tpu.nlp.text import word_punct_tokenize
 
 # chunk grammar over PTB tags: maximal runs joined into one phrase
 _NP_START = {"DT", "PRP$", "JJ", "JJR", "JJS", "CD"}
@@ -32,8 +30,8 @@ _VP_START = {"MD", "RB", "RBR", "RBS"}
 _VP_HEAD = {"VB", "VBD", "VBG", "VBN", "VBP", "VBZ"}
 
 
-def tokenize(sentence: str) -> List[str]:
-    return _TOKEN.findall(sentence)
+#: shared word/punct tokenizer (see nlp/text.py)
+tokenize = word_punct_tokenize
 
 
 def _chunk(tagged: Sequence[Tuple[str, str]]) -> List[List[str]]:

@@ -102,23 +102,32 @@ class StateTrackerServer:
         with conn:
             while True:
                 try:
-                    name, args, kwargs = conn.recv()
+                    msg = conn.recv()
                 except (EOFError, OSError):
                     return                   # client went away (or died)
-                try:
-                    if name not in _TRACKER_METHODS:
-                        raise AttributeError(f"no tracker method {name!r}")
-                    reply = (True,
-                             getattr(self.tracker, name)(*args, **kwargs))
-                except Exception as exc:  # noqa: BLE001 — sent to client
+                except Exception as exc:  # noqa: BLE001
+                    # malformed request pickle: the frame was consumed, so
+                    # the connection is still usable — reply with the error
                     reply = (False, exc)
+                else:
+                    try:
+                        name, args, kwargs = msg
+                        if name not in _TRACKER_METHODS:
+                            raise AttributeError(
+                                f"no tracker method {name!r}")
+                        reply = (True, getattr(self.tracker, name)(
+                            *args, **kwargs))
+                    except Exception as exc:  # noqa: BLE001 — to client
+                        reply = (False, exc)
                 try:
                     conn.send(reply)
-                except (ValueError, TypeError, AttributeError):
-                    # unpicklable payload/exception
-                    conn.send((False, RuntimeError(repr(reply[1]))))
-                except (BrokenPipeError, OSError):
+                except (BrokenPipeError, ConnectionError, OSError):
                     return
+                except Exception:            # unpicklable payload/exception
+                    try:
+                        conn.send((False, RuntimeError(repr(reply[1]))))
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        return
 
     def _accept_loop(self) -> None:
         while not self._closing:
@@ -133,6 +142,10 @@ class StateTrackerServer:
                     return
                 log.exception("tracker server accept failed")
                 continue
+            # prune finished connection threads so reconnect churn (worker
+            # crash/restart cycles) doesn't grow the list forever
+            self._conn_threads = [t for t in self._conn_threads
+                                  if t.is_alive()]
             t = threading.Thread(target=self._serve_connection,
                                  args=(conn,), daemon=True,
                                  name="tracker-conn")
@@ -175,14 +188,26 @@ class RemoteStateTracker:
     concurrent use from the worker loop and its heartbeat thread."""
 
     def __init__(self, connection_string: str,
-                 authkey: Optional[bytes] = None):
+                 authkey: Optional[bytes] = None,
+                 timeout_s: float = 60.0):
         host, _, port = connection_string.rpartition(":")
         self._conn = Client((host, int(port)), authkey=authkey)
         self._lock = threading.Lock()
+        self.timeout_s = timeout_s
 
     def _call(self, name: str, *args: Any, **kwargs: Any) -> Any:
         with self._lock:
             self._conn.send((name, args, kwargs))
+            # bounded wait: a hung/deadlocked master must not wedge the
+            # worker forever — TimeoutError is an OSError, so the worker
+            # loop treats it as a lost connection, exits, and the reaper
+            # requeues its job
+            if not self._conn.poll(self.timeout_s):
+                # the reply stream is now out of sync — close so any later
+                # call fails fast instead of reading a stale reply
+                self._conn.close()
+                raise TimeoutError(
+                    f"no reply to {name!r} within {self.timeout_s}s")
             ok, value = self._conn.recv()
         if not ok:
             raise value

@@ -1,0 +1,63 @@
+"""Live console server — Dropwizard render-webapp/ops-console parity
+(RenderApplication.java, StateTrackerDropWizardResource.java)."""
+
+import json
+import urllib.request
+
+from deeplearning4j_tpu.parallel.coordinator import Job, StateTracker
+from deeplearning4j_tpu.runtime.console import ConsoleServer
+from deeplearning4j_tpu.runtime.metrics import ScalarsLogger
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def test_console_serves_dashboard_scalars_state_and_renders(tmp_path):
+    scalars = str(tmp_path / "scalars.jsonl")
+    logger = ScalarsLogger(scalars)
+    for step in range(5):
+        logger.log(step, loss=1.0 / (step + 1), acc=step / 5.0)
+    logger.close()
+
+    render = tmp_path / "renders"
+    render.mkdir()
+    (render / "embedding.html").write_text("<html>embedding</html>")
+
+    tracker = StateTracker()
+    tracker.add_worker("w1")
+    tracker.add_job(Job(work=1.0))
+    tracker.increment("jobs_done", 3)
+
+    with ConsoleServer(scalars_path=scalars, tracker=tracker,
+                       render_dir=str(render)) as srv:
+        page = _get(srv.url + "/").decode()
+        assert "training console" in page
+
+        rows = json.loads(_get(srv.url + "/api/scalars"))
+        assert len(rows) == 5
+        assert rows[0]["loss"] == 1.0
+
+        state = json.loads(_get(srv.url + "/api/state"))
+        assert state["attached"] and state["workers"] == ["w1"]
+        assert state["counters"]["jobs_done"] == 3
+        assert state["has_pending"] is True
+
+        body = _get(srv.url + "/renders/embedding.html").decode()
+        assert body == "<html>embedding</html>"
+
+        # traversal + missing-file guarded
+        for bad in ("/renders/../secret", "/renders/nope.html", "/zzz"):
+            try:
+                urllib.request.urlopen(srv.url + bad, timeout=10)
+                raise AssertionError(f"{bad} should 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+
+def test_console_without_sources_is_empty_not_broken():
+    with ConsoleServer() as srv:
+        assert json.loads(_get(srv.url + "/api/scalars")) == []
+        assert json.loads(_get(srv.url + "/api/state")) == {
+            "attached": False}

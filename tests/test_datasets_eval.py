@@ -120,3 +120,40 @@ def test_dataset_transforms():
     assert train.num_examples() == 40 and test.num_examples() == 10
     merged = DataSet.merge([train, test])
     assert merged.num_examples() == 50
+
+
+def test_labeled_point_interop_roundtrip():
+    """MLLibUtil parity: LabeledPoint records -> DataSet (one-hot) and
+    back; regression labels pass through continuous."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.interop import (
+        LabeledPoint, from_arrays, from_labeled_points, to_labeled_points)
+
+    pts = [LabeledPoint(0, [1.0, 2.0]), LabeledPoint(2, [3.0, 4.0]),
+           LabeledPoint(1, [5.0, 6.0])]
+    ds = from_labeled_points(pts)
+    assert ds.num_examples() == 3 and ds.num_outcomes() == 3
+    np.testing.assert_allclose(np.asarray(ds.labels)[1], [0, 0, 1])
+
+    back = to_labeled_points(ds)
+    assert [p.label for p in back] == [0.0, 2.0, 1.0]
+    np.testing.assert_allclose(back[2].features, [5.0, 6.0])
+
+    # regression: continuous targets kept as a single column
+    reg = from_labeled_points(
+        [LabeledPoint(0.5, [1.0]), LabeledPoint(-1.5, [2.0])],
+        num_classes=0)
+    np.testing.assert_allclose(np.asarray(reg.labels)[:, 0], [0.5, -1.5])
+    back = to_labeled_points(reg)
+    assert back[1].label == -1.5
+
+    ds2 = from_arrays([[1, 2], [3, 4]], [1, 0], num_classes=3)
+    assert ds2.num_outcomes() == 3
+
+    import pytest
+    with pytest.raises(ValueError):
+        from_labeled_points([])
+    with pytest.raises(ValueError):
+        from_labeled_points([LabeledPoint(1.5, [1.0])])   # non-integer class
+    with pytest.raises(ValueError):
+        from_labeled_points([LabeledPoint(5, [1.0])], num_classes=3)

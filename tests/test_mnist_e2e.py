@@ -1,0 +1,110 @@
+"""MNIST end-to-end through the real ingestion path: idx files on disk →
+MnistDataFetcher → CLI LeNet training → evaluation.
+
+Two tiers (VERDICT round-1 item 5 / MnistDataFetcher.java:37 parity):
+- the PIPELINE is always proven, by writing idx files (the real format)
+  and driving the CLI against them — zero egress;
+- the ≥97% LeNet accuracy claim runs only when a real MNIST archive is
+  present locally ($MNIST_DIR / ./data/mnist / ~/.dl4j-tpu/mnist),
+  because this environment cannot download it.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import mnist as mnist_io
+
+
+def _write_idx_archive(dirpath, n_train=1024, n_test=512):
+    xtr, ytr = mnist_io.synthetic_mnist(n=n_train, seed=0)
+    xte, yte = mnist_io.synthetic_mnist(n=n_test, seed=1)
+    mnist_io.write_idx_images(
+        os.path.join(dirpath, "train-images-idx3-ubyte"), xtr)
+    mnist_io.write_idx_labels(
+        os.path.join(dirpath, "train-labels-idx1-ubyte"), ytr)
+    mnist_io.write_idx_images(
+        os.path.join(dirpath, "t10k-images-idx3-ubyte"), xte)
+    mnist_io.write_idx_labels(
+        os.path.join(dirpath, "t10k-labels-idx1-ubyte"), yte)
+
+
+def test_idx_archive_cli_lenet_end_to_end(tmp_path, monkeypatch, capsys):
+    """Full user workflow: idx archive on disk, LeNet conf JSON, CLI
+    train on 'mnist2d', CLI test on the held-out split — the pipeline
+    that runs unchanged on the real archive."""
+    from deeplearning4j_tpu import cli
+    from deeplearning4j_tpu.models.lenet import lenet_conf
+
+    data_dir = tmp_path / "mnist"
+    data_dir.mkdir()
+    _write_idx_archive(str(data_dir))
+    monkeypatch.setenv("MNIST_DIR", str(data_dir))
+
+    conf_path = tmp_path / "lenet.json"
+    # float32 on CPU test devices; lr tuned for the tiny surrogate
+    conf_path.write_text(lenet_conf(lr=0.05,
+                                    compute_dtype="float32").to_json())
+    model_path = tmp_path / "lenet.bin"
+
+    rc = cli.main(["train", "--input", "mnist2d",
+                   "--conf", str(conf_path), "--output", str(model_path),
+                   "--epochs", "5", "--batch", "128"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    train_acc = float(out.split("train accuracy:")[1].strip())
+    assert train_acc > 0.85, out                # surrogate is learnable
+
+    rc = cli.main(["test", "--input", "mnist2d-test",
+                   "--model", str(model_path)])
+    assert rc == 0
+    stats = capsys.readouterr().out
+    assert "Accuracy" in stats or "accuracy" in stats
+    # the held-out split goes through the SAME idx readers
+    acc_line = [l for l in stats.splitlines() if "ccuracy" in l][0]
+    test_acc = float(acc_line.split(":")[-1].strip())
+    assert test_acc > 0.75, stats
+
+
+def test_idx_roundtrip_matches_loader(tmp_path):
+    """write_idx_* output parses back identically through load_mnist
+    (including the native C++ reader when available)."""
+    x, y = mnist_io.synthetic_mnist(n=64, seed=3)
+    _write = tmp_path / "m"
+    _write.mkdir()
+    mnist_io.write_idx_images(str(_write / "train-images-idx3-ubyte"), x)
+    mnist_io.write_idx_labels(str(_write / "train-labels-idx1-ubyte"), y)
+    mnist_io.write_idx_images(str(_write / "t10k-images-idx3-ubyte"), x[:8])
+    mnist_io.write_idx_labels(str(_write / "t10k-labels-idx1-ubyte"), y[:8])
+    xi, yi = mnist_io.load_mnist(str(_write), train=True)
+    np.testing.assert_array_equal(xi, x)
+    np.testing.assert_array_equal(yi, y)
+
+
+_REAL_DIR = mnist_io.find_mnist_dir()
+
+
+@pytest.mark.skipif(_REAL_DIR is None,
+                    reason="no real MNIST archive on this host (zero "
+                           "egress); place idx files in $MNIST_DIR to run")
+def test_real_mnist_lenet_97pct():
+    """The reference's headline dataset milestone: LeNet ≥97% on the real
+    MNIST test split (SURVEY.md §7 stage 4)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.fetchers import MnistDataFetcher
+    from deeplearning4j_tpu.models.lenet import lenet
+
+    ftr = MnistDataFetcher(train=True, flatten=False, binarize=False)
+    ftr.fetch(ftr.total)
+    train = ftr.next()
+    fte = MnistDataFetcher(train=False, flatten=False, binarize=False)
+    fte.fetch(fte.total)
+    test = fte.next()
+    assert train.num_examples() == 60000 and test.num_examples() == 10000
+
+    net = lenet(compute_dtype="float32")
+    net.fit(train.batch_by(128), num_epochs=2)
+    acc = net.evaluate(test).accuracy()
+    assert acc >= 0.97, acc
