@@ -27,7 +27,7 @@ from typing import List, Optional
 import numpy as np
 
 
-def _load_dataset(spec: str, batch: int = 0):
+def _load_dataset(spec: str, batch: int = 0, binarize: bool = True):
     from deeplearning4j_tpu.datasets.fetchers import (
         CSVDataFetcher, IrisDataFetcher, MnistDataFetcher)
 
@@ -38,9 +38,12 @@ def _load_dataset(spec: str, batch: int = 0):
         # real idx files when $MNIST_DIR (or ./data/mnist) holds them —
         # MnistDataFetcher.java:37 parity — else the synthetic surrogate.
         # "2d" keeps [N, 28, 28, 1] images for conv nets (LeNet); plain
-        # "mnist" flattens to [N, 784] for dense nets.
+        # "mnist" flattens to [N, 784] for dense nets.  ``binarize``
+        # follows the reference default (threshold at 30/255);
+        # --raw-pixels turns it off for grayscale conv training.
         f = MnistDataFetcher(train=not spec.endswith("-test"),
-                             flatten=not spec.startswith("mnist2d"))
+                             flatten=not spec.startswith("mnist2d"),
+                             binarize=binarize)
         f.fetch(f.total)
     else:
         f = CSVDataFetcher(spec)
@@ -63,7 +66,8 @@ def cmd_train(args) -> int:
 
     with open(args.conf) as fh:
         conf = MultiLayerConfiguration.from_json(fh.read())
-    data = _load_dataset(args.input)
+    data = _load_dataset(args.input,
+                         binarize=not args.raw_pixels)
     net = MultiLayerNetwork(conf).init(seed=args.seed)
     net.set_listeners([ScoreIterationListener(args.log_every)])
     batches = (data.batch_by(args.batch) if args.batch > 0 else data)
@@ -78,7 +82,7 @@ def cmd_train(args) -> int:
 
 def cmd_test(args) -> int:
     net = _load_model(args.model)
-    data = _load_dataset(args.input)
+    data = _load_dataset(args.input, binarize=not args.raw_pixels)
     ev = net.evaluate(data)
     print(ev.stats())
     return 0
@@ -86,7 +90,7 @@ def cmd_test(args) -> int:
 
 def cmd_predict(args) -> int:
     net = _load_model(args.model)
-    data = _load_dataset(args.input)
+    data = _load_dataset(args.input, binarize=not args.raw_pixels)
     preds = np.asarray(net.predict(data.features))
     if args.output:
         np.savetxt(args.output, preds, fmt="%d")
@@ -114,18 +118,23 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--batch", type=int, default=0,
                    help="minibatch size (0 = full batch)")
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--raw-pixels", action="store_true",
+                   help="keep mnist pixels as [0,1] floats instead of the "
+                        "reference's >30/255 binarization")
     t.add_argument("--log-every", type=int, default=10)
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("test", help="evaluate a saved model")
     e.add_argument("--input", required=True)
     e.add_argument("--model", required=True)
+    e.add_argument("--raw-pixels", action="store_true")
     e.set_defaults(fn=cmd_test)
 
     r = sub.add_parser("predict", help="class predictions for a dataset")
     r.add_argument("--input", required=True)
     r.add_argument("--model", required=True)
     r.add_argument("--output", default=None)
+    r.add_argument("--raw-pixels", action="store_true")
     r.set_defaults(fn=cmd_predict)
     return p
 

@@ -79,8 +79,14 @@ class SqliteInvertedIndex:
         ``addWordsToDoc`` parity, with the label-aware variant folded
         in)."""
         with self._lock:
-            new_id = self._insert_locked(tokens, label, doc_id)
-            self._conn.commit()
+            try:
+                new_id = self._insert_locked(tokens, label, doc_id)
+                self._conn.commit()
+            except Exception:
+                # never leave a partial insert pending on the shared
+                # connection: the next unrelated commit would persist it
+                self._conn.rollback()
+                raise
         return new_id
 
     def add_documents(self, docs: Sequence[Tuple[Sequence[str],
@@ -88,9 +94,13 @@ class SqliteInvertedIndex:
         """Batched variant (the reference buffers into miniBatches): ONE
         transaction/fsync for the whole batch, not one per document."""
         with self._lock:
-            ids = [self._insert_locked(tokens, label, None)
-                   for tokens, label in docs]
-            self._conn.commit()
+            try:
+                ids = [self._insert_locked(tokens, label, None)
+                       for tokens, label in docs]
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()     # all-or-nothing for the batch
+                raise
         return ids
 
     # -- reading ------------------------------------------------------------

@@ -61,8 +61,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
                 scale: float, block_k: int, causal: bool):
     """One (batch*head, q-block) grid step.
 
-    q_ref [1, bq, D]; k_ref/v_ref [1, T, D]; bias_ref [1, T] additive mask;
-    o_ref [1, bq, D]; lse_ref [1, bq].
+    q_ref [1, bq, D]; k_ref/v_ref [1, T, D]; bias_ref [1, T, 1] additive
+    mask; o_ref [1, bq, D]; lse_ref [1, bq, 1].
+
+    The per-row tensors (bias, lse, delta) carry a trailing singleton dim
+    at every pallas boundary: Mosaic requires a block's last two dims to
+    be (divisible by 8, divisible by 128) or equal to the array dims, and
+    a [1, T]-blocked 2D array violates the sublane rule; [bq, 1] / [T, 1]
+    blocks satisfy it by dim equality.
     """
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -83,7 +89,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        s = s + bias_ref[0, pl.ds(j * block_k, block_k), 0][None, :]
         if causal:
             k_cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -108,7 +114,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
 
     l = jnp.maximum(l, 1e-30)                            # fully-masked rows
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = m + jnp.log(l)                          # [bq, 1]
 
 
 def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
@@ -122,26 +128,26 @@ def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
 
     kern = functools.partial(_fwd_kernel, scale=scale, block_k=bk,
                              causal=causal)
-    o, lse = pl.pallas_call(
+    o, lse3 = pl.pallas_call(
         kern,
         grid=(BH, Tq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, Tk), lambda bh, i: (bh, 0)),
+            pl.BlockSpec((1, Tk, 1), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tq, D), q4.dtype),
-            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q4, k4, v4, bias)
-    return o, lse
+    )(q4, k4, v4, bias[:, :, None])
+    return o, lse3[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -161,15 +167,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
     k = k_ref[0]                                         # [bk, D]
     v = v_ref[0]
-    bias = bias_ref[0][None, :]                          # [1, bk] (this block)
+    bias = bias_ref[0, :, 0][None, :]                    # [1, bk] (this block)
     k_cols = kj * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
 
     def body(i, carry):
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]     # [bq, D]
         do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]      # [bq, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
 
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -215,8 +221,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
     q = q_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]                                     # [bq, 1]
+    delta = delta_ref[0]
     q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(j, dq):
@@ -224,7 +230,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        s = s + bias_ref[0, pl.ds(j * block_k, block_k), 0][None, :]
         if causal:
             k_cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -259,7 +265,9 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
                     axis=-1)                             # [BH, Tq]
 
     full = lambda bh, i: (bh, 0, 0)
-    vec = lambda bh, i: (bh, 0)
+    # trailing singleton at the pallas boundary (see _fwd_kernel docstring)
+    bias3, lse3, delta3 = (bias[:, :, None], lse[:, :, None],
+                           delta[:, :, None])
 
     dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
                                  block_q=bq, causal=causal)
@@ -270,10 +278,10 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
             pl.BlockSpec((1, Tq, D), full),                      # q
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # k block
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # v block
-            pl.BlockSpec((1, bk), lambda bh, j: (bh, j)),        # bias block
+            pl.BlockSpec((1, bk, 1), lambda bh, j: (bh, j, 0)),  # bias block
             pl.BlockSpec((1, Tq, D), full),                      # do
-            pl.BlockSpec((1, Tq), vec),                          # lse
-            pl.BlockSpec((1, Tq), vec),                          # delta
+            pl.BlockSpec((1, Tq, 1), full),                      # lse
+            pl.BlockSpec((1, Tq, 1), full),                      # delta
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
@@ -284,7 +292,7 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
             jax.ShapeDtypeStruct((BH, Tk, D), v4.dtype),
         ],
         interpret=interpret,
-    )(q4, k4, v4, bias, do4, lse, delta)
+    )(q4, k4, v4, bias3, do4, lse3, delta3)
 
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale,
                                 block_k=bk, causal=causal)
@@ -295,15 +303,15 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # q block
             pl.BlockSpec((1, Tk, D), full),                      # k
             pl.BlockSpec((1, Tk, D), full),                      # v
-            pl.BlockSpec((1, Tk), vec),                          # bias
+            pl.BlockSpec((1, Tk, 1), full),                      # bias
             pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # do block
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),        # lse block
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),        # delta blk
+            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),  # lse block
+            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),  # delta blk
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q4.dtype),
         interpret=interpret,
-    )(q4, k4, v4, bias, do4, lse, delta)
+    )(q4, k4, v4, bias3, do4, lse3, delta3)
 
     return dq4, dk4, dv4, None  # no gradient for bias
 

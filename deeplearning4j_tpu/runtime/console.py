@@ -29,8 +29,6 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from deeplearning4j_tpu.runtime.metrics import ScalarsLogger
-
 _DASHBOARD = """<!doctype html><html><head><meta charset="utf-8">
 <title>deeplearning4j_tpu console</title>
 <style>
@@ -127,8 +125,14 @@ class ConsoleServer:
                         self._render_file(self.path[len("/renders/"):])
                     else:
                         self._send(b"not found", "text/plain", 404)
-                except BrokenPipeError:
+                except (BrokenPipeError, ConnectionError):
                     pass
+                except Exception as exc:  # noqa: BLE001 — 500, not a reset
+                    try:
+                        self._send(f"internal error: {exc!r}".encode(),
+                                   "text/plain", 500)
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        pass
 
             def _render_file(self, name: str) -> None:
                 if outer.render_dir is None or "/" in name or ".." in name:
@@ -147,12 +151,44 @@ class ConsoleServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+        # incremental scalars-read state (see scalar_rows)
+        self._scalars_lock = threading.Lock()
+        self._scalars_offset = 0
+        self._scalars_rows: list = []
+        self._scalars_tail = b""
 
     # -- data sources --------------------------------------------------------
     def scalar_rows(self) -> list:
+        """Rows from the scalars JSONL, read INCREMENTALLY: the polling
+        dashboard hits this every ~2 s for the whole training run, so the
+        parsed history is cached and only bytes appended since the last
+        call are read/parsed (O(new rows) per poll, not O(file)).  A torn
+        final line (a concurrent logger mid-append) stays buffered until
+        its remainder arrives instead of raising."""
         if not self.scalars_path or not os.path.exists(self.scalars_path):
             return []
-        return ScalarsLogger.read(self.scalars_path)
+        with self._scalars_lock:
+            size = os.path.getsize(self.scalars_path)
+            if size < self._scalars_offset:      # truncated/rotated: reset
+                self._scalars_offset = 0
+                self._scalars_rows = []
+                self._scalars_tail = b""
+            if size > self._scalars_offset:
+                with open(self.scalars_path, "rb") as f:
+                    f.seek(self._scalars_offset)
+                    chunk = self._scalars_tail + f.read()
+                    self._scalars_offset = f.tell()
+                lines = chunk.split(b"\n")
+                self._scalars_tail = lines.pop()  # b"" unless torn
+                for line in lines:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        self._scalars_rows.append(json.loads(line))
+                    except ValueError:
+                        continue                  # malformed line: skip
+            return list(self._scalars_rows)
 
     def state_snapshot(self) -> Dict[str, Any]:
         """StateTrackerDropWizardResource role: live tracker introspection."""

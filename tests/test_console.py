@@ -61,3 +61,26 @@ def test_console_without_sources_is_empty_not_broken():
         assert json.loads(_get(srv.url + "/api/scalars")) == []
         assert json.loads(_get(srv.url + "/api/state")) == {
             "attached": False}
+
+
+def test_console_scalars_incremental_and_torn_line_tolerant(tmp_path):
+    """Live-append behavior: new rows appear across polls, a torn final
+    line (logger mid-append) is buffered not fatal, and the endpoint
+    returns 200 throughout."""
+    scalars = str(tmp_path / "s.jsonl")
+    with open(scalars, "w") as f:
+        f.write('{"step": 0, "loss": 1.0}\n')
+
+    with ConsoleServer(scalars_path=scalars) as srv:
+        assert len(json.loads(_get(srv.url + "/api/scalars"))) == 1
+
+        with open(scalars, "a") as f:            # torn append (no newline)
+            f.write('{"step": 1, "lo')
+        rows = json.loads(_get(srv.url + "/api/scalars"))
+        assert len(rows) == 1                    # torn line buffered
+
+        with open(scalars, "a") as f:            # remainder arrives
+            f.write('ss": 0.5}\n{"step": 2, "loss": 0.25}\n')
+        rows = json.loads(_get(srv.url + "/api/scalars"))
+        assert [r["step"] for r in rows] == [0, 1, 2]
+        assert rows[1]["loss"] == 0.5
