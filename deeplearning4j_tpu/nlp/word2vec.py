@@ -68,10 +68,9 @@ class Word2VecConfig:
 
 # -- jitted training steps --------------------------------------------------
 
-@partial(jax.jit, static_argnames=(), donate_argnums=(0, 1))
-def _hs_step(syn0: Array, syn1: Array, inputs: Array, codes: Array,
-             points: Array, mask: Array, alpha: Array):
-    """One batched HS update.
+def _hs_update(syn0: Array, syn1: Array, inputs: Array, codes: Array,
+               points: Array, mask: Array, alpha: Array):
+    """One batched HS update (plain function; jitted wrappers below).
 
     inputs [B] — rows of syn0 to train (context words);
     codes/points/mask [B, L] — the center words' Huffman paths.
@@ -100,9 +99,8 @@ def _hs_step(syn0: Array, syn1: Array, inputs: Array, codes: Array,
     return syn0, syn1
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _neg_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
-              negatives: Array, pair_mask: Array, alpha: Array):
+def _neg_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
+                negatives: Array, pair_mask: Array, alpha: Array):
     """Negative sampling: target center word label 1, K negatives label 0.
     ``pair_mask`` [B] zeroes padded pairs."""
     l1 = syn0[inputs]                                    # [B, D]
@@ -133,26 +131,65 @@ def _neg_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
     return syn0, syn1neg
 
 
+#: jitted single-objective steps (kept for paragraph_vectors and tests)
+_hs_step = partial(jax.jit, donate_argnums=(0, 1))(_hs_update)
+_neg_step = partial(jax.jit, donate_argnums=(0, 1))(_neg_update)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2),
+         static_argnames=("use_hs", "negative"))
+def _chunk_step(syn0: Array, syn1: Array, syn1neg: Array,
+                centers: Array, contexts: Array, n_real: Array,
+                codes_t: Array, points_t: Array, mask_t: Array,
+                table: Array, key: Array, chunk_id: Array, alpha: Array,
+                *, use_hs: bool, negative: int):
+    """One FUSED training chunk: Huffman-path gathers, negative-sample
+    draws, and both objective updates in a single compiled program.
+
+    The eager per-chunk version dispatched ~8 separate device ops
+    (gathers, randint, two jitted steps); under a tunneled TPU that made
+    training dispatch-latency-bound.  All device-resident inputs
+    (codes_t/points_t/mask_t/table) are passed by buffer each call —
+    constant, so nothing re-uploads.  The pad mask is derived on-device
+    from ``n_real`` (one scalar) instead of shipping a [B] float vector
+    per chunk."""
+    pmask = (jnp.arange(centers.shape[0]) < n_real).astype(jnp.float32)
+    if use_hs:
+        syn0, syn1 = _hs_update(
+            syn0, syn1, contexts, codes_t[centers], points_t[centers],
+            mask_t[centers] * pmask[:, None], alpha)
+    if negative > 0:
+        sub = jax.random.fold_in(key, chunk_id)
+        draws = jax.random.randint(
+            sub, (centers.shape[0], negative), 0, table.shape[0])
+        syn0, syn1neg = _neg_update(
+            syn0, syn1neg, contexts, centers, table[draws], pmask, alpha)
+    return syn0, syn1, syn1neg
+
+
 # -- host-side pair generation ---------------------------------------------
 
 def sentence_pairs(idx: np.ndarray, window: int,
                    rng: np.random.RandomState
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """(center, context) pairs with per-position dynamic window shrink
-    (skipGram:314's b = rand % window).  Vectorized numpy."""
+    (skipGram:314's b = rand % window).  Fully vectorized: the previous
+    python double loop topped out around 450k words/s on host, below the
+    device kernel's rate — pair generation must not be the pipeline's
+    bottleneck."""
     n = idx.shape[0]
     if n < 2:
         return (np.empty(0, np.int32),) * 2
-    centers, contexts = [], []
     b = rng.randint(0, window, size=n)
-    for pos in range(n):
-        w = window - b[pos]
-        lo, hi = max(0, pos - w), min(n, pos + w + 1)
-        for j in range(lo, hi):
-            if j != pos:
-                centers.append(idx[pos])
-                contexts.append(idx[j])
-    return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+    deltas = np.concatenate([np.arange(-window, 0),
+                             np.arange(1, window + 1)])      # [2W]
+    pos = np.arange(n)
+    j = pos[:, None] + deltas[None, :]                        # [n, 2W]
+    valid = ((np.abs(deltas)[None, :] <= (window - b)[:, None])
+             & (j >= 0) & (j < n))
+    ci, di = np.nonzero(valid)            # row-major: same order as the
+    return (idx[ci].astype(np.int32),     # reference's per-pos j sweep
+            idx[j[ci, di]].astype(np.int32))
 
 
 class Word2Vec:
@@ -237,40 +274,46 @@ class Word2Vec:
         total = max(1, total_words * cfg.epochs)
 
         words_seen = 0
+        chunk_id = 0
         B = cfg.batch_size
         pend_c = np.empty(0, np.int32)
         pend_x = np.empty(0, np.int32)
+        if cfg.negative > 0 and self.syn1neg is None:
+            raise ValueError(
+                "negative sampling enabled but no syn1neg table: pass "
+                "initial_weights with a syn1neg entry (or None weights to "
+                "initialize fresh)")
+        # syn1neg placeholder so the fused step has a donatable buffer
+        # when negative sampling is OFF (that static branch never reads
+        # it); rethreaded through every call because donation consumes it
+        dummy_neg = jnp.zeros((1, 1), jnp.float32)
 
         def run_chunk(centers_np: np.ndarray, contexts_np: np.ndarray,
                       n_real: int) -> None:
-            """Train one FIXED-size [B] chunk (padded with masked zeros)."""
-            nonlocal nkey
+            """Train one FIXED-size [B] chunk (padded with masked zeros)
+            via the single fused jitted step."""
+            nonlocal chunk_id, dummy_neg
             pad = B - n_real
-            pmask_np = np.concatenate(
-                [np.ones(n_real, np.float32), np.zeros(pad, np.float32)])
             if pad:
                 centers_np = np.concatenate(
                     [centers_np, np.zeros(pad, np.int32)])
                 contexts_np = np.concatenate(
                     [contexts_np, np.zeros(pad, np.int32)])
-            centers = jnp.asarray(centers_np)
-            contexts = jnp.asarray(contexts_np)
-            pmask = jnp.asarray(pmask_np)
             alpha = max(cfg.min_alpha,
                         cfg.alpha * (1.0 - words_seen / total))
-            a = jnp.float32(alpha)
-            if cfg.use_hs:
-                self.syn0, self.syn1 = _hs_step(
-                    self.syn0, self.syn1, contexts, codes_t[centers],
-                    points_t[centers], mask_t[centers] * pmask[:, None], a)
-            if cfg.negative > 0:
-                nkey, sub = jax.random.split(nkey)
-                draws = jax.random.randint(
-                    sub, (B, cfg.negative), 0, table.shape[0])
-                negs = table[draws]
-                self.syn0, self.syn1neg = _neg_step(
-                    self.syn0, self.syn1neg, contexts, centers, negs,
-                    pmask, a)
+            neg_tab = (self.syn1neg if self.syn1neg is not None
+                       else dummy_neg)
+            self.syn0, self.syn1, neg_tab = _chunk_step(
+                self.syn0, self.syn1, neg_tab,
+                jnp.asarray(centers_np), jnp.asarray(contexts_np),
+                n_real, codes_t, points_t, mask_t, table,
+                nkey, chunk_id, jnp.float32(alpha),
+                use_hs=cfg.use_hs, negative=cfg.negative)
+            if self.syn1neg is not None:
+                self.syn1neg = neg_tab
+            else:
+                dummy_neg = neg_tab          # keep a live (undonated) handle
+            chunk_id += 1
 
         def drain(final: bool) -> None:
             nonlocal pend_c, pend_x

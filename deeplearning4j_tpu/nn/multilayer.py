@@ -288,14 +288,17 @@ class MultiLayerNetwork:
         self.params = params
 
     # -- backprop fine-tuning (doBackWard:941 ≡ jax.grad of loss) ----------
-    def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
-                     num_epochs: int = 1, seed: int = 2) -> None:
-        """Full-network supervised minibatch training with ONE fused,
-        jit-compiled train step (value+grad+GradientAdjustment+update).
+    def _backprop_machinery(self):
+        """Build (train_step, updaters) ONCE per network and cache.
 
-        Each layer gets its OWN updater from its conf, so per-layer
-        lr/momentum/l2 overrides (ConfOverride parity) take effect."""
-        params = self._require_params()
+        The jitted step closes over conf/layers only, so rebuilding it on
+        every ``fit_backprop`` call would throw away the XLA compile
+        cache — on TPU that charged a full recompilation (tens of
+        seconds) to every fit invocation.  Mutating ``self.conf`` after
+        the first fit requires a fresh network (same contract as the
+        reference's init()-once lifecycle)."""
+        if getattr(self, "_bp_cache", None) is not None:
+            return self._bp_cache
         updaters = [dl4j_updater(
             lr=c.lr, momentum=c.momentum, momentum_schedule=c.momentum_after,
             use_adagrad=c.use_adagrad, l2=c.l2,
@@ -307,6 +310,11 @@ class MultiLayerNetwork:
 
         @jax.jit
         def train_step(params, ustate, x, y, key, iteration):
+            # derive this step's key on-device from the run key: no
+            # host-side split (whose [n_steps]-shaped output recompiles
+            # whenever the step count changes)
+            key = jax.random.fold_in(key, iteration)
+
             def obj(p):
                 # Single forward: reuse the loss-side activations to
                 # harvest the batch statistics BN's running-stat EMA needs
@@ -344,17 +352,33 @@ class MultiLayerNetwork:
                 new_params[i] = p
             return new_params, new_ustate, score
 
+        self._bp_cache = (train_step, updaters)
+        return self._bp_cache
+
+    def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
+                     num_epochs: int = 1, seed: int = 2) -> None:
+        """Full-network supervised minibatch training with ONE fused,
+        jit-compiled train step (value+grad+GradientAdjustment+update),
+        compiled once per network and reused across fit calls.
+
+        Each layer gets its OWN updater from its conf, so per-layer
+        lr/momentum/l2 overrides (ConfOverride parity) take effect."""
+        params = self._require_params()
+        train_step, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]
-        key = jax.random.key(seed)
         batches = [data] if isinstance(data, DataSet) else list(data)
+        run_key = jax.random.key(seed)
         it = 0
         for epoch in range(num_epochs):
             for batch in batches:
-                key, sub = jax.random.split(key)
                 params, ustate, score = train_step(
-                    params, ustate, batch.features, batch.labels, sub, it)
-                for ls in self.listeners:
-                    ls.iteration_done(self, it, float(score))
+                    params, ustate, batch.features, batch.labels,
+                    run_key, it)
+                # float(score) synchronizes host<->device; only pay for
+                # it when someone is listening
+                if self.listeners:
+                    for ls in self.listeners:
+                        ls.iteration_done(self, it, float(score))
                 it += 1
         self.params = params
 

@@ -156,6 +156,7 @@ class ConsoleServer:
         self._scalars_offset = 0
         self._scalars_rows: list = []
         self._scalars_tail = b""
+        self._scalars_head = b""          # head fingerprint of the file
 
     # -- data sources --------------------------------------------------------
     def scalar_rows(self) -> list:
@@ -169,12 +170,24 @@ class ConsoleServer:
             return []
         with self._scalars_lock:
             size = os.path.getsize(self.scalars_path)
-            if size < self._scalars_offset:      # truncated/rotated: reset
+            # replacement detection: size shrink alone misses a rewritten
+            # file that regrew past the cached offset between polls, so
+            # fingerprint the head bytes too
+            head = b""
+            if self._scalars_head:
+                with open(self.scalars_path, "rb") as f:
+                    head = f.read(len(self._scalars_head))
+            if size < self._scalars_offset or (self._scalars_head
+                                               and head
+                                               != self._scalars_head):
                 self._scalars_offset = 0
                 self._scalars_rows = []
                 self._scalars_tail = b""
+                self._scalars_head = b""
             if size > self._scalars_offset:
                 with open(self.scalars_path, "rb") as f:
+                    if not self._scalars_head:
+                        self._scalars_head = f.read(64)
                     f.seek(self._scalars_offset)
                     chunk = self._scalars_tail + f.read()
                     self._scalars_offset = f.tell()

@@ -84,3 +84,20 @@ def test_console_scalars_incremental_and_torn_line_tolerant(tmp_path):
         rows = json.loads(_get(srv.url + "/api/scalars"))
         assert [r["step"] for r in rows] == [0, 1, 2]
         assert rows[1]["loss"] == 0.5
+
+
+def test_console_scalars_detects_file_replacement(tmp_path):
+    """A rewritten scalars file (new run) that regrows past the cached
+    offset must reset the cache, not serve stale rows + mid-file bytes."""
+    scalars = str(tmp_path / "s.jsonl")
+    with open(scalars, "w") as f:
+        for i in range(5):
+            f.write('{"step": %d, "loss": 9.0}\n' % i)
+    with ConsoleServer(scalars_path=scalars) as srv:
+        assert len(json.loads(_get(srv.url + "/api/scalars"))) == 5
+        with open(scalars, "w") as f:        # new run, same-or-bigger size
+            for i in range(8):
+                f.write('{"step": %d, "acc": 0.5}\n' % i)
+        rows = json.loads(_get(srv.url + "/api/scalars"))
+        assert len(rows) == 8
+        assert all("acc" in r for r in rows)   # no stale old-run rows

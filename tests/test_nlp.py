@@ -163,3 +163,19 @@ def test_word_vectors_binary_no_trailing_newline(tmp_path):
     back = load_word_vectors_binary(str(p))
     np.testing.assert_allclose(np.asarray(back.vectors), vecs, rtol=1e-6)
     assert back.has_word("beta")
+
+
+def test_word2vec_negative_requires_syn1neg_on_warm_start():
+    """negative>0 with a warm start missing the syn1neg table must fail
+    loudly, not silently train against a dummy table."""
+    import pytest
+
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig
+
+    corpus = ["the cat sat on the mat", "the dog sat on the rug"] * 5
+    cfg = Word2VecConfig(vector_size=8, negative=5, epochs=1, batch_size=64)
+    a = Word2Vec(corpus, cfg)
+    a.fit()
+    b = Word2Vec(corpus, cfg, cache=a.cache)
+    with pytest.raises(ValueError, match="syn1neg"):
+        b.fit(initial_weights=(a.syn0, a.syn1, None))
