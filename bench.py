@@ -78,6 +78,15 @@ def _mfu(flops_per_step: float, step_s: float, device_kind: str,
 
 # -- inner benches ----------------------------------------------------------
 
+def _value_sync(x) -> float:
+    """Force real completion of a dispatch chain by FETCHING a value.
+    ``jax.block_until_ready`` returns early on the tunneled axon device,
+    so only a host read of a result element bounds timing honestly."""
+    import numpy as np
+
+    return float(np.asarray(x).ravel()[0])
+
+
 def bench_probe():
     """Cheap backend probe: initializes the default backend and reports it."""
     platform, kind, n = _platform_info()
@@ -162,7 +171,7 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
     }
 
 
-def bench_resnet(batch_size: int = 64, image_size: int = 224,
+def bench_resnet(batch_size: int = 128, image_size: int = 224,
                  steps: int = 20, warmup: int = 3):
     """ResNet-50 training throughput (BASELINE.json configs)."""
     import jax
@@ -214,20 +223,21 @@ def lenet_train_flops(batch: int) -> float:
     return 3.0 * 2.0 * macs * batch
 
 
-def bench_lenet(batch_size: int = 128, steps: int = 30, warmup: int = 4):
+def bench_lenet(batch_size: int = 128, steps: int = 64, warmup: int = 64):
     """LeNet-MNIST through the REAL MultiLayerNetwork.fit path (the
     flagship API — nn/multilayer/MultiLayerNetwork.java:918 parity), not a
-    hand-rolled train step.  Timed the way real training runs: a pipelined
-    fit (no per-step host sync — listeners would force one device
-    round-trip per step, latency-bound under a tunneled TPU) bracketed by
-    ``block_until_ready``."""
+    hand-rolled train step.  Uniform batch lists run fit's scanned-epoch
+    path (one dispatch per epoch); the sync is a VALUE fetch of a param
+    element — ``block_until_ready`` returns early on the tunneled axon
+    device and under-measures."""
     import jax
+    import numpy as np
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models import lenet
 
     platform, kind, n_dev = _platform_info()
     if platform == "cpu":
-        batch_size, steps = 32, 8
+        batch_size, steps, warmup = 32, 8, 8
 
     net = lenet.lenet()
     key = jax.random.key(0)
@@ -236,11 +246,17 @@ def bench_lenet(batch_size: int = 128, steps: int = 30, warmup: int = 4):
         jax.random.randint(jax.random.key(1), (batch_size,), 0, 10), 10)
     batch = DataSet(x, labels)
 
-    net.fit_backprop([batch] * max(warmup, 1), num_epochs=1)   # compile
-    jax.block_until_ready(net.params)
+    def true_sync():
+        return _value_sync(jax.tree.leaves(net.params)[0])
+
+    # warmup batch-list length MUST equal steps: the scanned epoch
+    # specializes on the stacked leading dim, so a different length
+    # would put a fresh compile inside the timing window
+    net.fit_backprop([batch] * steps, num_epochs=1)            # compile
+    true_sync()
     t0 = time.perf_counter()
     net.fit_backprop([batch] * steps, num_epochs=1)
-    jax.block_until_ready(net.params)
+    true_sync()
     step_s = (time.perf_counter() - t0) / steps
     sps = batch_size / step_s
     flops = lenet_train_flops(batch_size)
@@ -282,14 +298,16 @@ def bench_word2vec(n_sentences: int = 1600, sent_len: int = 30,
     # per-row mean normalization in the update keeps big batches stable
     cfg = Word2VecConfig(vector_size=100, window=5, epochs=epochs,
                          negative=5, use_hs=True, batch_size=16384)
-    import jax
-
     w2v = Word2Vec(sentences, cfg)
+
+    def true_sync():
+        return _value_sync(w2v.syn0)
+
     w2v.fit()          # warmup: compiles the HS/neg-sampling kernels
-    jax.block_until_ready(w2v.syn0)
+    true_sync()
     t0 = time.perf_counter()
     w2v.fit()          # measured: same shapes, cached executables
-    jax.block_until_ready(w2v.syn0)   # fit dispatches async; time real work
+    true_sync()
     dt = time.perf_counter() - t0
     wps = total_words / dt
     return {

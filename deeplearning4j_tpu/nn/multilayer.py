@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.conf.configuration import (
@@ -50,6 +51,10 @@ Params = List[Dict[str, Array]]
 
 
 class MultiLayerNetwork:
+    #: scanned-epoch fast path stacks the dataset on device; above this
+    #: budget fit_backprop streams batch-by-batch instead (no OOM)
+    SCAN_MAX_DATASET_BYTES = 256 * 1024 * 1024
+
     def __init__(self, conf: MultiLayerConfiguration,
                  params: Optional[Params] = None):
         self.conf = conf
@@ -191,25 +196,41 @@ class MultiLayerNetwork:
                     constrain_unit_norm=conf.constrain_gradient_to_unit_norm,
                 )
 
-                @jax.jit
-                def gd_step(p, ustate, inputs, k, it, _layer=layer,
-                            _updater=updater):
-                    score, grads = _layer.pretrain_value_and_grad(p, k, inputs)
-                    # batch_size=1: objectives are batch MEANS (the ÷batch
-                    # step exists for parity with summed reference grads)
-                    updates, ustate = _updater.update(ustate, grads, p, it, 1)
-                    return apply_updates(p, updates), ustate, score
+                # cache the jitted per-layer step on the network: a fresh
+                # closure per pretrain() call would recompile every time
+                # (the fit_backprop lesson); key derives on-device
+                if not hasattr(self, "_pretrain_cache"):
+                    self._pretrain_cache = {}
+                if i not in self._pretrain_cache:
+                    @jax.jit
+                    def gd_step(p, ustate, inputs, k, it, _layer=layer,
+                                _updater=updater):
+                        k = jax.random.fold_in(k, it)
+                        score, grads = _layer.pretrain_value_and_grad(
+                            p, k, inputs)
+                        # batch_size=1: objectives are batch MEANS (the
+                        # ÷batch step exists for parity with summed
+                        # reference grads)
+                        updates, ustate = _updater.update(
+                            ustate, grads, p, it, 1)
+                        return apply_updates(p, updates), ustate, score
+                    self._pretrain_cache[i] = gd_step
+                gd_step = self._pretrain_cache[i]
 
                 ustate = updater.init(params[i])
                 it = 0
+                # distinct key stream per LAYER: fold_in(key, it) alone
+                # would replay identical corruption/Gibbs noise in every
+                # layer of the stack
+                layer_key = jax.random.fold_in(key, i)
                 for batch in batches:
                     inputs = layer_input(batch.features)
                     for _ in range(conf.num_iterations):
-                        key, sub = jax.random.split(key)
                         params[i], ustate, score = gd_step(
-                            params[i], ustate, inputs, sub, it)
-                        for ls in self.listeners:
-                            ls.iteration_done(self, it, float(score))
+                            params[i], ustate, inputs, layer_key, it)
+                        if self.listeners:
+                            for ls in self.listeners:
+                                ls.iteration_done(self, it, float(score))
                         it += 1
             else:
                 for b, batch in enumerate(batches):
@@ -308,8 +329,7 @@ class MultiLayerNetwork:
         bn_layers = [i for i, c in enumerate(self.conf.confs)
                      if c.kind is LayerKind.BATCH_NORM]
 
-        @jax.jit
-        def train_step(params, ustate, x, y, key, iteration):
+        def step_body(params, ustate, x, y, key, iteration):
             # derive this step's key on-device from the run key: no
             # host-side split (whose [n_steps]-shaped output recompiles
             # whenever the step count changes)
@@ -352,7 +372,26 @@ class MultiLayerNetwork:
                 new_params[i] = p
             return new_params, new_ustate, score
 
-        self._bp_cache = (train_step, updaters)
+        train_step = jax.jit(step_body)
+
+        @jax.jit
+        def train_epoch(params, ustate, xs, ys, key, it0):
+            """One dispatch per EPOCH: lax.scan the step over device-
+            stacked batches [NB, B, ...].  A python per-step loop costs
+            one host->device dispatch round-trip per step — under a
+            tunneled TPU that latency (10-20 ms) dwarfs small-model step
+            compute by orders of magnitude."""
+            def body(carry, inp):
+                p, u, it = carry
+                x, y = inp
+                p, u, score = step_body(p, u, x, y, key, it)
+                return (p, u, it + 1), score
+
+            (params, ustate, _), scores = lax.scan(
+                body, (params, ustate, it0), (xs, ys))
+            return params, ustate, scores
+
+        self._bp_cache = (train_step, train_epoch, updaters)
         return self._bp_cache
 
     def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
@@ -361,25 +400,51 @@ class MultiLayerNetwork:
         jit-compiled train step (value+grad+GradientAdjustment+update),
         compiled once per network and reused across fit calls.
 
+        Uniform-shape batch lists run as a scanned EPOCH — a single
+        device dispatch per epoch, with listeners replayed from the
+        scanned per-step scores afterwards.  Ragged batch lists (or a
+        lone DataSet) use the per-step path.
+
         Each layer gets its OWN updater from its conf, so per-layer
         lr/momentum/l2 overrides (ConfOverride parity) take effect."""
         params = self._require_params()
-        train_step, updaters = self._backprop_machinery()
+        train_step, train_epoch, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         batches = [data] if isinstance(data, DataSet) else list(data)
         run_key = jax.random.key(seed)
+        # the scanned path stacks every batch on device: only take it when
+        # the whole dataset comfortably fits in HBM, else stream per-step
+        total_bytes = sum(
+            np.asarray(b.features).nbytes + np.asarray(b.labels).nbytes
+            for b in batches)
+        uniform = (len(batches) > 1
+                   and total_bytes <= self.SCAN_MAX_DATASET_BYTES
+                   and len({(b.features.shape, b.labels.shape)
+                            for b in batches}) == 1)
         it = 0
-        for epoch in range(num_epochs):
-            for batch in batches:
-                params, ustate, score = train_step(
-                    params, ustate, batch.features, batch.labels,
-                    run_key, it)
-                # float(score) synchronizes host<->device; only pay for
-                # it when someone is listening
+        if uniform:
+            xs = jnp.stack([jnp.asarray(b.features) for b in batches])
+            ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
+            for epoch in range(num_epochs):
+                params, ustate, scores = train_epoch(
+                    params, ustate, xs, ys, run_key, it)
                 if self.listeners:
-                    for ls in self.listeners:
-                        ls.iteration_done(self, it, float(score))
-                it += 1
+                    for j, s in enumerate(np.asarray(scores)):
+                        for ls in self.listeners:
+                            ls.iteration_done(self, it + j, float(s))
+                it += len(batches)
+        else:
+            for epoch in range(num_epochs):
+                for batch in batches:
+                    params, ustate, score = train_step(
+                        params, ustate, batch.features, batch.labels,
+                        run_key, it)
+                    # float(score) synchronizes host<->device; only pay
+                    # for it when someone is listening
+                    if self.listeners:
+                        for ls in self.listeners:
+                            ls.iteration_done(self, it, float(score))
+                    it += 1
         self.params = params
 
     # -- fit (fit:918 parity: pretrain -> finetune -> optional backprop) ---

