@@ -78,6 +78,19 @@ def _mfu(flops_per_step: float, step_s: float, device_kind: str,
 
 # -- inner benches ----------------------------------------------------------
 
+def _sanitize(obj):
+    """NaN/inf -> None so the printed line is STRICT JSON (json.dumps
+    would emit bare NaN tokens jq and friends cannot parse)."""
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_sanitize(v) for v in obj]
+    if isinstance(obj, float) and (obj != obj or obj in (float("inf"),
+                                                         float("-inf"))):
+        return None
+    return obj
+
+
 def _value_sync(x) -> float:
     """Force real completion of a dispatch chain by FETCHING a value.
     ``jax.block_until_ready`` returns early on the tunneled axon device,
@@ -127,11 +140,14 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
     # failure must degrade to XLA attention, not kill the benchmark.
     flash_used = False
     attn = make_flash_attn(mesh)
+    from deeplearning4j_tpu.ops.pallas_attention import FLASH_MIN_SEQ
     if attn is not tfm.attention:
         try:
             q = jnp.zeros((n_dev, seq_len, 1, 64), jnp.bfloat16)
             float(jnp.sum(attn(q, q, q, None, False)))
-            flash_used = True
+            # the mesh-aware wrapper dispatches XLA attention below the
+            # measured flash/XLA crossover; report what actually runs
+            flash_used = seq_len >= FLASH_MIN_SEQ
         except Exception as e:  # pragma: no cover - TPU-compile specific
             print(f'{{"warn": "flash attention unavailable: {e!r}"}}',
                   file=sys.stderr)
@@ -223,7 +239,7 @@ def lenet_train_flops(batch: int) -> float:
     return 3.0 * 2.0 * macs * batch
 
 
-def bench_lenet(batch_size: int = 128, steps: int = 64, warmup: int = 64):
+def bench_lenet(batch_size: int = 128, steps: int = 64):
     """LeNet-MNIST through the REAL MultiLayerNetwork.fit path (the
     flagship API — nn/multilayer/MultiLayerNetwork.java:918 parity), not a
     hand-rolled train step.  Uniform batch lists run fit's scanned-epoch
@@ -237,7 +253,7 @@ def bench_lenet(batch_size: int = 128, steps: int = 64, warmup: int = 64):
 
     platform, kind, n_dev = _platform_info()
     if platform == "cpu":
-        batch_size, steps, warmup = 32, 8, 8
+        batch_size, steps = 32, 8
 
     net = lenet.lenet()
     key = jax.random.key(0)
@@ -385,11 +401,13 @@ def bench_scaling(ndp: int = 8, steps: int = 20, warmup: int = 3,
     }
 
 
-def bench_longctx(batch_size: int = 1, seq_len: int = 2048,
+def bench_longctx(batch_size: int = 1, seq_len: int = 8192,
                   n_heads: int = 12, head_dim: int = 64,
                   steps: int = 10, warmup: int = 2):
     """Long-context attention microbench: Pallas flash kernel vs plain XLA
-    attention, fwd+bwd at seq_len."""
+    attention, fwd+bwd at seq_len.  Default 8192 — the regime the flash
+    kernel exists for (measured v5e: 5x over XLA at 8192; XLA OOMs at
+    16384 while flash runs)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models import transformer as tfm
@@ -417,7 +435,10 @@ def bench_longctx(batch_size: int = 1, seq_len: int = 2048,
         float(jnp.sum(out[0].astype(jnp.float32)))
         return (time.perf_counter() - t0) / steps
 
-    t_plain = time_fn(tfm.attention)
+    try:
+        t_plain = time_fn(tfm.attention)
+    except Exception:          # XLA OOMs at very long T; flash still runs
+        t_plain = float("nan")
     if platform == "tpu":
         try:
             t_flash = time_fn(lambda q, k, v, m, c:
@@ -506,7 +527,7 @@ def main() -> None:
             ndev = int(args[args.index("--ndev") + 1]) \
                 if "--ndev" in args else 8
             _force_cpu(ndev)
-        print(json.dumps(INNER[name]()))
+        print(json.dumps(_sanitize(INNER[name]())))
         return
 
     which = args[0] if args else "all"
@@ -518,7 +539,7 @@ def main() -> None:
         out = run_config(which, tpu_ok)
         if not tpu_ok and probe_err:
             out.setdefault("tpu_error", probe_err)
-        print(json.dumps(out))
+        print(json.dumps(_sanitize(out)))
         return
 
     headline = run_config("bert", tpu_ok)
@@ -534,7 +555,7 @@ def main() -> None:
     out["suite"] = suite
     if not tpu_ok and probe_err:
         out["tpu_error"] = probe_err
-    print(json.dumps(out))
+    print(json.dumps(_sanitize(out)))
 
 
 if __name__ == "__main__":

@@ -196,9 +196,12 @@ class MultiLayerNetwork:
                     constrain_unit_norm=conf.constrain_gradient_to_unit_norm,
                 )
 
-                # cache the jitted per-layer step on the network: a fresh
-                # closure per pretrain() call would recompile every time
-                # (the fit_backprop lesson); key derives on-device
+                # cache the jitted per-layer step AND its updater on the
+                # network: a fresh closure per pretrain() call would
+                # recompile every time (the fit_backprop lesson), and the
+                # ustate init must come from the same updater the cached
+                # step closes over.  Like _bp_cache: mutating conf after
+                # the first fit requires a fresh network.
                 if not hasattr(self, "_pretrain_cache"):
                     self._pretrain_cache = {}
                 if i not in self._pretrain_cache:
@@ -214,8 +217,8 @@ class MultiLayerNetwork:
                         updates, ustate = _updater.update(
                             ustate, grads, p, it, 1)
                         return apply_updates(p, updates), ustate, score
-                    self._pretrain_cache[i] = gd_step
-                gd_step = self._pretrain_cache[i]
+                    self._pretrain_cache[i] = (gd_step, updater)
+                gd_step, updater = self._pretrain_cache[i]
 
                 ustate = updater.init(params[i])
                 it = 0
@@ -413,10 +416,14 @@ class MultiLayerNetwork:
         batches = [data] if isinstance(data, DataSet) else list(data)
         run_key = jax.random.key(seed)
         # the scanned path stacks every batch on device: only take it when
-        # the whole dataset comfortably fits in HBM, else stream per-step
-        total_bytes = sum(
-            np.asarray(b.features).nbytes + np.asarray(b.labels).nbytes
-            for b in batches)
+        # the whole dataset comfortably fits in HBM, else stream per-step.
+        # Sized from shape/dtype — np.asarray here would D2H-copy every
+        # device-resident batch just to count bytes
+        def _nbytes(a):
+            import math
+            return math.prod(a.shape) * jnp.dtype(a.dtype).itemsize
+        total_bytes = sum(_nbytes(b.features) + _nbytes(b.labels)
+                          for b in batches)
         uniform = (len(batches) > 1
                    and total_bytes <= self.SCAN_MAX_DATASET_BYTES
                    and len({(b.features.shape, b.labels.shape)

@@ -32,6 +32,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+try:                                     # TPU-only compiler knobs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                      # pragma: no cover
+    pltpu = None
+
+
+def _dimsem(*sems):
+    """dimension_semantics compiler params: 'parallel' grid dims can be
+    pipelined/reordered by Mosaic; the accumulation dim of the backward
+    kernels must stay 'arbitrary' (sequential revisiting)."""
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=sems)
+
 Array = jax.Array
 
 # Additive mask value.  Deliberately NOT -1e30: the backward pass
@@ -43,6 +57,14 @@ Array = jax.Array
 # -1e5 still underflows exp() to exactly 0 against any real score.
 _MASK_VAL = -1e5
 _NEG_INIT = -1e30                    # running-max seed only; never stored
+
+
+def _scratch(shape):
+    """fp32 VMEM scratch; plain ShapeDtypeStruct when the TPU pallas
+    module is unavailable (interpret-only builds)."""
+    if pltpu is None:
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+    return pltpu.VMEM(shape, jnp.float32)
 
 
 def _pick_block(t: int, preferred: int) -> int:
@@ -57,64 +79,66 @@ def _pick_block(t: int, preferred: int) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                scale: float, block_k: int, causal: bool):
-    """One (batch*head, q-block) grid step.
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_sc, l_sc, acc_sc, *, scale: float, causal: bool):
+    """One (batch*head, q-block, k-block) grid step — FULLY streaming.
 
-    q_ref [1, bq, D]; k_ref/v_ref [1, T, D]; bias_ref [1, T, 1] additive
-    mask; o_ref [1, bq, D]; lse_ref [1, bq, 1].
+    q_ref [1, bq, D]; k_ref/v_ref [1, bk, D]; bias_ref [1, bk, 1];
+    o_ref [1, bq, D]; lse_ref [1, bq, 1].  The online-softmax running
+    statistics live in VMEM scratch carried across the innermost
+    (k-block) grid dimension; k/v stream block-by-block from HBM, so
+    VMEM residency is O(block) at ANY sequence length (the
+    full-K/V-in-VMEM form crashed the TPU compiler at T=16384).
 
     The per-row tensors (bias, lse, delta) carry a trailing singleton dim
     at every pallas boundary: Mosaic requires a block's last two dims to
     be (divisible by 8, divisible by 128) or equal to the array dims, and
-    a [1, T]-blocked 2D array violates the sublane rule; [bq, 1] / [T, 1]
-    blocks satisfy it by dim equality.
+    a [1, T]-blocked 2D array violates the sublane rule; [bq, 1] / [bk,
+    1] blocks satisfy it by dim equality.
     """
     qi = pl.program_id(1)
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
     bq = q_ref.shape[1]
-    T = k_ref.shape[1]
-    D = q_ref.shape[2]
-    n_k = T // block_k
+    bk = k_ref.shape[1]
 
-    q = q_ref[0]                                         # [bq, D]
-    m0 = jnp.full((bq, 1), _NEG_INIT, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, D), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc[...], _NEG_INIT)
+        l_sc[...] = jnp.zeros_like(l_sc[...])
+        acc_sc[...] = jnp.zeros_like(acc_sc[...])
 
-    q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    # causal: key blocks strictly above the diagonal contribute nothing
+    live = jnp.logical_or(not causal, qi * bq + bq > j * bk)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]     # [bk, D]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]                                     # [bq, D]
+        k = k_ref[0]                                     # [bk, D]
+        v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias_ref[0, pl.ds(j * block_k, block_k), 0][None, :]
+        s = s + bias_ref[0, :, 0][None, :]
         if causal:
-            k_cols = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_rows >= k_cols, s, _MASK_VAL)
 
+        m = m_sc[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)                           # [bq, bk] fp32
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_sc[...] = l_sc[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        acc = acc * alpha + pv
-        return m_new, l, acc
+        acc_sc[...] = acc_sc[...] * alpha + pv
+        m_sc[...] = m_new
 
-    if causal:
-        # key blocks strictly above the diagonal contribute nothing
-        n_live = lax.div(qi * bq + bq + block_k - 1, block_k)
-        n_iter = jnp.minimum(n_live, n_k)
-    else:
-        n_iter = n_k
-    m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
-
-    l = jnp.maximum(l, 1e-30)                            # fully-masked rows
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)                          # [bq, 1]
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[...], 1e-30)                # fully-masked rows
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_sc[...] + jnp.log(l)              # [bq, 1]
 
 
 def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
@@ -126,26 +150,32 @@ def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
     bk = _pick_block(Tk, block_k)
     scale = 1.0 / (D ** 0.5)
 
-    kern = functools.partial(_fwd_kernel, scale=scale, block_k=bk,
-                             causal=causal)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal)
     o, lse3 = pl.pallas_call(
         kern,
-        grid=(BH, Tq // bq),
+        grid=(BH, Tq // bq, Tk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, Tk, 1), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, 1), lambda bh, i, j: (bh, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tq, D), q4.dtype),
             jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            _scratch((bq, 1)),
+            _scratch((bq, 1)),
+            _scratch((bq, D)),
+        ],
         interpret=interpret,
+        compiler_params=None if interpret else _dimsem(
+            "parallel", "parallel", "arbitrary"),
     )(q4, k4, v4, bias[:, :, None])
     return o, lse3[..., 0]
 
@@ -156,100 +186,95 @@ def _fwd(q4, k4, v4, bias, causal, block_q, block_k, interpret):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *,
-                    scale: float, block_q: int, causal: bool):
-    """Grid (BH, key-blocks): accumulate dK/dV for one key block by
-    streaming query blocks."""
+                    scale: float, causal: bool):
+    """Grid (BH, key-blocks, query-blocks): the dk/dv OUT block for a key
+    block is revisited across every query-block grid step and accumulated
+    in place (fp32 outputs).
+
+    Streaming q/do/lse/delta per GRID STEP — rather than holding the full
+    [T, D] tensors in VMEM and walking them with an inner fori_loop —
+    keeps VMEM residency O(block) at any sequence length (the inner-loop
+    form crashed the TPU compiler at T=8192)."""
     kj = pl.program_id(1)
+    i = pl.program_id(2)
+    bq = q_ref.shape[1]
     bk = k_ref.shape[1]
-    T = q_ref.shape[1]
-    D = q_ref.shape[2]
-    n_q = T // block_q
 
-    k = k_ref[0]                                         # [bk, D]
-    v = v_ref[0]
-    bias = bias_ref[0, :, 0][None, :]                    # [1, bk] (this block)
-    k_cols = kj * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]     # [bq, D]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]      # [bq, 1]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+    k_cols = kj * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    q_rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    # causal: a query block strictly before this key block sees none of it
+    live = jnp.logical_or(not causal, (i + 1) * bq > kj * bk)
 
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]                                     # [bq, D]
+        do = do_ref[0]
+        lse = lse_ref[0]                                 # [bq, 1]
+        delta = delta_ref[0]
+        k = k_ref[0]                                     # [bk, D]
+        v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias
+        s = s + bias_ref[0, :, 0][None, :]
         if causal:
-            q_rows = i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
             s = jnp.where(q_rows >= k_cols, s, _MASK_VAL)
         p = jnp.exp(s - lse)                             # [bq, bk] fp32
 
-        dv = dv + lax.dot_general(p.astype(do.dtype), do,
-                                  (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
+        dv_ref[0] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale                    # [bq, bk]
-        dk = dk + lax.dot_general(ds.astype(q.dtype), q,
-                                  (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        return dk, dv
-
-    if causal:
-        # query blocks strictly before this key block see none of it
-        i0 = lax.div(kj * bk, block_q)
-    else:
-        i0 = 0
-    dk0 = jnp.zeros((bk, D), jnp.float32)
-    dv0 = jnp.zeros((bk, D), jnp.float32)
-    dk, dv = lax.fori_loop(i0, n_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        dk_ref[0] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, *,
-                   scale: float, block_k: int, causal: bool):
-    """Grid (BH, query-blocks): accumulate dQ for one query block."""
+                   scale: float, causal: bool):
+    """Grid (BH, query-blocks, key-blocks): accumulate the revisited dQ
+    block across key-block grid steps (same streaming rationale as
+    _bwd_dkv_kernel)."""
     qi = pl.program_id(1)
+    j = pl.program_id(2)
     bq = q_ref.shape[1]
-    T = k_ref.shape[1]
-    D = q_ref.shape[2]
-    n_k = T // block_k
+    bk = k_ref.shape[1]
 
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0]                                     # [bq, 1]
-    delta = delta_ref[0]
-    q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    q_rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    live = jnp.logical_or(not causal, qi * bq + bq > j * bk)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias_ref[0, pl.ds(j * block_k, block_k), 0][None, :]
+        s = s + bias_ref[0, :, 0][None, :]
         if causal:
-            k_cols = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_rows >= k_cols, s, _MASK_VAL)
         p = jnp.exp(s - lse)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + lax.dot_general(ds.astype(k.dtype), k,
-                                    (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-
-    if causal:
-        n_live = lax.div(qi * bq + bq + block_k - 1, block_k)
-        n_iter = jnp.minimum(n_live, n_k)
-    else:
-        n_iter = n_k
-    dq = lax.fori_loop(0, n_iter, body, jnp.zeros((bq, D), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        dq_ref[0] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
 
 def _bwd(causal, block_q, block_k, interpret, residuals, do4):
@@ -264,56 +289,63 @@ def _bwd(causal, block_q, block_k, interpret, residuals, do4):
     delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32),
                     axis=-1)                             # [BH, Tq]
 
-    full = lambda bh, i: (bh, 0, 0)
     # trailing singleton at the pallas boundary (see _fwd_kernel docstring)
     bias3, lse3, delta3 = (bias[:, :, None], lse[:, :, None],
                            delta[:, :, None])
 
     dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
-                                 block_q=bq, causal=causal)
+                                 causal=causal)
+    # grid (BH, kv-blocks, q-blocks): the dk/dv out block is indexed by
+    # (bh, kj) only, so it stays resident across the q dimension of the
+    # grid and the kernel accumulates into it (fp32; cast after)
     dk4, dv4 = pl.pallas_call(
         dkv_kern,
-        grid=(BH, Tk // bk),
+        grid=(BH, Tk // bk, Tq // bq),
         in_specs=[
-            pl.BlockSpec((1, Tq, D), full),                      # q
-            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # k block
-            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),  # v block
-            pl.BlockSpec((1, bk, 1), lambda bh, j: (bh, j, 0)),  # bias block
-            pl.BlockSpec((1, Tq, D), full),                      # do
-            pl.BlockSpec((1, Tq, 1), full),                      # lse
-            pl.BlockSpec((1, Tq, 1), full),                      # delta
+            pl.BlockSpec((1, bq, D), lambda bh, kj, i: (bh, i, 0)),  # q
+            pl.BlockSpec((1, bk, D), lambda bh, kj, i: (bh, kj, 0)),  # k
+            pl.BlockSpec((1, bk, D), lambda bh, kj, i: (bh, kj, 0)),  # v
+            pl.BlockSpec((1, bk, 1), lambda bh, kj, i: (bh, kj, 0)),  # bias
+            pl.BlockSpec((1, bq, D), lambda bh, kj, i: (bh, i, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda bh, kj, i: (bh, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda bh, kj, i: (bh, i, 0)),  # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, kj, i: (bh, kj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tk, D), k4.dtype),
-            jax.ShapeDtypeStruct((BH, Tk, D), v4.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tk, D), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=None if interpret else _dimsem(
+            "parallel", "parallel", "arbitrary"),
     )(q4, k4, v4, bias3, do4, lse3, delta3)
 
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale,
-                                block_k=bk, causal=causal)
+                                causal=causal)
     dq4 = pl.pallas_call(
         dq_kern,
-        grid=(BH, Tq // bq),
+        grid=(BH, Tq // bq, Tk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # q block
-            pl.BlockSpec((1, Tk, D), full),                      # k
-            pl.BlockSpec((1, Tk, D), full),                      # v
-            pl.BlockSpec((1, Tk, 1), full),                      # bias
-            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),  # do block
-            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),  # lse block
-            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),  # delta blk
+            pl.BlockSpec((1, bq, D), lambda bh, qi, j: (bh, qi, 0)),  # q
+            pl.BlockSpec((1, bk, D), lambda bh, qi, j: (bh, j, 0)),   # k
+            pl.BlockSpec((1, bk, D), lambda bh, qi, j: (bh, j, 0)),   # v
+            pl.BlockSpec((1, bk, 1), lambda bh, qi, j: (bh, j, 0)),   # bias
+            pl.BlockSpec((1, bq, D), lambda bh, qi, j: (bh, qi, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, j: (bh, qi, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, j: (bh, qi, 0)),  # delta
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q4.dtype),
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi, j: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), jnp.float32),
         interpret=interpret,
+        compiler_params=None if interpret else _dimsem(
+            "parallel", "parallel", "arbitrary"),
     )(q4, k4, v4, bias3, do4, lse3, delta3)
 
-    return dq4, dk4, dv4, None  # no gradient for bias
+    return (dq4.astype(q4.dtype), dk4.astype(k4.dtype),
+            dv4.astype(v4.dtype), None)  # no gradient for bias
 
 
 # ---------------------------------------------------------------------------
@@ -377,19 +409,29 @@ def _aligned_for_tpu(Tq: int, Tk: int, D: int) -> bool:
             and D % 8 == 0 and D <= 256)
 
 
+#: below this key length XLA's fused attention wins on TPU (the T² score
+#: matrix still fits HBM comfortably and avoids flash's revisit
+#: bookkeeping); measured v5e crossover: parity at 4096, flash 5x at
+#: 8192, XLA OOM at 16384
+FLASH_MIN_SEQ = 4096
+
+
 def attention_auto(q: Array, k: Array, v: Array,
                    mask: Optional[Array] = None,
                    causal: bool = False) -> Array:
-    """Pallas flash attention when it can actually run well: on a single
-    TPU device with Mosaic-friendly shapes.  Everywhere else — CPU (the
-    interpreter is far too slow for real training), unaligned shapes
-    (degenerate block sizes), or multi-device meshes (a pallas_call inside
-    a GSPMD-jitted step cannot be partitioned; use ``make_flash_attn``
-    with the mesh instead) — the plain XLA attention.
+    """Pallas flash attention when it actually wins: on a single TPU
+    device, Mosaic-friendly shapes, and LONG sequences (>=
+    ``FLASH_MIN_SEQ``, where XLA's T² materialization turns into an HBM
+    problem).  Everywhere else — CPU (the interpreter is far too slow for
+    real training), unaligned shapes, short sequences, or multi-device
+    meshes (a pallas_call inside a GSPMD-jitted step cannot be
+    partitioned; use ``make_flash_attn`` with the mesh instead) — the
+    plain XLA attention.
     """
     from deeplearning4j_tpu.models import transformer as tfm
 
     if (jax.devices()[0].platform == "tpu" and jax.device_count() == 1
+            and k.shape[1] >= FLASH_MIN_SEQ
             and _aligned_for_tpu(q.shape[1], k.shape[1], q.shape[3])):
         return flash_attention(q, k, v, mask, causal)
     return tfm.attention(q, k, v, mask, causal)
@@ -425,7 +467,7 @@ def make_flash_attn(mesh):
     def attn(q, k, v, mask=None, causal=False):
         B, Tq, NH, D = q.shape
         Tk = k.shape[1]
-        if (B % dp != 0 or NH % tp != 0
+        if (B % dp != 0 or NH % tp != 0 or Tk < FLASH_MIN_SEQ
                 or not _aligned_for_tpu(Tq, Tk, D)):
             return tfm.attention(q, k, v, mask, causal)
         if mask is None:
