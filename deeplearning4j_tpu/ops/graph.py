@@ -1,0 +1,275 @@
+"""Symbolic graph builder — the SameDiff/op-graph role, TPU-native.
+
+Reference parity: the reference's compute stack sits on ND4J's op
+factory/executioner (string-named op dispatch over INDArrays,
+SURVEY.md §2.8), whose successor in later DL4J is the SameDiff graph
+builder (define-placeholders → compose ops → autodiff → execute).  In
+JAX the *graph* is the jaxpr: tracing a python function IS graph
+construction, and XLA compiles it to HLO.  This module offers the
+reference-style imperative building API on top of that reality:
+
+    g = GraphBuilder()
+    x = g.placeholder("x", (8, 4))
+    w = g.variable("w", np.random.randn(4, 2))
+    b = g.variable("b", np.zeros(2))
+    y = g.softmax(g.add(g.matmul(x, w), b))
+    loss = g.mean(g.square(g.sub(y, g.placeholder("t", (8, 2)))))
+
+    g.jaxpr(loss)          # the traced graph (inspection/debugging)
+    g.hlo(loss)            # lowered StableHLO text — "graph -> HLO"
+    f = g.compile(loss)    # jitted executable: f(x=..., t=...)
+    grads = g.grad(loss)   # d loss / d each variable, jitted
+
+Every op node is a closure over its inputs; nothing executes until
+``compile``/``grad`` traces the whole graph once — identical staging
+semantics to jit, so the builder adds no runtime overhead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    """One graph vertex: op name + parents; ``fn(env)`` computes its
+    value given the placeholder/variable environment."""
+    graph: "GraphBuilder"
+    name: str
+    op: str
+    parents: Tuple["Node", ...]
+    fn: Callable[[Dict[str, Array]], Array]
+
+    def __repr__(self) -> str:
+        ps = ", ".join(p.name for p in self.parents)
+        return f"{self.name} = {self.op}({ps})"
+
+
+class GraphBuilder:
+    """Imperative graph construction over jax tracing (SameDiff role)."""
+
+    #: elementwise/binary ops exposed as builder methods, named like the
+    #: reference's string-dispatched transforms (ops/registry parity)
+    _UNARY = {
+        "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "exp": jnp.exp, "log": jnp.log, "neg": jnp.negative,
+        "abs": jnp.abs, "sqrt": jnp.sqrt, "square": jnp.square,
+        "softmax": jax.nn.softmax,
+    }
+    _BINARY = {
+        "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+        "div": jnp.divide, "pow": jnp.power, "maximum": jnp.maximum,
+        "minimum": jnp.minimum,
+    }
+    _REDUCE = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max,
+               "min": jnp.min}
+
+    def __init__(self):
+        self.placeholders: Dict[str, jax.ShapeDtypeStruct] = {}
+        self.variables: Dict[str, Array] = {}
+        self.nodes: List[Node] = []
+        self._counter = 0
+
+    # -- leaves -------------------------------------------------------------
+    def placeholder(self, name: str, shape: Sequence[int],
+                    dtype=jnp.float32) -> Node:
+        """Runtime input (SameDiff placeholder)."""
+        if name in self.placeholders or name in self.variables:
+            raise ValueError(f"name {name!r} already defined")
+        self.placeholders[name] = jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return self._add(name, "placeholder", (),
+                         lambda env, _n=name: env[_n])
+
+    def variable(self, name: str, value) -> Node:
+        """Trainable leaf (SameDiff variable); ``grad`` differentiates
+        with respect to these."""
+        if name in self.placeholders or name in self.variables:
+            raise ValueError(f"name {name!r} already defined")
+        self.variables[name] = jnp.asarray(value)
+        return self._add(name, "variable", (),
+                         lambda env, _n=name: env[_n])
+
+    def constant(self, value) -> Node:
+        arr = jnp.asarray(value)
+        return self._add(self._fresh("const"), "constant", (),
+                         lambda env, _a=arr: _a)
+
+    # -- ops ----------------------------------------------------------------
+    def _add(self, name: str, op: str, parents: Tuple[Node, ...],
+             raw_fn: Callable[[Dict[str, Array]], Array]) -> Node:
+        node_id = len(self.nodes)
+
+        def fn(env: Dict[str, Array], _raw=raw_fn, _id=node_id) -> Array:
+            # memoize per evaluation: a node shared by several consumers
+            # must trace once, not once per consumer (a deep shared DAG
+            # would otherwise blow up exponentially)
+            cache = env.setdefault("__node_cache__", {})
+            if _id not in cache:
+                cache[_id] = _raw(env)
+            return cache[_id]
+
+        node = Node(self, name, op, parents, fn)
+        self.nodes.append(node)
+        return node
+
+    def _fresh(self, op: str) -> str:
+        self._counter += 1
+        return f"{op}_{self._counter}"
+
+    def apply(self, op: str, *args: Node, **kw) -> Node:
+        """String-named dispatch — the op-factory surface
+        (Nd4j.getOpFactory() parity): ``g.apply("tanh", x)``."""
+        if op in self._REDUCE:
+            unknown = set(kw) - {"axis", "keepdims"}
+            if unknown:
+                raise TypeError(f"{op} got unexpected kwargs "
+                                f"{sorted(unknown)}")
+            (a,) = args
+            f = self._REDUCE[op]
+            axis = kw.get("axis")
+            keepdims = kw.get("keepdims", False)
+            return self._add(self._fresh(op), op, (a,),
+                             lambda env, _a=a: f(_a.fn(env), axis=axis,
+                                                 keepdims=keepdims))
+        if kw:
+            raise TypeError(f"{op} takes no kwargs, got {sorted(kw)}")
+        if op in self._UNARY:
+            (a,) = args
+            f = self._UNARY[op]
+            return self._add(self._fresh(op), op, (a,),
+                             lambda env, _a=a: f(_a.fn(env)))
+        if op in self._BINARY:
+            a, b = args
+            f = self._BINARY[op]
+            return self._add(self._fresh(op), op, (a, b),
+                             lambda env, _a=a, _b=b: f(_a.fn(env),
+                                                       _b.fn(env)))
+        # 6) fall through to the framework op registry so user-registered
+        # activations (ops/registry.register_activation) work here too
+        try:
+            from deeplearning4j_tpu.ops.registry import get_activation
+            f = get_activation(op)
+        except Exception:
+            raise ValueError(f"unknown op {op!r}") from None
+        (a,) = args
+        return self._add(self._fresh(op), op, (a,),
+                         lambda env, _a=a: f(_a.fn(env)))
+
+    def __getattr__(self, op: str):
+        # builder method sugar: g.tanh(x), g.add(a, b), g.sum(x, axis=0)
+        if op in (*self._UNARY, *self._BINARY, *self._REDUCE):
+            return lambda *args, **kw: self.apply(op, *args, **kw)
+        raise AttributeError(op)
+
+    def matmul(self, a: Node, b: Node) -> Node:
+        return self._add(self._fresh("matmul"), "matmul", (a, b),
+                         lambda env, _a=a, _b=b: jnp.matmul(_a.fn(env),
+                                                            _b.fn(env)))
+
+    def reshape(self, a: Node, shape: Sequence[int]) -> Node:
+        shape = tuple(shape)
+        return self._add(self._fresh("reshape"), "reshape", (a,),
+                         lambda env, _a=a: jnp.reshape(_a.fn(env), shape))
+
+    def transpose(self, a: Node, axes: Optional[Sequence[int]] = None
+                  ) -> Node:
+        return self._add(self._fresh("transpose"), "transpose", (a,),
+                         lambda env, _a=a: jnp.transpose(_a.fn(env), axes))
+
+    # -- tracing / lowering / execution -------------------------------------
+    def _reachable_placeholders(self, out: Node) -> set:
+        """Placeholder names `out` actually depends on (SameDiff only
+        requires inputs the requested output consumes)."""
+        seen, stack, names = set(), [out], set()
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            if n.op == "placeholder":
+                names.add(n.name)
+            stack.extend(n.parents)
+        return names
+
+    def _as_function(self, out: Node) -> Callable:
+        """(variables_dict, **placeholders) -> value; the traceable whole-
+        graph function."""
+        required = self._reachable_placeholders(out)
+
+        def f(variables: Dict[str, Array], **placeholders: Array) -> Array:
+            env = {**variables, **placeholders}
+            missing = required - set(placeholders)
+            if missing:
+                raise ValueError(f"missing placeholders: {sorted(missing)}")
+            return out.fn(env)
+        return f
+
+    def _example_args(self, out: Node) -> Dict[str, Array]:
+        req = self._reachable_placeholders(out)
+        return {n: jnp.zeros(s.shape, s.dtype)
+                for n, s in self.placeholders.items() if n in req}
+
+    def jaxpr(self, out: Node) -> str:
+        """The traced graph as a jaxpr (the TPU-native 'graph IR')."""
+        f = self._as_function(out)
+        return str(jax.make_jaxpr(f)(self.variables,
+                                     **self._example_args(out)))
+
+    def hlo(self, out: Node) -> str:
+        """Lowered StableHLO text — the 'autodiff graph → HLO' north-star
+        capability, natively via jit lowering."""
+        f = self._as_function(out)
+        return jax.jit(f).lower(self.variables,
+                                **self._example_args(out)).as_text()
+
+    def compile(self, out: Node) -> Callable:
+        """Jitted executable over the CURRENT variable values:
+        ``f(**placeholders) -> value``."""
+        base = jax.jit(self._as_function(out))
+
+        def run(**placeholders: Array) -> Array:
+            return base(self.variables, **placeholders)
+        return run
+
+    def grad(self, out: Node, wrt: Optional[Sequence[str]] = None
+             ) -> Callable:
+        """Jitted gradient of a SCALAR output w.r.t. the named variables
+        (default: all): ``g(**placeholders) -> {name: grad}``."""
+        names = list(wrt) if wrt is not None else list(self.variables)
+        unknown = set(names) - set(self.variables)
+        if unknown:
+            raise ValueError(f"not variables: {sorted(unknown)}")
+        f = self._as_function(out)
+
+        def scalar(subset: Dict[str, Array], others: Dict[str, Array],
+                   **ph: Array) -> Array:
+            return f({**others, **subset}, **ph)
+
+        # others ride as a jit ARGUMENT: baking them in as constants
+        # would freeze non-wrt variables at first-trace values and
+        # silently ignore later set_variable() updates
+        gradfn = jax.jit(jax.grad(scalar))
+
+        def run(**placeholders: Array) -> Dict[str, Array]:
+            subset = {n: self.variables[n] for n in names}
+            others = {n: v for n, v in self.variables.items()
+                      if n not in subset}
+            return gradfn(subset, others, **placeholders)
+        return run
+
+    def set_variable(self, name: str, value) -> None:
+        if name not in self.variables:
+            raise KeyError(name)
+        self.variables[name] = jnp.asarray(value)
+
+    def __repr__(self) -> str:
+        lines = [f"GraphBuilder({len(self.nodes)} nodes)"]
+        lines += [f"  {n!r}" for n in self.nodes]
+        return "\n".join(lines)

@@ -299,7 +299,14 @@ def worker_main(connection_string: str, performer_spec: PerformerSpec,
     job requeued by the master's reaper."""
     _fix_child_platform()
     worker_id = worker_id or f"worker-{os.getpid()}"
-    tracker = RemoteStateTracker(connection_string, authkey=authkey)
+    try:
+        tracker = RemoteStateTracker(connection_string, authkey=authkey)
+    except (ConnectionError, OSError) as exc:
+        # a late joiner may find the run already finished and the server
+        # gone — exit cleanly, don't die with a traceback
+        log.warning("worker %s could not reach %s (%s); exiting",
+                    worker_id, connection_string, exc)
+        return
     performer = resolve_performer_factory(performer_spec)()
     tracker.add_worker(worker_id)
 

@@ -166,3 +166,94 @@ def test_multiprocess_mln_param_averaging():
     net.params = averaged
     acc = net.evaluate(data).accuracy()
     assert acc > 0.7, acc
+
+
+def test_worker_joins_mid_run_and_shares_work(tmp_path):
+    """Elasticity (SURVEY §5.3: 'workers may come and go between
+    batches'): a worker that joins by connection string AFTER the run
+    started is assigned jobs and completes the gated second half.
+    Deterministic: a "gate" job blocks the original worker until the
+    late joiner registers (so the run cannot finish early), the second
+    half only appears once it has, and the original worker is disabled
+    at gate-open — the late joiner must do the work."""
+    import multiprocessing
+    import threading
+
+    marker = str(tmp_path / "joined.marker")
+    first, second = [1.0, 2.0, "gate"], [4.0, 5.0, 6.0, 7.0]
+
+    class GatedIterator(so.JobIterator):
+        """First batch free; second batch gated on the late joiner."""
+
+        def __init__(self):
+            self._i = 0
+
+        def _avail(self):
+            items = list(first)
+            if "late-joiner" in runner.tracker.workers():
+                # from here only the late joiner may work: exercises the
+                # workerEnabled switch (StateTracker.java:182 parity) and
+                # makes "the late joiner completed the second half" exact
+                runner.tracker.enable_worker("proc-worker-0", False)
+                items += second
+            return items
+
+        def has_next(self):
+            return self._i < len(self._avail())
+
+        def next(self, worker_id):
+            job = so.Job(work=self._avail()[self._i], worker_id=worker_id)
+            self._i += 1
+            return job
+
+        def reset(self):
+            self._i = 0
+
+    class ByWorkerAggregator:
+        def __init__(self):
+            self.by_worker = {}
+
+        def accumulate(self, job):
+            self.by_worker.setdefault(job.worker_id, set()).add(job.result)
+
+        def aggregate(self):
+            return self.by_worker
+
+        def reset(self):
+            pass
+
+    agg = ByWorkerAggregator()
+    runner = tp.MultiProcessRunner(
+        GatedIterator(),
+        ("transport_workloads:GateWaitPerformer", (marker,), {}),
+        agg, n_workers=1, router_cls=so.HogWildWorkRouter)
+
+    def join_late():
+        import time
+        # wait until the FIRST worker registered (run is live)
+        while not runner.tracker.workers():
+            time.sleep(0.01)
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=tp.worker_main,
+                        args=(runner.connection_string,
+                              ("transport_workloads:GateWaitPerformer",
+                               (marker,), {})),
+                        kwargs={"worker_id": "late-joiner",
+                                "authkey": runner.server.authkey},
+                        daemon=True)
+        p.start()
+        while "late-joiner" not in runner.tracker.workers():
+            time.sleep(0.01)
+        open(marker, "w").write("joined")   # release the gate job
+        return p
+
+    t = threading.Thread(target=join_late, daemon=True)
+    t.start()
+    result = runner.run(timeout_s=120)
+    all_results = set().union(*result.values())
+    assert all_results == {1.0, 4.0, "gate-done"} | {
+        x * x for x in second}
+    assert runner.tracker.count("jobs_done") == len(first) + len(second)
+    # the gated second half ran on the late joiner exclusively (the
+    # original worker was disabled at gate-open)
+    assert result.get("late-joiner", set()) >= {x * x for x in second}

@@ -53,3 +53,21 @@ class CollectSetAggregator:
 
     def reset(self) -> None:
         pass
+
+
+class GateWaitPerformer(WorkerPerformer):
+    """Squares numbers; the special "gate" job BLOCKS until a marker file
+    appears — used to hold a run open deterministically while another
+    worker joins."""
+
+    def __init__(self, marker_path: str):
+        self.marker_path = marker_path
+
+    def perform(self, job: Job) -> None:
+        import time
+        if job.work == "gate":
+            while not os.path.exists(self.marker_path):
+                time.sleep(0.01)
+            job.result = "gate-done"
+            return
+        job.result = float(job.work) ** 2
