@@ -130,6 +130,51 @@ def _warn_dropped(runner: "so.DistributedRunner") -> None:
                     "the returned vectors exclude that data", dropped)
 
 
+class WordCountPerformer(so.WorkerPerformer):
+    """Distributed word counting (scaleout/perform/text/
+    WordCountWorkPerformer.java parity): each job is a sentence (or
+    sentence list); the result is its token-count dict."""
+
+    def __init__(self, tokenizer=None):
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+
+    def perform(self, job: Job) -> None:
+        from collections import Counter
+        from itertools import chain
+
+        sentences = [job.work] if isinstance(job.work, str) else job.work
+        job.result = dict(Counter(
+            chain.from_iterable(self.tokenizer(s) for s in sentences)))
+
+
+class WordCountAggregator(so.JobAggregator):
+    """Merge per-shard count dicts (the WordCountTest reduction)."""
+
+    def __init__(self):
+        from collections import Counter
+        self.total = Counter()
+
+    def accumulate(self, job: Job) -> None:
+        self.total.update(job.result or {})
+
+    def aggregate(self):
+        return dict(self.total)
+
+    def reset(self) -> None:
+        pass                      # counts accumulate across rounds
+
+
+def word_count_distributed(sentences: Sequence[str], n_workers: int = 2,
+                           tokenizer=None, timeout_s: float = 60.0) -> dict:
+    """WordCountTest parity: corpus → merged token counts via the runner."""
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(list(sentences)),
+        lambda: WordCountPerformer(tokenizer),
+        WordCountAggregator(), n_workers=n_workers,
+        router_cls=so.HogWildWorkRouter)
+    return runner.run(timeout_s=timeout_s)
+
+
 class GlovePerformer(so.WorkerPerformer):
     """Distributed GloVe workload (scaleout/perform/models/glove/
     GlovePerformer.java parity): each job is a sentence shard; the

@@ -104,6 +104,12 @@ class GraphBuilder:
     # -- ops ----------------------------------------------------------------
     def _add(self, name: str, op: str, parents: Tuple[Node, ...],
              raw_fn: Callable[[Dict[str, Array]], Array]) -> Node:
+        for p in parents:
+            if p.graph is not self:
+                # the evaluation cache keys on per-builder node ids, so a
+                # foreign node would silently alias another node's value
+                raise ValueError(
+                    f"node {p.name!r} belongs to a different GraphBuilder")
         node_id = len(self.nodes)
 
         def fn(env: Dict[str, Array], _raw=raw_fn, _id=node_id) -> Array:
@@ -151,12 +157,14 @@ class GraphBuilder:
             return self._add(self._fresh(op), op, (a, b),
                              lambda env, _a=a, _b=b: f(_a.fn(env),
                                                        _b.fn(env)))
-        # 6) fall through to the framework op registry so user-registered
-        # activations (ops/registry.register_activation) work here too
+        # fall through to the framework op registry so user-registered
+        # activations (ops/registry.register_activation) work here too.
+        # Only a LOOKUP miss means "unknown op"; any other failure (e.g.
+        # a broken registry import) must surface as itself
+        from deeplearning4j_tpu.ops.registry import get_activation
         try:
-            from deeplearning4j_tpu.ops.registry import get_activation
             f = get_activation(op)
-        except Exception:
+        except ValueError:
             raise ValueError(f"unknown op {op!r}") from None
         (a,) = args
         return self._add(self._fresh(op), op, (a,),

@@ -299,25 +299,27 @@ def worker_main(connection_string: str, performer_spec: PerformerSpec,
     job requeued by the master's reaper."""
     _fix_child_platform()
     worker_id = worker_id or f"worker-{os.getpid()}"
+    performer = resolve_performer_factory(performer_spec)()
     try:
+        # BOTH connections and the registration RPC are join-time: any of
+        # them can lose the race against a finishing master — a late
+        # joiner must exit cleanly, not die with a traceback
         tracker = RemoteStateTracker(connection_string, authkey=authkey)
-    except (ConnectionError, OSError) as exc:
-        # a late joiner may find the run already finished and the server
-        # gone — exit cleanly, don't die with a traceback
-        log.warning("worker %s could not reach %s (%s); exiting",
+        tracker.add_worker(worker_id)
+        # The heartbeat gets its OWN connection: the main loop's socket
+        # is held for a full RPC round-trip, so a large add_update (MLN
+        # params) would otherwise block heartbeats past the stale
+        # threshold and get a healthy worker reaped mid-report.
+        beat_tracker = RemoteStateTracker(connection_string,
+                                          authkey=authkey)
+    except (EOFError, ConnectionError, OSError) as exc:
+        log.warning("worker %s could not join %s (%s); exiting",
                     worker_id, connection_string, exc)
         return
-    performer = resolve_performer_factory(performer_spec)()
-    tracker.add_worker(worker_id)
 
     if heartbeat_interval_s is None:
         heartbeat_interval_s = 0.25
     stop_beat = threading.Event()
-    # The heartbeat gets its OWN connection: the main loop's socket is
-    # held for a full RPC round-trip, so a large add_update (MLN params)
-    # would otherwise block heartbeats past the stale threshold and get a
-    # healthy worker reaped mid-report.
-    beat_tracker = RemoteStateTracker(connection_string, authkey=authkey)
 
     def beat() -> None:
         while not stop_beat.is_set():

@@ -83,3 +83,13 @@ def test_graph_string_dispatch_and_errors():
         g.compile(s)()                         # missing placeholder
     with pytest.raises(KeyError):
         g.set_variable("unknown", 1.0)
+
+
+def test_graph_rejects_foreign_nodes():
+    """Nodes from another builder must be rejected — the evaluation cache
+    keys on per-builder ids, so a foreign node would silently alias."""
+    g1, g2 = GraphBuilder(), GraphBuilder()
+    x = g1.placeholder("x", (2,))
+    c = g2.constant(np.ones(2, np.float32) * 5)
+    with pytest.raises(ValueError, match="different GraphBuilder"):
+        g1.add(x, c)

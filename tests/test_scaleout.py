@@ -331,3 +331,12 @@ def test_word2vec_warm_start_preserves_source_tables():
     b = Word2Vec(corpus, cfg, cache=a.cache)
     b.fit(initial_weights=(a.syn0, a.syn1, a.syn1neg))
     assert np.isfinite(np.asarray(a.syn0)).all()   # source not donated away
+
+
+def test_distributed_word_count():
+    """WordCountTest parity: sentence jobs -> merged token counts."""
+    from deeplearning4j_tpu.nlp.distributed import word_count_distributed
+
+    counts = word_count_distributed(
+        ["the cat sat", "the dog sat", "the end"], n_workers=2)
+    assert counts["the"] == 3 and counts["sat"] == 2 and counts["end"] == 1
