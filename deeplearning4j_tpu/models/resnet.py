@@ -146,19 +146,23 @@ def _conv(x: Array, w: Array, stride: int = 1, cdt=jnp.bfloat16) -> Array:
 
 
 def _bn(x: Array, p: Dict[str, Array], st: Dict[str, Array], train: bool,
-        momentum: float, eps: float):
-    """Returns (normalized x fp32, updated stats)."""
-    x = x.astype(jnp.float32)
+        momentum: float, eps: float, out_dtype=jnp.bfloat16):
+    """Returns (normalized x in ``out_dtype``, updated stats).
+
+    Statistics/normalization math in fp32; the OUTPUT drops back to the
+    compute dtype — fp32 activations flowing between bf16 convs would
+    double every layer boundary's HBM traffic."""
+    x32 = x.astype(jnp.float32)
     if train:
-        mean = jnp.mean(x, axis=(0, 1, 2))
-        var = jnp.var(x, axis=(0, 1, 2))
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
         new_st = {"mean": momentum * st["mean"] + (1 - momentum) * mean,
                   "var": momentum * st["var"] + (1 - momentum) * var}
     else:
         mean, var = st["mean"], st["var"]
         new_st = st
     inv = lax.rsqrt(var + eps) * p["g"]
-    return (x - mean) * inv + p["b"], new_st
+    return ((x32 - mean) * inv + p["b"]).astype(out_dtype), new_st
 
 
 def forward(cfg: ResNetConfig, params: PyTree, stats: PyTree, x: Array,
@@ -170,7 +174,7 @@ def forward(cfg: ResNetConfig, params: PyTree, stats: PyTree, x: Array,
 
     h = _conv(x, params["stem"]["w"], cfg.stem_stride, cdt)
     h, new_stats["stem"] = _bn(h, params["stem"]["bn"], stats["stem"],
-                               train, mom, eps)
+                               train, mom, eps, cdt)
     h = jax.nn.relu(h)
     if cfg.stem_pool:
         h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1),
@@ -184,19 +188,22 @@ def forward(cfg: ResNetConfig, params: PyTree, stats: PyTree, x: Array,
             stride = 2 if (b == 0 and s > 0) else 1
 
             r = _conv(h, blk["c1"]["w"], 1, cdt)
-            r, nst["c1"] = _bn(r, blk["c1"]["bn"], bst["c1"], train, mom, eps)
+            r, nst["c1"] = _bn(r, blk["c1"]["bn"], bst["c1"], train, mom,
+                               eps, cdt)
             r = jax.nn.relu(r)
             # v1.5: the stride lives on the 3x3
             r = _conv(r, blk["c2"]["w"], stride, cdt)
-            r, nst["c2"] = _bn(r, blk["c2"]["bn"], bst["c2"], train, mom, eps)
+            r, nst["c2"] = _bn(r, blk["c2"]["bn"], bst["c2"], train, mom,
+                               eps, cdt)
             r = jax.nn.relu(r)
             r = _conv(r, blk["c3"]["w"], 1, cdt)
-            r, nst["c3"] = _bn(r, blk["c3"]["bn"], bst["c3"], train, mom, eps)
+            r, nst["c3"] = _bn(r, blk["c3"]["bn"], bst["c3"], train, mom,
+                               eps, cdt)
 
             if "proj" in blk:
                 h = _conv(h, blk["proj"]["w"], stride, cdt)
                 h, nst["proj"] = _bn(h, blk["proj"]["bn"], bst["proj"],
-                                     train, mom, eps)
+                                     train, mom, eps, cdt)
             h = jax.nn.relu(h + r)
             new_stats[name] = nst
 

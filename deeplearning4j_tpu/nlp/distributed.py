@@ -172,7 +172,9 @@ def word_count_distributed(sentences: Sequence[str], n_workers: int = 2,
         lambda: WordCountPerformer(tokenizer),
         WordCountAggregator(), n_workers=n_workers,
         router_cls=so.HogWildWorkRouter)
-    return runner.run(timeout_s=timeout_s)
+    counts = runner.run(timeout_s=timeout_s)
+    _warn_dropped(runner)
+    return counts if counts is not None else {}
 
 
 class GlovePerformer(so.WorkerPerformer):

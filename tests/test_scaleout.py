@@ -340,3 +340,9 @@ def test_distributed_word_count():
     counts = word_count_distributed(
         ["the cat sat", "the dog sat", "the end"], n_workers=2)
     assert counts["the"] == 3 and counts["sat"] == 2 and counts["end"] == 1
+
+
+def test_distributed_word_count_empty_corpus():
+    from deeplearning4j_tpu.nlp.distributed import word_count_distributed
+
+    assert word_count_distributed([]) == {}
