@@ -1,0 +1,238 @@
+"""Fused word2vec chunk update as a Pallas TPU kernel (small-vocab path).
+
+Reference parity: the inner training kernel
+``InMemoryLookupTable.iterateSample:195-303`` (HS tree walk + negative
+sampling, BLAS-1 axpy per word).  The XLA redesign in ``nlp/word2vec.py``
+batches those axpys into gathers + einsums + scatter-adds; on TPU those
+gathers/scatters of ~400-byte rows run far from HBM peak (measured ~6 ms
+per 16k-pair chunk for HS alone) because XLA lowers row scatter-adds to a
+serial per-row loop and row gathers to narrow copies.
+
+This kernel removes gathers and scatters ENTIRELY for vocabularies whose
+tables fit in VMEM (V*(D+1) fp32 up to a few MB — covers the classic
+word2vec regime of 1e2..1e4 vocab, the reference's own test scale):
+
+- syn0 / syn1 / syn1neg stay resident in VMEM for the whole chunk;
+- every row "gather" is a one-hot matmul  OHTᵀ·syn  on the MXU, and every
+  row "scatter-add" is the transposed one-hot matmul  OHT·payload — the
+  [V, BLK] one-hot is built by an iota-compare in VMEM and never touches
+  HBM;
+- hierarchical-softmax levels and the (K+1) negative-sampling partners
+  reuse the same one-hot per row set, so each level costs two MXU calls;
+- per-row counts for the batched-update mean normalization (see
+  ``_hs_update``) ride in an extra payload lane — same matmul, no extra
+  scatter.
+
+The update math is IDENTICAL to ``nlp/word2vec._hs_update`` /
+``_neg_update`` (bf16 matmuls, fp32 accumulation): per chunk, both
+objectives read the chunk-start table values, per-row update sums are
+normalized by hit counts, and ``syn0 += hs_part/cnt_hs + neg_part/cnt_neg``.
+``interpret=True`` runs the kernel through the Pallas interpreter for the
+CPU test harness (tests/test_nlp.py compares it against the XLA path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:                                     # TPU-only compiler knobs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                      # pragma: no cover
+    pltpu = None
+
+Array = jax.Array
+
+#: VMEM budget for the resident tables + accumulators + one-hot scratch
+#: (~14 MB of the ~16 MB/core VMEM; measured fitting at V=2000, D=100,
+#: BLK=2048 on v5e)
+VMEM_BUDGET_BYTES = 14 * 2 ** 20
+
+
+def choose_block(vocab: int, dim: int, negative: int, batch: int,
+                 interpret: bool = False) -> int:
+    """Largest grid block for which the VMEM model fits, or 0 when the
+    vocabulary is too large for the resident kernel (callers then use the
+    XLA gather/scatter path).  On hardware, blocks below 1024 are
+    excluded — Mosaic rejects the narrow one-hot layouts they produce;
+    the interpreter (CPU test harness) has no such limit."""
+    n_tables = 3 if negative > 0 else 2
+    # fp32 tables + their bf16 casts + fp32 accumulators (acc0 is 2(D+1))
+    fixed = vocab * (n_tables * dim * 6 + 4 * (dim + 1) * 4)
+    for blk in (2048, 1024):
+        if batch % blk:
+            continue
+        if fixed + 2 * vocab * blk <= VMEM_BUDGET_BYTES:
+            return blk
+    if interpret and batch <= 1024:
+        return batch
+    return 0
+
+
+def _kernel(alpha_ref, inputs_ref, targets_ref, pmask_ref,
+            codes_ref, points_ref, mask_ref, negs_ref,
+            syn0_ref, syn1_ref, syn1neg_ref,
+            acc0_ref, acc1_ref, accn_ref,
+            *, L: int, K: int, use_hs: bool):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc0_ref[...] = jnp.zeros_like(acc0_ref)
+        acc1_ref[...] = jnp.zeros_like(acc1_ref)
+        accn_ref[...] = jnp.zeros_like(accn_ref)
+
+    bf = jnp.bfloat16
+    alpha = alpha_ref[0, 0]
+    BLK = inputs_ref.shape[0]
+    V0 = syn0_ref.shape[0]
+    D = syn0_ref.shape[1]
+
+    def one_hot_t(rows, v):
+        """[v, BLK] transposed one-hot of ``rows`` [BLK] — iota compare in
+        VMEM; used both as gather (contract dim 0) and scatter (dim 1)."""
+        iota = lax.broadcasted_iota(jnp.int32, (v, BLK), 0)
+        return (iota == rows[None, :]).astype(bf)
+
+    def gather(oht, table_ref):
+        return lax.dot_general(
+            oht, table_ref[...].astype(bf), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [BLK, D]
+
+    def scatter_acc(acc_ref, oht, upd, cnt):
+        payload = jnp.concatenate(
+            [upd, cnt[:, None]], axis=1).astype(bf)      # [BLK, D+1]
+        acc_ref[...] += lax.dot_general(
+            oht, payload, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [V, D+1]
+
+    inp = inputs_ref[:]
+    oh0 = one_hot_t(inp, V0)
+    l1 = gather(oh0, syn0_ref)                           # [BLK, D] fp32
+    l1bf = l1.astype(bf)
+
+    neu1e_hs = jnp.zeros((BLK, D), jnp.float32)
+    neu1e_ng = jnp.zeros((BLK, D), jnp.float32)
+
+    if use_hs:
+        def hs_level(l, neu1e):
+            pts = points_ref[pl.dslice(l, 1), :][0]
+            code = codes_ref[pl.dslice(l, 1), :][0]
+            m = mask_ref[pl.dslice(l, 1), :][0]
+            oht = one_hot_t(pts, syn1_ref.shape[0])
+            s1 = gather(oht, syn1_ref)                   # [BLK, D]
+            f = jax.nn.sigmoid(jnp.sum(l1 * s1, axis=1))
+            g = (1.0 - code - f) * alpha * m             # [BLK]
+            scatter_acc(acc1_ref, oht, g[:, None] * l1, m)
+            return neu1e + g[:, None] * s1
+
+        neu1e_hs = lax.fori_loop(0, L, hs_level, neu1e_hs)
+
+    if K > 0:
+        tgt = targets_ref[:]
+        pmask = pmask_ref[:]
+
+        def neg_partner(k, neu1e):
+            rows = lax.cond(
+                k == 0, lambda: tgt,
+                lambda: negs_ref[
+                    pl.dslice(jnp.maximum(k - 1, 0), 1), :][0])
+            label = jnp.where(k == 0, 1.0, 0.0)
+            valid = jnp.where((k == 0) | (rows != tgt), 1.0, 0.0)
+            oht = one_hot_t(rows, syn1neg_ref.shape[0])
+            sn = gather(oht, syn1neg_ref)
+            f = jax.nn.sigmoid(jnp.sum(l1 * sn, axis=1))
+            g = (label - f) * alpha * valid * pmask
+            scatter_acc(accn_ref, oht, g[:, None] * l1, valid * pmask)
+            return neu1e + g[:, None] * sn
+
+        neu1e_ng = lax.fori_loop(0, K + 1, neg_partner, neu1e_ng)
+
+    # syn0 accumulator: both objectives' contributions + their own count
+    # channels in ONE [V0, 2(D+1)] matmul (outside: each part is divided
+    # by its own count before the add, matching the XLA path exactly)
+    row_hs = (jnp.sum(mask_ref[...], axis=0) > 0).astype(jnp.float32) \
+        if use_hs else jnp.zeros((BLK,), jnp.float32)
+    row_ng = pmask_ref[:] if K > 0 else jnp.zeros((BLK,), jnp.float32)
+    payload0 = jnp.concatenate(
+        [neu1e_hs, row_hs[:, None], neu1e_ng, row_ng[:, None]],
+        axis=1).astype(bf)                               # [BLK, 2(D+1)]
+    acc0_ref[...] += lax.dot_general(
+        oh0, payload0, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("use_hs", "negative", "block", "interpret"))
+def fused_chunk_update(syn0: Array, syn1: Array, syn1neg: Array,
+                       inputs: Array, targets: Array, codes: Array,
+                       points: Array, mask: Array, negs: Array,
+                       pmask: Array, alpha: Array,
+                       *, use_hs: bool, negative: int,
+                       block: int = 512, interpret: bool = False):
+    """One training chunk through the VMEM-resident kernel.
+
+    inputs/targets [B]; codes/points/mask [B, L]; negs [B, K] (already
+    mapped through the unigram table); pmask [B] combined pad+window mask.
+    Returns updated (syn0, syn1, syn1neg).
+    """
+    B = inputs.shape[0]
+    L = codes.shape[1]
+    K = negative
+    BLK = min(block, B)
+    NB = B // BLK
+    assert NB * BLK == B, f"B={B} must be a multiple of block={BLK}"
+    V0, D = syn0.shape
+
+    codes = codes.astype(jnp.float32)
+    mask = mask.astype(jnp.float32) * pmask[:, None]
+    grid = (NB,)
+    out_shapes = [
+        jax.ShapeDtypeStruct((V0, 2 * (D + 1)), jnp.float32),
+        jax.ShapeDtypeStruct((syn1.shape[0], D + 1), jnp.float32),
+        jax.ShapeDtypeStruct((syn1neg.shape[0], D + 1), jnp.float32),
+    ]
+    full = lambda r, c: pl.BlockSpec((r, c), lambda i: (0, 0))
+    acc0, acc1, accn = pl.pallas_call(
+        functools.partial(_kernel, L=L, K=K, use_hs=use_hs),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # alpha
+            pl.BlockSpec((BLK,), lambda i: (i,)),            # inputs
+            pl.BlockSpec((BLK,), lambda i: (i,)),            # targets
+            pl.BlockSpec((BLK,), lambda i: (i,)),            # pmask
+            pl.BlockSpec((L, BLK), lambda i: (0, i)),        # codes^T
+            pl.BlockSpec((L, BLK), lambda i: (0, i)),        # points^T
+            pl.BlockSpec((L, BLK), lambda i: (0, i)),        # mask^T
+            pl.BlockSpec((max(K, 1), BLK), lambda i: (0, i)),  # negs^T
+            full(*syn0.shape),
+            full(*syn1.shape),
+            full(*syn1neg.shape),
+        ],
+        out_specs=[
+            full(V0, 2 * (D + 1)),
+            full(syn1.shape[0], D + 1),
+            full(syn1neg.shape[0], D + 1),
+        ],
+        out_shape=out_shapes,
+        interpret=interpret,
+        compiler_params=None if (interpret or pltpu is None) else
+        pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(jnp.reshape(alpha, (1, 1)).astype(jnp.float32),
+      inputs, targets, pmask,
+      codes.T, points.T, mask.T,
+      (negs.T if K > 0 else jnp.zeros((1, B), jnp.int32)),
+      syn0, syn1, syn1neg)
+
+    if use_hs:
+        syn1 = syn1 + acc1[:, :D] / jnp.maximum(acc1[:, D:], 1.0)
+    if K > 0:
+        syn1neg = syn1neg + accn[:, :D] / jnp.maximum(accn[:, D:], 1.0)
+    upd0 = acc0[:, :D] / jnp.maximum(acc0[:, D:D + 1], 1.0) \
+        + acc0[:, D + 1:2 * D + 1] / jnp.maximum(acc0[:, 2 * D + 1:], 1.0)
+    return syn0 + upd0, syn1, syn1neg
