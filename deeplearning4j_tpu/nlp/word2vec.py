@@ -212,9 +212,17 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
         if pallas_block > 0:
             from deeplearning4j_tpu.ops.pallas_word2vec import \
                 fused_chunk_update
+            if use_hs:
+                codes_b, points_b, mask_b = (codes_t[cen], points_t[cen],
+                                             mask_t[cen])
+            else:      # no Huffman tables exist; (B, 1) dummies keep the
+                B_ = cen.shape[0]          # kernel's BlockSpecs non-empty
+                codes_b = jnp.zeros((B_, 1), jnp.float32)
+                points_b = jnp.zeros((B_, 1), jnp.int32)
+                mask_b = jnp.zeros((B_, 1), jnp.float32)
             syn0, syn1, syn1neg = fused_chunk_update(
-                syn0, syn1, syn1neg, ctx, cen, codes_t[cen],
-                points_t[cen], mask_t[cen], negs, m, alpha,
+                syn0, syn1, syn1neg, ctx, cen, codes_b,
+                points_b, mask_b, negs, m, alpha,
                 use_hs=use_hs, negative=negative,
                 block=pallas_block, interpret=pallas_interpret)
         else:
@@ -295,8 +303,8 @@ def corpus_pairs(indexed: Sequence[np.ndarray], window: int,
     outs: List[Tuple[np.ndarray, ...]] = []
     for s0 in range(0, n, slab):
         s1 = min(n, s0 + slab)
-        pos = np.arange(s0, s1)
-        j = pos[:, None] + deltas[None, :]                   # [S, 2W]
+        pos = np.arange(s0, s1, dtype=np.int32)
+        j = pos[:, None] + deltas[None, :]                   # [S, 2W] i32
         jc = np.clip(j, 0, n - 1)
         valid = (j >= 0) & (j < n) & (sid[jc] == sid[s0:s1, None])
         ci, di = np.nonzero(valid)

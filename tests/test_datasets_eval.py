@@ -157,3 +157,56 @@ def test_labeled_point_interop_roundtrip():
         from_labeled_points([LabeledPoint(1.5, [1.0])])   # non-integer class
     with pytest.raises(ValueError):
         from_labeled_points([LabeledPoint(5, [1.0])], num_classes=3)
+
+
+# -- newsgroups corpus (ReutersNewsGroupsLoader parity) ---------------------
+
+def test_newsgroups_loader_synthetic_tfidf_classifies():
+    from deeplearning4j_tpu.datasets.newsgroups import NewsGroupsDataSetIterator
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    it = NewsGroupsDataSetIterator(batch=200, tfidf=True, n_docs=200)
+    ds = it.next()
+    assert ds.features.shape[0] == 200
+    assert it.total_outcomes() == 4
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(it.input_columns()).lr(0.5).activation("tanh")
+            .num_iterations(5)
+            .list(2).hidden_layer_sizes(16)
+            .override(1, kind=LayerKind.OUTPUT, n_out=4,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit_backprop(ds.batch_by(50), num_epochs=40)
+    acc = net.evaluate(ds).accuracy()
+    assert acc > 0.9, acc
+
+
+def test_newsgroups_loader_bow_and_batching():
+    from deeplearning4j_tpu.datasets.newsgroups import NewsGroupsDataSetIterator
+
+    it = NewsGroupsDataSetIterator(batch=64, tfidf=False, n_docs=150)
+    seen = 0
+    while it.has_next():
+        b = it.next()
+        seen += int(b.features.shape[0])
+    assert seen == 150
+    it.reset()
+    assert it.has_next()
+
+
+def test_newsgroups_label_directories(tmp_path):
+    from deeplearning4j_tpu.datasets.newsgroups import NewsGroupsLoader
+
+    for lab, words in [("alpha", "rocket orbit lunar"),
+                       ("beta", "goal team season")]:
+        d = tmp_path / lab
+        d.mkdir()
+        for i in range(3):
+            (d / f"doc{i}.txt").write_text(f"{words} doc {i}")
+    loader = NewsGroupsLoader(tfidf=True, root_dir=str(tmp_path))
+    assert not loader.synthetic
+    assert loader.label_names == ["alpha", "beta"]
+    assert loader.num_examples == 6
+    assert int(loader.data.labels.sum()) == 6

@@ -247,3 +247,12 @@ def test_word2vec_pallas_path_converges():
     wv = Word2Vec(CORPUS, cfg).fit()
     assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")
     assert wv.similarity("king", "queen") > wv.similarity("king", "mouse")
+
+
+def test_word2vec_pallas_neg_only_fit():
+    """use_hs=False + kernel='pallas': no Huffman tables exist; the kernel
+    must still compile (dummy (B,1) HS blocks) and train."""
+    cfg = Word2VecConfig(vector_size=16, window=3, epochs=2, negative=5,
+                         use_hs=False, batch_size=256, kernel="pallas")
+    wv = Word2Vec(CORPUS, cfg).fit()
+    assert np.all(np.isfinite(np.asarray(wv.vectors)))
