@@ -117,8 +117,8 @@ def bert_train_flops(cfg, batch: int, seq: int) -> float:
     return 3.0 * fwd
 
 
-def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
-               warmup: int = 3):
+def bench_bert(batch_size: int = 32, seq_len: int = 128,
+               steps: int = 20):
     import jax
     import jax.numpy as jnp
     import optax
@@ -153,21 +153,24 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
                   file=sys.stderr)
             attn = tfm.attention
 
+    # all measured steps scan inside ONE dispatch: measured time is
+    # device throughput, not the tunnel's 15-20 ms per-call latency
     init_fn, step_fn = bert.make_train_step(
-        cfg, mesh, optimizer=optax.adamw(1e-4), attn_fn=attn)
+        cfg, mesh, optimizer=optax.adamw(1e-4), attn_fn=attn,
+        n_steps=steps)
 
     state = init_fn(jax.random.key(0))
     batch = bert.synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len)
 
-    for i in range(warmup):
-        state, loss = step_fn(state, batch, jax.random.key(i))
-    float(loss)  # host fetch: actual D2H sync (block_until_ready can
-    # return early on the tunneled axon device)
+    import jax.numpy as _jnp
+    state, loss = step_fn(state, batch, jax.random.key(0))   # compile+warm
+    float(_jnp.ravel(loss)[-1])  # host fetch: actual D2H sync
+    # (block_until_ready can return early on the tunneled axon device;
+    # ravel handles the scalar loss of an unscanned n_steps=1 step)
 
     t0 = time.perf_counter()
-    for i in range(steps):
-        state, loss = step_fn(state, batch, jax.random.key(100 + i))
-    final_loss = float(loss)
+    state, loss = step_fn(state, batch, jax.random.key(100))
+    final_loss = float(_jnp.ravel(loss)[-1])
     dt = time.perf_counter() - t0
 
     sps = batch_size * steps / dt
@@ -188,7 +191,7 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, steps: int = 20,
 
 
 def bench_resnet(batch_size: int = 128, image_size: int = 224,
-                 steps: int = 20, warmup: int = 3):
+                 steps: int = 20):
     """ResNet-50 training throughput (BASELINE.json configs)."""
     import jax
     from deeplearning4j_tpu.models import resnet
@@ -202,17 +205,18 @@ def bench_resnet(batch_size: int = 128, image_size: int = 224,
         cfg = resnet.resnet50()
 
     mesh = make_mesh(MeshSpec(data=n_dev), devices=jax.devices())
-    init_fn, step_fn = resnet.make_train_step(cfg, mesh)
+    # scanned steps: one dispatch for the whole measured window (see
+    # bench_bert)
+    init_fn, step_fn = resnet.make_train_step(cfg, mesh, n_steps=steps)
     state = init_fn(jax.random.key(0))
     x, y = resnet.synthetic_batch(jax.random.key(1), cfg, batch_size,
                                   image_size)
-    for _ in range(warmup):
-        state, loss = step_fn(state, x, y)
-    float(loss)
+    import jax.numpy as _jnp
+    state, loss = step_fn(state, x, y)                       # compile+warm
+    float(_jnp.ravel(loss)[-1])
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step_fn(state, x, y)
-    final_loss = float(loss)
+    state, loss = step_fn(state, x, y)
+    final_loss = float(_jnp.ravel(loss)[-1])
     dt = time.perf_counter() - t0
     sps = batch_size * steps / dt / n_dev
     # ResNet-50 fwd ~4.1 GMACs/img @224 => train ~3x fwd FLOPs

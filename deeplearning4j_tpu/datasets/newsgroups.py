@@ -28,11 +28,6 @@ from deeplearning4j_tpu.datasets.iterator import BaseDatasetIterator
 from deeplearning4j_tpu.nlp.vectorizers import (BagOfWordsVectorizer,
                                                 TfidfVectorizer)
 
-#: synthetic surrogate defaults (labels mirror 20-newsgroups' flavor)
-_SURROGATE_LABELS = ("sci.space", "rec.sport", "comp.graphics",
-                     "talk.politics")
-
-
 def _surrogate_corpus(n_docs: int, seed: int
                       ) -> Tuple[List[str], List[str], List[str]]:
     """Deterministic labeled corpus: each label owns a topic vocabulary;
@@ -103,10 +98,14 @@ class NewsGroupsLoader:
         vec_cls = TfidfVectorizer if tfidf else BagOfWordsVectorizer
         self.vectorizer = vec_cls(tokenizer=tokenizer,
                                   min_word_frequency=min_word_frequency)
-        features = self.vectorizer.fit_transform(texts)
+        # features/labels stay host-side numpy: the fetcher uploads one
+        # batch slice at a time (a device-resident copy here would hold
+        # the whole TF-IDF matrix twice and add a D2H roundtrip)
+        features = np.asarray(self.vectorizer.fit_transform(texts))
         idx = [self.label_names.index(l) for l in labels]
-        self.data = DataSet(jnp.asarray(features),
-                            one_hot(np.asarray(idx), len(self.label_names)))
+        self.data = DataSet(features,
+                            np.asarray(one_hot(np.asarray(idx),
+                                               len(self.label_names))))
 
     @property
     def num_examples(self) -> int:

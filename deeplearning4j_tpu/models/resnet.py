@@ -234,11 +234,15 @@ class TrainState(NamedTuple):
 
 
 def make_train_step(cfg: ResNetConfig, mesh: Mesh,
-                    optimizer: Optional[optax.GradientTransformation] = None
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    n_steps: int = 1
                     ) -> Tuple[Callable, Callable]:
     """(init_fn(key) -> TrainState,
         step_fn(state, x, labels) -> (state, loss)), jitted with the batch
-    sharded over ``data`` and everything else replicated."""
+    sharded over ``data`` and everything else replicated.
+
+    ``n_steps > 1`` scans that many optimizer steps inside one dispatch
+    (see bert.make_train_step) — loss comes back as [n_steps]."""
     optimizer = optimizer or optax.sgd(0.1, momentum=0.9, nesterov=True)
     repl = NamedSharding(mesh, P())
     xsh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
@@ -249,7 +253,7 @@ def make_train_step(cfg: ResNetConfig, mesh: Mesh,
         return TrainState(params, stats, optimizer.init(params),
                           jnp.zeros((), jnp.int32))
 
-    def _step(state: TrainState, x: Array, labels: Array):
+    def _one_step(state: TrainState, x: Array, labels: Array):
         (loss, new_stats), grads = jax.value_and_grad(
             lambda p: loss_fn(cfg, p, state.batch_stats, x, labels),
             has_aux=True)(state.params)
@@ -258,6 +262,14 @@ def make_train_step(cfg: ResNetConfig, mesh: Mesh,
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, new_stats, opt_state,
                           state.step + 1), loss
+
+    if n_steps == 1:
+        _step = _one_step
+    else:
+        def _step(state: TrainState, x: Array, labels: Array):
+            def body(s, _):
+                return _one_step(s, x, labels)
+            return jax.lax.scan(body, state, None, length=n_steps)
 
     cache: Dict[str, Callable] = {}
 
