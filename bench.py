@@ -162,15 +162,14 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128,
     state = init_fn(jax.random.key(0))
     batch = bert.synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len)
 
-    import jax.numpy as _jnp
     state, loss = step_fn(state, batch, jax.random.key(0))   # compile+warm
-    float(_jnp.ravel(loss)[-1])  # host fetch: actual D2H sync
+    float(jnp.ravel(loss)[-1])  # host fetch: actual D2H sync
     # (block_until_ready can return early on the tunneled axon device;
     # ravel handles the scalar loss of an unscanned n_steps=1 step)
 
     t0 = time.perf_counter()
     state, loss = step_fn(state, batch, jax.random.key(100))
-    final_loss = float(_jnp.ravel(loss)[-1])
+    final_loss = float(jnp.ravel(loss)[-1])
     dt = time.perf_counter() - t0
 
     sps = batch_size * steps / dt

@@ -19,7 +19,6 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Sequence, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, one_hot

@@ -247,3 +247,78 @@ def train_glove_distributed(sentences: Sequence[str],
                          "had zero co-occurrences or every job was dropped "
                          "after repeated failures")
     return WordVectors(cache, jnp.asarray(state[0]) + jnp.asarray(state[1]))
+
+
+class VocabCountPerformer(so.WorkerPerformer):
+    """Distributed vocab counting (spark TextPipeline parity:
+    dl4j-spark-nlp/.../text/TextPipeline.java:37 — RDD tokenize ->
+    per-partition term/doc counts).  Each job is a sentence shard; the
+    result is (term_counts, doc_counts, n_docs)."""
+
+    def __init__(self, tokenizer=None):
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+
+    def perform(self, job: Job) -> None:
+        from collections import Counter
+
+        sentences = [job.work] if isinstance(job.work, str) else job.work
+        terms: "Counter[str]" = Counter()
+        docs: "Counter[str]" = Counter()
+        for s in sentences:
+            toks = self.tokenizer(s)
+            terms.update(toks)
+            docs.update(set(toks))
+        job.result = (dict(terms), dict(docs), len(sentences))
+
+
+class VocabCountAggregator(so.JobAggregator):
+    """Merge partition counts into one (terms, docs, n_docs) triple —
+    TextPipeline's reduceByKey stage."""
+
+    def __init__(self):
+        from collections import Counter
+        self.terms = Counter()
+        self.docs = Counter()
+        self.n_docs = 0
+
+    def accumulate(self, job: Job) -> None:
+        t, d, n = job.result or ({}, {}, 0)
+        self.terms.update(t)
+        self.docs.update(d)
+        self.n_docs += n
+
+    def aggregate(self):
+        return dict(self.terms), dict(self.docs), self.n_docs
+
+    def reset(self) -> None:
+        pass                      # counts accumulate across rounds
+
+
+def build_vocab_distributed(sentences: Sequence[str],
+                            min_word_frequency: int = 1,
+                            n_workers: int = 2,
+                            n_shards: Optional[int] = None,
+                            tokenizer=None,
+                            timeout_s: float = 60.0) -> VocabCache:
+    """TextPipeline parity: the VOCABULARY itself is built from
+    distributed counts (the reference's spark pipeline tokenizes and
+    counts on executors, then builds the VocabCache from the reduced
+    counts), equivalent to the sequential ``build_vocab`` on the same
+    corpus."""
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(
+            shard_sentences(sentences, n_shards or n_workers)),
+        lambda: VocabCountPerformer(tokenizer),
+        VocabCountAggregator(), n_workers=n_workers,
+        router_cls=so.HogWildWorkRouter)
+    out = runner.run(timeout_s=timeout_s)
+    _warn_dropped(runner)
+    terms, docs, n_docs = out if out is not None else ({}, {}, 0)
+    cache = VocabCache()
+    for w, c in terms.items():
+        cache.add_token(w, count=float(c))
+    for w, c in docs.items():
+        cache.doc_freq[w] = int(c)
+    cache.num_docs = n_docs
+    cache.trim(min_word_frequency)
+    return cache

@@ -4,12 +4,16 @@ Reference parity: ``models/paragraphvectors/ParagraphVectors.java:53``
 (``dbow:188``, ``trainSentence:165``) — label words are injected into the
 same embedding space as vocabulary words and trained alongside them.
 
-TPU-native: reuses the word2vec batched kernels (_hs_step) — the label
-"word" is just an extra row of syn0 trained against every center word of
-its document (PV-DBOW), or averaged into the context (PV-DM simplified to
-the DBOW-style update the reference actually performs in ``dbow``).
-Inference for an unseen document trains ONLY its new label row with the
-rest of the space frozen.
+TPU-native: the label "word" is just an extra row of syn0 trained against
+every center word of its document (PV-DBOW), or averaged into the context
+(PV-DM simplified to the DBOW-style update the reference actually performs
+in ``dbow``).  Label pairs ride the word2vec scanned-epoch machinery
+(``_scan_slab`` — one dispatch per epoch, Pallas VMEM kernel on TPU) by
+encoding them as candidate pairs with ``delta = 0``: the on-device dynamic
+window shrink ``|delta| <= window - b`` always passes for them, so they
+train every epoch exactly like the reference's dbow loop, while real word
+pairs keep their shrink semantics.  Inference for an unseen document
+trains ONLY its new label row with the rest of the space frozen.
 """
 
 from __future__ import annotations
@@ -24,8 +28,9 @@ import numpy as np
 from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import (VocabCache, build_huffman,
                                           encode_hs_tables)
-from deeplearning4j_tpu.nlp.word2vec import (Word2VecConfig, _hs_step,
-                                             sentence_pairs)
+from deeplearning4j_tpu.nlp.word2vec import (Word2VecConfig,
+                                             corpus_pairs, hs_mask_table,
+                                             run_pair_training)
 from deeplearning4j_tpu.nlp.word_vectors import WordVectors
 
 
@@ -73,50 +78,60 @@ class ParagraphVectors:
         self.syn0 = (jax.random.uniform(key, (V, D)) - 0.5) / D
         self.syn1 = jnp.zeros((V, D))
 
-        codes_t, points_t, lengths_t = encode_hs_tables(cache)
-        codes_t = jnp.asarray(codes_t)
-        points_t = jnp.asarray(points_t)
-        mask_full = jnp.asarray(
-            (np.arange(codes_t.shape[1])[None, :] <
-             np.asarray(lengths_t)[:, None]).astype(np.float32))
+        codes_np, points_np, lengths_t = encode_hs_tables(cache)
+        mask_full = hs_mask_table(codes_np, lengths_t)
+        codes_t = jnp.asarray(codes_np)
+        points_t = jnp.asarray(points_np)
 
-        rng = np.random.RandomState(cfg.seed)
-        B = cfg.batch_size
+        # Assemble ONE candidate pair list for the whole corpus, then run
+        # the word2vec scanned-epoch engine on it.  Label pairs (PV-DBOW:
+        # label row predicts every doc word) get delta = 0 so the
+        # on-device window-shrink mask always keeps them; word pairs come
+        # from corpus_pairs with real deltas.
+        indexed: List[np.ndarray] = []
+        label_rows: List[int] = []
+        for label, text in self.docs:
+            idx = np.asarray(
+                [i for i in (cache.index_of(t)
+                             for t in self.tokenizer(text)) if i >= 0],
+                np.int32)
+            if idx.size:
+                indexed.append(idx)
+                label_rows.append(cache.index_of(label))
+        if not indexed:
+            self._wv = WordVectors(cache, self.syn0)
+            return self._wv
 
-        def train_pairs(inputs_np, centers_np):
-            """inputs: syn0 rows to move; centers: HS target words."""
-            for lo in range(0, inputs_np.size, B):
-                ib = inputs_np[lo:lo + B]
-                cb = centers_np[lo:lo + B]
-                n_real = ib.size
-                if n_real < B:
-                    pad = B - n_real
-                    ib = np.concatenate([ib, np.zeros(pad, np.int32)])
-                    cb = np.concatenate([cb, np.zeros(pad, np.int32)])
-                pmask = jnp.asarray(np.arange(B) < n_real, jnp.float32)
-                centers = jnp.asarray(cb)
-                self.syn0, self.syn1 = _hs_step(
-                    self.syn0, self.syn1, jnp.asarray(ib),
-                    codes_t[centers], points_t[centers],
-                    mask_full[centers] * pmask[:, None],
-                    jnp.float32(cfg.alpha))
+        lens = np.asarray([a.size for a in indexed])
+        starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        seen_before = starts.astype(np.float32)
+        # label pairs: (center=word, input=label row, pos=token position)
+        lb_cen = np.concatenate(indexed)
+        lb_ctx = np.repeat(np.asarray(label_rows, np.int32), lens)
+        lb_pos = np.arange(lb_cen.size, dtype=np.int32)
+        lb_dlt = np.zeros(lb_cen.size, np.int32)
+        lb_off = np.repeat(seen_before, lens)
+        if cfg.train_words:
+            w_cen, w_ctx, w_pos, w_dlt, w_off = corpus_pairs(
+                indexed, cfg.window)
+            cen = np.concatenate([lb_cen, w_cen])
+            ctx = np.concatenate([lb_ctx, w_ctx])
+            pos = np.concatenate([lb_pos, w_pos])
+            dlt = np.concatenate([lb_dlt, w_dlt])
+            off = np.concatenate([lb_off, w_off])
+        else:
+            cen, ctx, pos, dlt, off = (lb_cen, lb_ctx, lb_pos, lb_dlt,
+                                       lb_off)
 
-        for _ in range(cfg.epochs):
-            for label, text in self.docs:
-                li = cache.index_of(label)
-                idx = np.asarray(
-                    [i for i in (cache.index_of(t)
-                                 for t in self.tokenizer(text)) if i >= 0],
-                    np.int32)
-                if idx.size == 0:
-                    continue
-                # PV-DBOW: the label row is trained to predict every word
-                lbl_in = np.full(idx.size, li, np.int32)
-                train_pairs(lbl_in, idx)
-                if cfg.train_words:
-                    c, x = sentence_pairs(idx, cfg.window, rng)
-                    if c.size:
-                        train_pairs(x, c)
+        total_words = int(lens.sum())
+        self.syn0, self.syn1, _, _ = run_pair_training(
+            self.syn0, self.syn1, None, (cen, ctx, pos, dlt, off),
+            vocab_size=V, dim=D, epochs=cfg.epochs,
+            total_words=total_words, codes_t=codes_t, points_t=points_t,
+            mask_t=mask_full, table=jnp.zeros((1,), jnp.int32),
+            window=cfg.window, alpha=cfg.alpha, min_alpha=cfg.min_alpha,
+            use_hs=True, negative=0, batch_size=cfg.batch_size,
+            kernel=cfg.kernel, seed=cfg.seed)
 
         self._wv = WordVectors(cache, self.syn0)
         return self._wv

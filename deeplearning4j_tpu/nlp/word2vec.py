@@ -77,7 +77,7 @@ class Word2VecConfig:
 
 def _hs_update(syn0: Array, syn1: Array, inputs: Array, codes: Array,
                points: Array, mask: Array, alpha: Array):
-    """One batched HS update (plain function; jitted wrappers below).
+    """One batched HS update (the XLA gather/scatter path).
 
     inputs [B] — rows of syn0 to train (context words);
     codes/points/mask [B, L] — the center words' Huffman paths.
@@ -125,7 +125,7 @@ def _neg_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
     neu1e = jnp.einsum("bk,bkd->bd", g, sn)
     dneg = g[:, :, None] * l1[:, None, :]
     B, K1, D = dneg.shape
-    # per-row mean normalization (see _hs_step)
+    # per-row mean normalization (see _hs_update)
     flat_rows = rows.reshape(B * K1)
     hit = (valid * pair_mask[:, None]).reshape(B * K1)
     cntn = jnp.zeros(syn1neg.shape[0]).at[flat_rows].add(hit, mode="drop")
@@ -136,11 +136,6 @@ def _neg_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
     syn0 = syn0.at[inputs].add(
         neu1e / jnp.maximum(cnt0, 1.0)[inputs][:, None], mode="drop")
     return syn0, syn1neg
-
-
-#: jitted single-objective steps (kept for paragraph_vectors and tests)
-_hs_step = partial(jax.jit, donate_argnums=(0, 1))(_hs_update)
-_neg_step = partial(jax.jit, donate_argnums=(0, 1))(_neg_update)
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2),
@@ -315,6 +310,112 @@ def corpus_pairs(indexed: Sequence[np.ndarray], window: int,
                  for k in range(5))
 
 
+def run_pair_training(syn0: Array, syn1: Array, syn1neg: Optional[Array],
+                      pairs: Tuple[np.ndarray, ...], *,
+                      vocab_size: int, dim: int, epochs: int,
+                      total_words: int, codes_t: Array, points_t: Array,
+                      mask_t: Array, table: Array, window: int,
+                      alpha: float, min_alpha: float, use_hs: bool,
+                      negative: int, batch_size: int, kernel: str,
+                      seed: int, dev_cache=None):
+    """The shared scanned-epoch training engine (Word2Vec AND
+    ParagraphVectors fit through here).
+
+    ``pairs`` = (centers, contexts, center_pos, delta, word_offset) from
+    ``corpus_pairs`` (plus any extra always-train pairs encoded with
+    delta = 0).  Handles kernel validation/selection (VMEM-resident
+    Pallas kernel on TPU when the tables fit; ``kernel='pallas'`` raises
+    when they don't), chunking with the device-residency cap
+    (host-numpy streaming past ~32M pairs), and the per-dispatch slab
+    cap.  Returns ``(syn0, syn1, syn1neg, dev_cache)`` — thread
+    ``dev_cache`` back in to reuse the uploaded pair chunks across
+    repeated fits on the same corpus."""
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"kernel must be 'auto', 'pallas' or 'xla', got {kernel!r}")
+    cen, ctx, cpos, dlt, woff = pairs
+    P = cen.size
+    if P == 0:
+        return syn0, syn1, syn1neg, dev_cache
+    B = batch_size
+    NC = -(-P // B)
+    pad = NC * B - P
+
+    def chunked_np(a: np.ndarray, fill=0) -> np.ndarray:
+        if pad:
+            a = np.concatenate([a, np.full(pad, fill, a.dtype)])
+        return a.reshape(NC, B)
+
+    # Device-resident pair arrays only while they stay small (they are
+    # re-read every epoch); past the cap, each slab streams from host
+    # numpy instead — bounded HBM however large the corpus, at one
+    # host->device copy per slab per epoch.
+    resident = P <= 32 * (1 << 20)            # 4 int32 arrays ≈ 512 MB
+    if dev_cache is None:
+        arrays = (chunked_np(cen), chunked_np(ctx), chunked_np(cpos),
+                  chunked_np(dlt))
+        if resident:
+            arrays = tuple(jnp.asarray(a) for a in arrays)
+        # per-chunk lr clock = word offset at the chunk's first pair
+        dev_cache = arrays + (jnp.asarray(woff[::B].copy()),
+                              jnp.arange(NC, dtype=jnp.int32))
+    cen_d, ctx_d, cpos_d, dlt_d, woff_d, cids = dev_cache
+    n_pairs = jnp.int32(P)
+    # syn1neg placeholder so the scan has a donatable buffer when
+    # negative sampling is OFF (that static branch never reads it)
+    neg_tab = (syn1neg if syn1neg is not None
+               else jnp.zeros((1, 1), jnp.float32))
+
+    # kernel selection: VMEM-resident Pallas kernel on TPU whenever the
+    # tables fit (2.7x the XLA path on v5e at bench shapes);
+    # kernel="pallas" forces it (via the interpreter off-TPU: tests)
+    pallas_block, pallas_interpret = 0, False
+    if kernel != "xla":
+        from deeplearning4j_tpu.ops.pallas_word2vec import choose_block
+        platform = jax.devices()[0].platform
+        blk = choose_block(vocab_size, dim, negative, B,
+                           interpret=platform != "tpu")
+        if blk and (platform == "tpu" or kernel == "pallas"):
+            pallas_block = blk
+            pallas_interpret = platform != "tpu"
+        elif kernel == "pallas":
+            raise ValueError(
+                f"kernel='pallas' but vocab {vocab_size} x dim {dim} "
+                f"exceeds the VMEM-resident budget (or batch_size {B} "
+                f"not divisible by the block)")
+
+    total = max(1, total_words * epochs)
+    nkey = jax.random.key(seed + 1)
+    # cap pairs-in-flight per dispatch: slab the chunk axis so a
+    # dispatch stays bounded; with host-streamed (non-resident) arrays
+    # this also caps HBM footprint (jit caches per NC-slab shape; the
+    # last partial slab adds at most one extra compile)
+    max_slab = max(1, (1 << 22) // B)         # ~4M pairs per dispatch
+    for epoch in range(epochs):
+        for c0 in range(0, NC, max_slab):
+            c1 = min(NC, c0 + max_slab)
+            syn0, syn1, neg_tab = _scan_slab(
+                syn0, syn1, neg_tab,
+                cen_d[c0:c1], ctx_d[c0:c1], cpos_d[c0:c1],
+                dlt_d[c0:c1], woff_d[c0:c1], cids[c0:c1], n_pairs,
+                codes_t, points_t, mask_t, table, nkey,
+                jnp.int32(epoch), jnp.float32(total_words),
+                jnp.float32(total), jnp.float32(alpha),
+                jnp.float32(min_alpha),
+                use_hs=use_hs, negative=negative, window=window,
+                pallas_block=pallas_block,
+                pallas_interpret=pallas_interpret)
+    return (syn0, syn1,
+            neg_tab if syn1neg is not None else None, dev_cache)
+
+
+def hs_mask_table(codes_t: np.ndarray, lengths_t: np.ndarray) -> Array:
+    """[V, L] float mask from per-word Huffman path lengths."""
+    return jnp.asarray(
+        (np.arange(codes_t.shape[1])[None, :] <
+         np.asarray(lengths_t)[:, None]).astype(np.float32))
+
+
 class Word2Vec:
     """fit() -> WordVectors.  API parity with Word2Vec.java's builder usage:
     Word2Vec(sentences, Word2VecConfig(...), tokenizer)."""
@@ -381,14 +482,11 @@ class Word2Vec:
                 else jnp.array(initial_weights[2]))
         else:
             self._reset_weights()
-        codes_t, points_t, lengths_t = encode_hs_tables(self.cache)
-        codes_t = jnp.asarray(codes_t)
-        points_t = jnp.asarray(points_t)
-        mask_t = jnp.asarray(
-            (np.arange(codes_t.shape[1])[None, :] <
-             np.asarray(lengths_t)[:, None]).astype(np.float32))
+        codes_np, points_np, lengths_t = encode_hs_tables(self.cache)
+        mask_t = hs_mask_table(codes_np, lengths_t)
+        codes_t = jnp.asarray(codes_np)
+        points_t = jnp.asarray(points_np)
         table = jnp.asarray(unigram_table(self.cache, cfg.table_size))
-        nkey = jax.random.key(cfg.seed + 1)
 
         # pre-index sentences + build the candidate pair list ONCE per
         # corpus; cached for repeated fit() calls on the same instance
@@ -407,88 +505,23 @@ class Word2Vec:
             self._pair_cache = (
                 corpus_pairs(indexed, cfg.window),
                 int(sum(a.size for a in indexed)))
-        (cen, ctx, cpos, dlt, woff), n_positions = self._pair_cache
-        total_words = n_positions
-        total = max(1, total_words * cfg.epochs)
+        pairs, n_positions = self._pair_cache
         if cfg.negative > 0 and self.syn1neg is None:
             raise ValueError(
                 "negative sampling enabled but no syn1neg table: pass "
                 "initial_weights with a syn1neg entry (or None weights to "
                 "initialize fresh)")
-        P = cen.size
-        if P == 0:
-            self._wv = WordVectors(self.cache, self.syn0)
-            return self._wv
-        B = cfg.batch_size
-        NC = -(-P // B)
-        pad = NC * B - P
-
-        def chunked_np(a: np.ndarray, fill=0) -> np.ndarray:
-            if pad:
-                a = np.concatenate([a, np.full(pad, fill, a.dtype)])
-            return a.reshape(NC, B)
-
-        # Device-resident pair arrays only while they stay small (they
-        # are re-read every epoch); past the cap, each slab streams from
-        # pinned host numpy instead — bounded HBM however large the
-        # corpus, at one host->device copy per slab per epoch.
-        resident = P <= 32 * (1 << 20)        # 4 int32 arrays ≈ 512 MB
-        if self._dev_cache is None:
-            arrays = (chunked_np(cen), chunked_np(ctx), chunked_np(cpos),
-                      chunked_np(dlt))
-            if resident:
-                arrays = tuple(jnp.asarray(a) for a in arrays)
-            # per-chunk lr clock = word offset at the chunk's first pair
-            self._dev_cache = arrays + (
-                jnp.asarray(woff[::B].copy()),
-                jnp.arange(NC, dtype=jnp.int32))
-        cen_d, ctx_d, cpos_d, dlt_d, woff_d, cids = self._dev_cache
-        n_pairs = jnp.int32(P)
-        # syn1neg placeholder so the scan has a donatable buffer when
-        # negative sampling is OFF (that static branch never reads it)
-        neg_tab = (self.syn1neg if self.syn1neg is not None
-                   else jnp.zeros((1, 1), jnp.float32))
-
-        # kernel selection: VMEM-resident Pallas kernel on TPU whenever
-        # the tables fit (2.7x the XLA path on v5e at bench shapes);
-        # kernel="pallas" forces it (via the interpreter off-TPU: tests)
-        pallas_block, pallas_interpret = 0, False
-        if cfg.kernel != "xla":
-            from deeplearning4j_tpu.ops.pallas_word2vec import choose_block
-            platform = jax.devices()[0].platform
-            blk = choose_block(len(self.cache), cfg.vector_size,
-                               cfg.negative, B,
-                               interpret=platform != "tpu")
-            if blk and (platform == "tpu" or cfg.kernel == "pallas"):
-                pallas_block = blk
-                pallas_interpret = platform != "tpu"
-            elif cfg.kernel == "pallas":
-                raise ValueError(
-                    f"kernel='pallas' but vocab {len(self.cache)} x dim "
-                    f"{cfg.vector_size} exceeds the VMEM-resident budget "
-                    f"(or batch_size {B} not divisible by the block)")
-
-        # cap pairs-in-flight per dispatch: slab the chunk axis so a
-        # dispatch stays bounded; with host-streamed (non-resident)
-        # arrays this also caps HBM footprint (jit caches per NC-slab
-        # shape; the last partial slab adds at most one extra compile)
-        max_slab = max(1, (1 << 22) // B)     # ~4M pairs per dispatch
-        for epoch in range(cfg.epochs):
-            for c0 in range(0, NC, max_slab):
-                c1 = min(NC, c0 + max_slab)
-                self.syn0, self.syn1, neg_tab = _scan_slab(
-                    self.syn0, self.syn1, neg_tab,
-                    cen_d[c0:c1], ctx_d[c0:c1], cpos_d[c0:c1],
-                    dlt_d[c0:c1], woff_d[c0:c1], cids[c0:c1], n_pairs,
-                    codes_t, points_t, mask_t, table, nkey,
-                    jnp.int32(epoch), jnp.float32(total_words),
-                    jnp.float32(total), jnp.float32(cfg.alpha),
-                    jnp.float32(cfg.min_alpha),
-                    use_hs=cfg.use_hs, negative=cfg.negative,
-                    window=cfg.window, pallas_block=pallas_block,
-                    pallas_interpret=pallas_interpret)
-        if self.syn1neg is not None:
-            self.syn1neg = neg_tab
+        self.syn0, self.syn1, self.syn1neg, self._dev_cache = \
+            run_pair_training(
+                self.syn0, self.syn1, self.syn1neg, pairs,
+                vocab_size=len(self.cache), dim=cfg.vector_size,
+                epochs=cfg.epochs, total_words=n_positions,
+                codes_t=codes_t, points_t=points_t, mask_t=mask_t,
+                table=table, window=cfg.window, alpha=cfg.alpha,
+                min_alpha=cfg.min_alpha, use_hs=cfg.use_hs,
+                negative=cfg.negative, batch_size=cfg.batch_size,
+                kernel=cfg.kernel, seed=cfg.seed,
+                dev_cache=self._dev_cache)
         self._wv = WordVectors(self.cache, self.syn0)
         return self._wv
 

@@ -256,3 +256,20 @@ def test_word2vec_pallas_neg_only_fit():
                          use_hs=False, batch_size=256, kernel="pallas")
     wv = Word2Vec(CORPUS, cfg).fit()
     assert np.all(np.isfinite(np.asarray(wv.vectors)))
+
+
+def test_build_vocab_distributed_matches_sequential():
+    """TextPipeline parity: distributed term/doc counting produces the
+    same VocabCache as the sequential build on the same corpus."""
+    from deeplearning4j_tpu.nlp.distributed import build_vocab_distributed
+    from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+    seq = build_vocab(CORPUS, DefaultTokenizerFactory(),
+                      min_word_frequency=2)
+    dist = build_vocab_distributed(CORPUS, min_word_frequency=2,
+                                   n_workers=3, n_shards=5)
+    assert dist.index == seq.index
+    assert dist.num_docs == seq.num_docs
+    for w in seq.index:
+        assert dist.word_frequency(w) == seq.word_frequency(w)
+        assert dist.doc_frequency(w) == seq.doc_frequency(w)
