@@ -313,7 +313,11 @@ def build_vocab_distributed(sentences: Sequence[str],
         router_cls=so.HogWildWorkRouter)
     out = runner.run(timeout_s=timeout_s)
     _warn_dropped(runner)
-    terms, docs, n_docs = out if out is not None else ({}, {}, 0)
+    if out is None:
+        raise ValueError(
+            "no worker produced vocabulary counts — every shard job was "
+            "dropped after repeated failures")
+    terms, docs, n_docs = out
     cache = VocabCache()
     for w, c in terms.items():
         cache.add_token(w, count=float(c))
