@@ -114,3 +114,38 @@ class SummaryStatistics:
 
 def summary_stats(values) -> str:
     return str(SummaryStatistics.of(values))
+
+
+def moving_average(x, n: int):
+    """Per-row moving average of window length ``n`` over the last axis
+    (``util/TimeSeriesUtils.java:movingAverage`` — cumsum formulation).
+    [..., C] -> [..., C - n + 1]."""
+    import numpy as np
+
+    v = np.asarray(x, dtype=np.float64)
+    cs = np.cumsum(v, axis=-1)
+    head = cs[..., n - 1:n]                      # first full window sum
+    rest = cs[..., n:] - cs[..., :-n]
+    return np.concatenate([head, rest], axis=-1) / float(n)
+
+
+def moving_window_matrix(x, window_rows: int, window_cols: int,
+                         add_rotate: bool = False, flattened: bool = False):
+    """Consecutive flat (window_rows x window_cols) chunks of a matrix
+    (``util/MovingWindowMatrix.java:windows`` semantics: the flattened
+    input is sliced into window-area chunks; ``add_rotate`` appends the
+    three rot90 orientations of each window before it)."""
+    import numpy as np
+
+    flat = np.asarray(x).ravel()
+    area = window_rows * window_cols
+    out = []
+    for lo in range(0, flat.size - area + 1, area):
+        win = flat[lo:lo + area].reshape(window_rows, window_cols)
+        if add_rotate:
+            cur = win
+            for _ in range(3):
+                cur = np.rot90(cur)
+                out.append(cur.ravel() if flattened else cur.copy())
+        out.append(win.ravel() if flattened else win)
+    return out

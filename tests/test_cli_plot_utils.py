@@ -172,3 +172,27 @@ def test_string_grid():
     filtered = grid.filter_rows_by_column(0, {"a", "c"})
     assert [r[0] for r in filtered.rows] == ["a", "c"]
     assert grid.to_lines()[2] == "c,Alice,3"
+
+
+def test_moving_average():
+    import numpy as np
+    from deeplearning4j_tpu.utils.math_utils import moving_average
+
+    x = np.asarray([[1.0, 2.0, 3.0, 4.0], [2.0, 2.0, 2.0, 2.0]])
+    got = moving_average(x, 2)
+    np.testing.assert_allclose(got, [[1.5, 2.5, 3.5], [2.0, 2.0, 2.0]])
+
+
+def test_moving_window_matrix():
+    import numpy as np
+    from deeplearning4j_tpu.utils.math_utils import moving_window_matrix
+
+    x = np.asarray([[1, 1, 2, 2], [1, 1, 2, 2],
+                    [3, 3, 4, 4], [3, 3, 4, 4]], np.float32)
+    wins = moving_window_matrix(x, 2, 2)
+    assert len(wins) == 4 and wins[0].shape == (2, 2)
+    # flat-chunk semantics: first window = first 4 flat elements
+    np.testing.assert_allclose(
+        wins[0], np.asarray([[1, 1], [2, 2]], np.float32))
+    rot = moving_window_matrix(x, 2, 2, add_rotate=True)
+    assert len(rot) == 16        # 3 rotations + original per window
