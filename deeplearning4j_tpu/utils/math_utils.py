@@ -120,8 +120,6 @@ def moving_average(x, n: int):
     """Per-row moving average of window length ``n`` over the last axis
     (``util/TimeSeriesUtils.java:movingAverage`` — cumsum formulation).
     [..., C] -> [..., C - n + 1]."""
-    import numpy as np
-
     v = np.asarray(x, dtype=np.float64)
     cs = np.cumsum(v, axis=-1)
     head = cs[..., n - 1:n]                      # first full window sum
@@ -135,13 +133,13 @@ def moving_window_matrix(x, window_rows: int, window_cols: int,
     (``util/MovingWindowMatrix.java:windows`` semantics: the flattened
     input is sliced into window-area chunks; ``add_rotate`` appends the
     three rot90 orientations of each window before it)."""
-    import numpy as np
-
     flat = np.asarray(x).ravel()
     area = window_rows * window_cols
     out = []
     for lo in range(0, flat.size - area + 1, area):
-        win = flat[lo:lo + area].reshape(window_rows, window_cols)
+        # copy: the reference returns independent windows; a view here
+        # would alias the caller's matrix through every returned window
+        win = flat[lo:lo + area].reshape(window_rows, window_cols).copy()
         if add_rotate:
             cur = win
             for _ in range(3):
