@@ -52,6 +52,7 @@ class ParagraphVectors:
         self.labels: List[str] = []
         self.syn0 = None
         self.syn1 = None
+        self._hs_tables = None
         self._wv: Optional[WordVectors] = None
 
     def fit(self) -> WordVectors:
@@ -82,6 +83,8 @@ class ParagraphVectors:
         mask_full = hs_mask_table(codes_np, lengths_t)
         codes_t = jnp.asarray(codes_np)
         points_t = jnp.asarray(points_np)
+        # cached for infer_vector (rebuilding iterates the whole vocab)
+        self._hs_tables = (codes_np, points_np, np.asarray(mask_full))
 
         # Assemble ONE candidate pair list for the whole corpus, then run
         # the word2vec scanned-epoch engine on it.  Label pairs (PV-DBOW:
@@ -148,6 +151,39 @@ class ParagraphVectors:
 
     def similarity(self, a: str, b: str) -> float:
         return self.word_vectors.similarity(a, b)
+
+    def infer_vector(self, text: str, epochs: int = 25,
+                     alpha: Optional[float] = None) -> np.ndarray:
+        """Embed an UNSEEN document: train a fresh syn0-style row against
+        the document's words' Huffman paths with the rest of the space
+        frozen (the PV inference step; the reference retrains through the
+        same dbow update with only the new label row unfrozen)."""
+        cfg = self.config
+        if self.cache is None or self.syn1 is None:
+            raise RuntimeError("call fit() first")
+        idx = [self.cache.index_of(t) for t in self.tokenizer(text)]
+        idx = np.asarray([i for i in idx if i >= 0], np.int32)
+        if idx.size == 0:
+            return np.zeros(cfg.vector_size, np.float32)
+        codes_np, points_np, mask_np = self._hs_tables
+        mask = mask_np[idx]
+        codes = codes_np[idx].astype(np.float32)         # [n, L]
+        points = points_np[idx]                          # [n, L]
+        # on-device gather: syn1 stays put, only [n, L, D] rows move
+        s1 = jnp.take(self.syn1, jnp.asarray(points), axis=0)  # frozen
+        codes_j, mask_j = jnp.asarray(codes), jnp.asarray(mask)
+        a = jnp.float32(alpha if alpha is not None else cfg.alpha)
+        key = jax.random.key(cfg.seed + 7)
+        v0 = (jax.random.uniform(key, (cfg.vector_size,)) - 0.5) \
+            / cfg.vector_size
+
+        def epoch_step(v, _):
+            f = jax.nn.sigmoid(jnp.einsum("d,nld->nl", v, s1))
+            g = (1.0 - codes_j - f) * a * mask_j
+            return v + jnp.einsum("nl,nld->d", g, s1) / idx.size, None
+
+        v, _ = jax.lax.scan(epoch_step, v0, None, length=epochs)
+        return np.asarray(v)
 
     def nearest_labels(self, text: str, top_n: int = 3):
         """Infer by averaging word vectors of the text, rank labels."""

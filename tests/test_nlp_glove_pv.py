@@ -44,15 +44,20 @@ def test_glove_trains_and_loss_decreases():
     assert g.similarity("cat", "dog") > g.similarity("cat", "crowns")
 
 
-def test_paragraph_vectors_separates_topics():
+def _pv_fixture(epochs=25):
     docs = ([("animals_%d" % i,
               "the cat and the dog chased the mouse on the mat")
              for i in range(10)]
             + [("royalty_%d" % i,
                 "the king and the queen rule the castle and the palace")
                for i in range(10)])
-    cfg = ParagraphVectorsConfig(vector_size=32, window=3, epochs=25,
+    cfg = ParagraphVectorsConfig(vector_size=32, window=3, epochs=epochs,
                                  alpha=0.05, batch_size=128, seed=11)
+    return docs, cfg
+
+
+def test_paragraph_vectors_separates_topics():
+    docs, cfg = _pv_fixture()
     pv = ParagraphVectors(docs, cfg)
     pv.fit()
     same = pv.similarity("animals_0", "animals_1")
@@ -78,3 +83,22 @@ def test_bag_of_words_and_tfidf():
     # 'the' appears in every doc => idf 0 => tfidf 0
     assert np.allclose(t[:, the], 0.0)
     assert t[0, tfidf.cache.index_of("cat")] > 0
+
+
+def test_paragraph_vectors_infer_vector():
+    """Inference for an unseen document: the trained-row embedding of a
+    topic's text lands nearer that topic's doc vectors than the other's."""
+    docs, cfg = _pv_fixture(epochs=40)
+    pv = ParagraphVectors(docs, cfg)
+    pv.fit()
+    v = pv.infer_vector("the cat chased the dog on the mat", epochs=40)
+    assert v.shape == (32,) and np.isfinite(v).all()
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+    an = cos(v, pv.doc_vector("animals_0"))
+    ro = cos(v, pv.doc_vector("royalty_0"))
+    assert an > ro, (an, ro)
+    # empty/unknown text -> zero vector, no crash
+    assert not pv.infer_vector("zzz qqq").any()
