@@ -24,11 +24,13 @@ trained in ONE jitted program:
   Word2Vec.java trainSentence) is computed per batch and passed as a
   scalar.
 
-Pair generation stays on host but runs ONCE per corpus (full-window
-candidate pairs, cached across fits); the dynamic window shrink
-(b = rand % window, skipGram:314) is applied ON DEVICE as a per-epoch
-mask, and training runs as a ``lax.scan`` over fixed-size [B] chunks —
-one dispatch per epoch slab instead of one per chunk (see _scan_slab).
+Pair generation stays on host but runs ONCE per corpus: full-window
+candidate pairs are built in slabs that STREAM into epoch 0's async
+device dispatches (cold-fit wall time = max(host, device)), then cached
+for later epochs/fits; the dynamic window shrink (b = rand % window,
+skipGram:314) is applied ON DEVICE as a per-epoch mask, and each slab
+trains as one ``lax.scan`` dispatch over fixed-size [B] chunks
+(see _scan_slab / run_pair_training).
 """
 
 from __future__ import annotations
@@ -143,7 +145,7 @@ def _neg_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
                           "pallas_block", "pallas_interpret"))
 def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
                centers: Array, contexts: Array, cpos: Array, deltas: Array,
-               offsets: Array, chunk_ids: Array, n_pairs: Array,
+               offsets: Array, chunk_ids: Array, n_real: Array,
                codes_t: Array, points_t: Array, mask_t: Array,
                table: Array, key: Array, epoch: Array,
                total_words: Array, total: Array, alpha0: Array,
@@ -169,7 +171,9 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
     ``offsets`` [NC] = corpus word offset at each chunk's first pair, so
     the linear lr decay by words seen (trainSentence:298) stays exact:
     ``alpha = max(min_alpha, alpha0 * (1 - seen/total))`` with
-    ``seen = epoch * total_words + offsets[c]``.
+    ``seen = epoch * total_words + offsets[c]``.  ``n_real`` [NC] = real
+    (unpadded) pairs per chunk; ``chunk_ids`` stay globally unique across
+    slabs so negative draws never repeat within an epoch.
     """
     ekey = jax.random.fold_in(key, epoch)
     seed32 = jax.random.randint(
@@ -190,10 +194,10 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
 
     def body(carry, inp):
         syn0, syn1, syn1neg = carry
-        cen, ctx, pos, dlt, off, cid = inp
+        cen, ctx, pos, dlt, off, cid, nr = inp
         shrink = window - b_draw(pos)                        # [B]
         wmask = (jnp.abs(dlt) <= shrink).astype(jnp.float32)
-        pmask = ((cid * B + col) < n_pairs).astype(jnp.float32)
+        pmask = (col < nr).astype(jnp.float32)
         m = wmask * pmask
         seen = epoch * total_words + off
         alpha = jnp.maximum(min_alpha, alpha0 * (1.0 - seen / total))
@@ -239,7 +243,7 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
 
     (syn0, syn1, syn1neg), _ = jax.lax.scan(
         body, (syn0, syn1, syn1neg),
-        (centers, contexts, cpos, deltas, offsets, chunk_ids))
+        (centers, contexts, cpos, deltas, offsets, chunk_ids, n_real))
     return syn0, syn1, syn1neg
 
 
@@ -283,8 +287,19 @@ def corpus_pairs(indexed: Sequence[np.ndarray], window: int,
     lr-decay clock.  Vectorized over ``slab``-position blocks so the
     [n, 2W] candidate matrix never exceeds ~40 MB however large the
     corpus."""
-    if not indexed:
+    outs = list(_corpus_pair_blocks(indexed, window, slab))
+    if not outs:
         return (np.empty(0, np.int32),) * 4 + (np.empty(0, np.float32),)
+    return tuple(np.concatenate([o[k] for o in outs])        # type: ignore
+                 for k in range(5))
+
+
+def _corpus_pair_blocks(indexed: Sequence[np.ndarray], window: int,
+                        slab: int = 1 << 20):
+    """Yield candidate-pair 5-tuples per position slab (corpus_pairs'
+    loop body, exposed for the streaming trainer)."""
+    if not indexed:
+        return
     tok = np.concatenate(indexed).astype(np.int32)
     lens = np.asarray([a.size for a in indexed])
     sid = np.repeat(np.arange(len(indexed)), lens)
@@ -295,7 +310,6 @@ def corpus_pairs(indexed: Sequence[np.ndarray], window: int,
     n = tok.size
     deltas = np.concatenate([np.arange(-window, 0),
                              np.arange(1, window + 1)]).astype(np.int32)
-    outs: List[Tuple[np.ndarray, ...]] = []
     for s0 in range(0, n, slab):
         s1 = min(n, s0 + slab)
         pos = np.arange(s0, s1, dtype=np.int32)
@@ -304,65 +318,67 @@ def corpus_pairs(indexed: Sequence[np.ndarray], window: int,
         valid = (j >= 0) & (j < n) & (sid[jc] == sid[s0:s1, None])
         ci, di = np.nonzero(valid)
         p = pos[ci]
-        outs.append((tok[p], tok[j[ci, di]], p.astype(np.int32),
-                     deltas[di], word_off[p]))
-    return tuple(np.concatenate([o[k] for o in outs])        # type: ignore
-                 for k in range(5))
+        yield (tok[p], tok[j[ci, di]], p.astype(np.int32),
+               deltas[di], word_off[p])
 
 
-def run_pair_training(syn0: Array, syn1: Array, syn1neg: Optional[Array],
-                      pairs: Tuple[np.ndarray, ...], *,
-                      vocab_size: int, dim: int, epochs: int,
-                      total_words: int, codes_t: Array, points_t: Array,
-                      mask_t: Array, table: Array, window: int,
-                      alpha: float, min_alpha: float, use_hs: bool,
-                      negative: int, batch_size: int, kernel: str,
-                      seed: int, dev_cache=None):
+def corpus_pairs_slabs(indexed: Sequence[np.ndarray], window: int,
+                       pairs_per_slab: int):
+    """Yield ``corpus_pairs``-shaped blocks of ~``pairs_per_slab`` pairs.
+    Streaming form: the scanned trainer dispatches each block (async)
+    before the host builds the next, so cold-fit wall time is
+    max(host pair generation, device training), not their sum."""
+    bufs: List[Tuple[np.ndarray, ...]] = []
+    n = 0
+    # position-slab sized so each block stays well under the pair budget
+    # (a position contributes up to 2*window candidate pairs)
+    pos_slab = max(1024, pairs_per_slab // (8 * window))
+    for arr_slab in _corpus_pair_blocks(indexed, window, pos_slab):
+        bufs.append(arr_slab)
+        n += arr_slab[0].size
+        if n >= pairs_per_slab:
+            yield tuple(np.concatenate([b[k] for b in bufs])
+                        for k in range(5))
+            bufs, n = [], 0
+    if bufs:
+        yield tuple(np.concatenate([b[k] for b in bufs]) for k in range(5))
+
+
+#: pairs per dispatch — bounds device buffers and jit-cache variants
+PAIRS_PER_SLAB = 1 << 22
+#: total pairs kept device-resident across epochs (beyond: host numpy,
+#: re-uploaded once per slab per epoch — bounded HBM for any corpus)
+RESIDENT_PAIR_CAP = 32 * (1 << 20)
+
+
+def run_pair_training(syn0, syn1, syn1neg,
+                      pairs=None, *,
+                      vocab_size, dim, epochs,
+                      total_words, codes_t, points_t,
+                      mask_t, table, window,
+                      alpha, min_alpha, use_hs,
+                      negative, batch_size, kernel,
+                      seed, dev_cache=None, pairs_iter=None):
     """The shared scanned-epoch training engine (Word2Vec AND
     ParagraphVectors fit through here).
 
-    ``pairs`` = (centers, contexts, center_pos, delta, word_offset) from
-    ``corpus_pairs`` (plus any extra always-train pairs encoded with
-    delta = 0).  Handles kernel validation/selection (VMEM-resident
-    Pallas kernel on TPU when the tables fit; ``kernel='pallas'`` raises
-    when they don't), chunking with the device-residency cap
-    (host-numpy streaming past ~32M pairs), and the per-dispatch slab
-    cap.  Returns ``(syn0, syn1, syn1neg, dev_cache)`` — thread
-    ``dev_cache`` back in to reuse the uploaded pair chunks across
-    repeated fits on the same corpus."""
+    Input pairs (centers, contexts, center_pos, delta, word_offset — the
+    ``corpus_pairs`` layout, plus any always-train pairs encoded with
+    delta = 0) arrive either materialized (``pairs``) or as a STREAM of
+    blocks (``pairs_iter``, e.g. ``corpus_pairs_slabs``).  In streaming
+    form epoch 0 interleaves host pair generation with async device
+    dispatch: cold-fit wall time is max(host, device), not their sum.
+
+    Handles kernel validation/selection (VMEM-resident Pallas kernel on
+    TPU when the tables fit; ``kernel='pallas'`` raises when they
+    don't), per-slab chunking with the device-residency cap, and
+    globally-unique chunk ids (negative-sample draws never repeat within
+    an epoch).  Returns ``(syn0, syn1, syn1neg, dev_cache)`` — thread
+    ``dev_cache`` back in to replay the prepared slabs on later fits."""
     if kernel not in ("auto", "pallas", "xla"):
         raise ValueError(
             f"kernel must be 'auto', 'pallas' or 'xla', got {kernel!r}")
-    cen, ctx, cpos, dlt, woff = pairs
-    P = cen.size
-    if P == 0:
-        return syn0, syn1, syn1neg, dev_cache
     B = batch_size
-    NC = -(-P // B)
-    pad = NC * B - P
-
-    def chunked_np(a: np.ndarray, fill=0) -> np.ndarray:
-        if pad:
-            a = np.concatenate([a, np.full(pad, fill, a.dtype)])
-        return a.reshape(NC, B)
-
-    # Device-resident pair arrays only while they stay small (they are
-    # re-read every epoch); past the cap, each slab streams from host
-    # numpy instead — bounded HBM however large the corpus, at one
-    # host->device copy per slab per epoch.
-    resident = P <= 32 * (1 << 20)            # 4 int32 arrays ≈ 512 MB
-    if dev_cache is None:
-        arrays = (chunked_np(cen), chunked_np(ctx), chunked_np(cpos),
-                  chunked_np(dlt))
-        if resident:
-            arrays = tuple(jnp.asarray(a) for a in arrays)
-        # per-chunk lr clock = word offset at the chunk's first pair
-        dev_cache = arrays + (jnp.asarray(woff[::B].copy()),
-                              jnp.arange(NC, dtype=jnp.int32))
-    cen_d, ctx_d, cpos_d, dlt_d, woff_d, cids = dev_cache
-    n_pairs = jnp.int32(P)
-    # syn1neg placeholder so the scan has a donatable buffer when
-    # negative sampling is OFF (that static branch never reads it)
     neg_tab = (syn1neg if syn1neg is not None
                else jnp.zeros((1, 1), jnp.float32))
 
@@ -384,27 +400,76 @@ def run_pair_training(syn0: Array, syn1: Array, syn1neg: Optional[Array],
                 f"exceeds the VMEM-resident budget (or batch_size {B} "
                 f"not divisible by the block)")
 
+    if epochs <= 0:
+        return syn0, syn1, syn1neg, dev_cache
     total = max(1, total_words * epochs)
     nkey = jax.random.key(seed + 1)
-    # cap pairs-in-flight per dispatch: slab the chunk axis so a
-    # dispatch stays bounded; with host-streamed (non-resident) arrays
-    # this also caps HBM footprint (jit caches per NC-slab shape; the
-    # last partial slab adds at most one extra compile)
-    max_slab = max(1, (1 << 22) // B)         # ~4M pairs per dispatch
-    for epoch in range(epochs):
-        for c0 in range(0, NC, max_slab):
-            c1 = min(NC, c0 + max_slab)
-            syn0, syn1, neg_tab = _scan_slab(
-                syn0, syn1, neg_tab,
-                cen_d[c0:c1], ctx_d[c0:c1], cpos_d[c0:c1],
-                dlt_d[c0:c1], woff_d[c0:c1], cids[c0:c1], n_pairs,
-                codes_t, points_t, mask_t, table, nkey,
-                jnp.int32(epoch), jnp.float32(total_words),
-                jnp.float32(total), jnp.float32(alpha),
-                jnp.float32(min_alpha),
-                use_hs=use_hs, negative=negative, window=window,
-                pallas_block=pallas_block,
-                pallas_interpret=pallas_interpret)
+
+    def prep_slab(blk, resident):
+        cen, ctx, cpos, dlt, woff = blk
+        P = cen.size
+        NC = -(-P // B)
+        pad = NC * B - P
+
+        def ch(a, fill=0):
+            if pad:
+                a = np.concatenate([a, np.full(pad, fill, a.dtype)])
+            a = a.reshape(NC, B)
+            return jnp.asarray(a) if resident else a
+
+        n_real = np.full(NC, B, np.int32)
+        n_real[-1] = P - (NC - 1) * B
+        # per-chunk lr clock = word offset at the chunk's first pair
+        return (ch(cen), ch(ctx), ch(cpos), ch(dlt),
+                jnp.asarray(woff[::B].copy()), jnp.asarray(n_real))
+
+    def dispatch(slab, cid0, epoch, state):
+        syn0, syn1, neg_tab = state
+        cen_d, ctx_d, cpos_d, dlt_d, woff_d, n_real = slab
+        NC = n_real.shape[0]
+        cids = jnp.arange(cid0, cid0 + NC, dtype=jnp.int32)
+        return _scan_slab(
+            syn0, syn1, neg_tab, cen_d, ctx_d, cpos_d, dlt_d,
+            woff_d, cids, n_real, codes_t, points_t, mask_t, table,
+            nkey, jnp.int32(epoch), jnp.float32(total_words),
+            jnp.float32(total), jnp.float32(alpha),
+            jnp.float32(min_alpha),
+            use_hs=use_hs, negative=negative, window=window,
+            pallas_block=pallas_block, pallas_interpret=pallas_interpret)
+
+    state = (syn0, syn1, neg_tab)
+    if dev_cache is None:
+        if pairs_iter is None:
+            if pairs is None:
+                raise ValueError("need pairs, pairs_iter or dev_cache")
+
+            def _slices():
+                P = pairs[0].size
+                for lo in range(0, P, PAIRS_PER_SLAB):
+                    yield tuple(a[lo:lo + PAIRS_PER_SLAB] for a in pairs)
+
+            pairs_iter = _slices()
+        # epoch 0 streams: prep slab k+1 on host while the device (async
+        # dispatch) trains slab k; prepared slabs are cached for replay
+        dev_cache = []
+        seen_pairs = 0
+        cid0 = 0
+        for blk in pairs_iter:
+            if blk[0].size == 0:
+                continue
+            resident = seen_pairs + blk[0].size <= RESIDENT_PAIR_CAP
+            slab = prep_slab(blk, resident)
+            state = dispatch(slab, cid0, 0, state)
+            dev_cache.append((slab, cid0))
+            seen_pairs += blk[0].size
+            cid0 += slab[5].shape[0]
+        first_epoch = 1
+    else:
+        first_epoch = 0
+    for epoch in range(first_epoch, epochs):
+        for slab, cid0 in dev_cache:
+            state = dispatch(slab, cid0, epoch, state)
+    syn0, syn1, neg_tab = state
     return (syn0, syn1,
             neg_tab if syn1neg is not None else None, dev_cache)
 
@@ -432,8 +497,8 @@ class Word2Vec:
         self.syn1: Optional[Array] = None
         self.syn1neg: Optional[Array] = None
         self._wv: Optional[WordVectors] = None
-        self._pair_cache = None     # host (pairs, n_positions)
-        self._dev_cache = None      # device-resident chunked pair arrays
+        self._n_positions = 0       # corpus words (the lr-decay clock)
+        self._dev_cache = None      # prepared pair slabs (see engine)
 
     # -- vocab (buildVocab:257 parity) -------------------------------------
     def build_vocab(self) -> VocabCache:
@@ -488,10 +553,16 @@ class Word2Vec:
         points_t = jnp.asarray(points_np)
         table = jnp.asarray(unigram_table(self.cache, cfg.table_size))
 
-        # pre-index sentences + build the candidate pair list ONCE per
-        # corpus; cached for repeated fit() calls on the same instance
-        # (warm-started resumes, benchmarking compiled-path steady state)
-        if getattr(self, "_pair_cache", None) is None:
+        if cfg.negative > 0 and self.syn1neg is None:
+            raise ValueError(
+                "negative sampling enabled but no syn1neg table: pass "
+                "initial_weights with a syn1neg entry (or None weights to "
+                "initialize fresh)")
+        # COLD fit: index sentences, then STREAM candidate-pair slabs —
+        # epoch 0 trains each slab (async dispatch) while the host builds
+        # the next, and the prepared slabs are cached so later fits (and
+        # epochs 1+) replay them with zero host pair work.
+        if self._dev_cache is None:
             indexed: List[np.ndarray] = []
             for sent in self.sentences:
                 idx = [self.cache.index_of(t)
@@ -499,29 +570,22 @@ class Word2Vec:
                 arr = np.asarray([i for i in idx if i >= 0], np.int32)
                 if arr.size:
                     indexed.append(arr)
-            # ONE host pass builds the full-window candidate pair list;
-            # the per-epoch window shrink is an on-device mask, so epochs
-            # cost zero additional host work (see _scan_slab docstring).
-            self._pair_cache = (
-                corpus_pairs(indexed, cfg.window),
-                int(sum(a.size for a in indexed)))
-        pairs, n_positions = self._pair_cache
-        if cfg.negative > 0 and self.syn1neg is None:
-            raise ValueError(
-                "negative sampling enabled but no syn1neg table: pass "
-                "initial_weights with a syn1neg entry (or None weights to "
-                "initialize fresh)")
+            self._n_positions = int(sum(a.size for a in indexed))
+            pairs_iter = corpus_pairs_slabs(indexed, cfg.window,
+                                            PAIRS_PER_SLAB)
+        else:
+            pairs_iter = None
         self.syn0, self.syn1, self.syn1neg, self._dev_cache = \
             run_pair_training(
-                self.syn0, self.syn1, self.syn1neg, pairs,
+                self.syn0, self.syn1, self.syn1neg,
                 vocab_size=len(self.cache), dim=cfg.vector_size,
-                epochs=cfg.epochs, total_words=n_positions,
+                epochs=cfg.epochs, total_words=self._n_positions,
                 codes_t=codes_t, points_t=points_t, mask_t=mask_t,
                 table=table, window=cfg.window, alpha=cfg.alpha,
                 min_alpha=cfg.min_alpha, use_hs=cfg.use_hs,
                 negative=cfg.negative, batch_size=cfg.batch_size,
                 kernel=cfg.kernel, seed=cfg.seed,
-                dev_cache=self._dev_cache)
+                dev_cache=self._dev_cache, pairs_iter=pairs_iter)
         self._wv = WordVectors(self.cache, self.syn0)
         return self._wv
 

@@ -273,3 +273,13 @@ def test_build_vocab_distributed_matches_sequential():
     for w in seq.index:
         assert dist.word_frequency(w) == seq.word_frequency(w)
         assert dist.doc_frequency(w) == seq.doc_frequency(w)
+
+
+def test_word2vec_zero_epochs_trains_nothing():
+    """epochs=0 must leave the freshly-initialized tables untouched
+    (the streamed epoch-0 path must not dispatch)."""
+    cfg = Word2VecConfig(vector_size=16, epochs=0, batch_size=256, seed=1)
+    w2v = Word2Vec(CORPUS[:16], cfg)
+    w2v.fit()
+    # syn1 starts all-zero and only training moves it
+    assert not np.asarray(w2v.syn1).any()
