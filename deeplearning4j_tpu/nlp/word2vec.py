@@ -336,11 +336,15 @@ def corpus_pairs_slabs(indexed: Sequence[np.ndarray], window: int,
     for arr_slab in _corpus_pair_blocks(indexed, window, pos_slab):
         bufs.append(arr_slab)
         n += arr_slab[0].size
-        if n >= pairs_per_slab:
-            yield tuple(np.concatenate([b[k] for b in bufs])
+        while n >= pairs_per_slab:
+            # emit EXACTLY pairs_per_slab (uniform [NC, B] shapes ->
+            # one jit variant for all full slabs); remainder carries over
+            cat = tuple(np.concatenate([b[k] for b in bufs])
                         for k in range(5))
-            bufs, n = [], 0
-    if bufs:
+            yield tuple(a[:pairs_per_slab] for a in cat)
+            bufs = [tuple(a[pairs_per_slab:] for a in cat)]
+            n -= pairs_per_slab
+    if n:
         yield tuple(np.concatenate([b[k] for b in bufs]) for k in range(5))
 
 

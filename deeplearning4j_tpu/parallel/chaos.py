@@ -1,0 +1,107 @@
+"""Fault injection for the scaleout runtime — chaos testing as a
+first-class capability.
+
+The reference's fault story is detection/recovery only (heartbeat reaper
+``MasterActor.java:139-169``, job re-delivery, worker enable/disable);
+SURVEY.md §5.3 notes it ships NO fault *injection* anywhere.  This module
+adds it: deterministic, seedable failure wrappers so the recovery paths
+(requeue, drop-after-retries, elastic rejoin) are exercised on purpose in
+tests and soak runs rather than only when something really breaks.
+
+``ChaosPerformer`` wraps any ``WorkerPerformer`` and injects, per
+``perform`` call and independently per worker:
+- crashes (raise) with probability ``p_fail``;
+- stalls of ``stall_s`` seconds with probability ``p_stall`` (exercises
+  the heartbeat/stale-reaper path when stalls exceed the reaper window);
+- result corruption hooks (``corrupt`` callable) for aggregator
+  hardening tests.
+
+Failures are drawn from a counter-based hash of (seed, worker calls), so
+a given seed produces the same fault schedule every run — flaky-test
+debugging stays deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.parallel.coordinator import Job
+from deeplearning4j_tpu.parallel import scaleout as so
+
+
+class InjectedFault(RuntimeError):
+    """Raised by ChaosPerformer for an injected crash."""
+
+
+def _hash01(seed: int, n: int) -> float:
+    """Deterministic uniform [0, 1) from (seed, call index)."""
+    h = (seed * 2654435761 + n * 40503) & 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 2246822519) & 0xFFFFFFFF
+    h ^= h >> 13
+    return (h & 0xFFFFFF) / float(1 << 24)
+
+
+class ChaosPerformer(so.WorkerPerformer):
+    """Wrap ``inner`` with a deterministic fault schedule."""
+
+    def __init__(self, inner: so.WorkerPerformer, *, p_fail: float = 0.0,
+                 p_stall: float = 0.0, stall_s: float = 0.0,
+                 corrupt: Optional[Callable] = None, seed: int = 0):
+        self.inner = inner
+        self.p_fail = p_fail
+        self.p_stall = p_stall
+        self.stall_s = stall_s
+        self.corrupt = corrupt
+        self.seed = seed
+        self._calls = 0
+        self._lock = threading.Lock()
+        #: observability: how many of each fault fired
+        self.injected = {"fail": 0, "stall": 0, "corrupt": 0}
+
+    def _next_call(self) -> int:
+        with self._lock:
+            self._calls += 1
+            return self._calls
+
+    def perform(self, job: Job) -> None:
+        n = self._next_call()
+        u = _hash01(self.seed, n)
+        if u < self.p_fail:
+            self.injected["fail"] += 1
+            raise InjectedFault(
+                f"injected crash (call {n}, u={u:.3f} < {self.p_fail})")
+        if _hash01(self.seed + 1, n) < self.p_stall:
+            self.injected["stall"] += 1
+            time.sleep(self.stall_s)
+        self.inner.perform(job)
+        if self.corrupt is not None \
+                and _hash01(self.seed + 2, n) < 0.5:
+            self.injected["corrupt"] += 1
+            job.result = self.corrupt(job.result)
+
+    def update(self, *args) -> None:
+        self.inner.update(*args)
+
+
+def chaos_factory(inner_factory: Callable[[], so.WorkerPerformer], *,
+                  p_fail: float = 0.0, p_stall: float = 0.0,
+                  stall_s: float = 0.0, seed: int = 0
+                  ) -> Callable[[], so.WorkerPerformer]:
+    """Performer factory wrapper for ``DistributedRunner``: each worker
+    gets its own ChaosPerformer with a distinct derived seed, so faults
+    are spread across workers but stay reproducible."""
+    counter = {"n": 0}
+    lock = threading.Lock()
+
+    def make() -> ChaosPerformer:
+        with lock:
+            counter["n"] += 1
+            worker_seed = seed + 1000 * counter["n"]
+        return ChaosPerformer(inner_factory(), p_fail=p_fail,
+                              p_stall=p_stall, stall_s=stall_s,
+                              seed=worker_seed)
+
+    return make
