@@ -1,0 +1,57 @@
+"""LeNet on MNIST through the CLI pipeline (the README's full workflow).
+
+Synthetic MNIST-shaped idx data by default (zero egress); set
+``MNIST_DIR`` (or pass --data-dir) at a directory with the four real idx
+files for the full run:
+
+    python examples/mnist_lenet.py [--data-dir ~/mnist] [--epochs 3]
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import cli                          # noqa: E402
+from deeplearning4j_tpu.datasets import mnist as mnist_io   # noqa: E402
+from deeplearning4j_tpu.models.lenet import lenet_conf      # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    work = tempfile.mkdtemp(prefix="lenet_example_")
+    data_dir = args.data_dir or os.environ.get("MNIST_DIR")
+    if data_dir is None:
+        data_dir = os.path.join(work, "mnist")
+        os.makedirs(data_dir)
+        x, y = mnist_io.synthetic_mnist(n=2048, seed=0)
+        mnist_io.write_idx_images(
+            os.path.join(data_dir, "train-images-idx3-ubyte"), x)
+        mnist_io.write_idx_labels(
+            os.path.join(data_dir, "train-labels-idx1-ubyte"), y)
+        xt, yt = mnist_io.synthetic_mnist(n=512, seed=1)
+        mnist_io.write_idx_images(
+            os.path.join(data_dir, "t10k-images-idx3-ubyte"), xt)
+        mnist_io.write_idx_labels(
+            os.path.join(data_dir, "t10k-labels-idx1-ubyte"), yt)
+        print(f"(no real archive given: wrote synthetic idx files to "
+              f"{data_dir})")
+    os.environ["MNIST_DIR"] = data_dir
+
+    conf = os.path.join(work, "lenet.json")
+    with open(conf, "w") as f:
+        f.write(lenet_conf(lr=0.05).to_json())
+    model = os.path.join(work, "lenet.bin")
+    cli.main(["train", "--input", "mnist2d", "--conf", conf,
+              "--output", model, "--epochs", str(args.epochs),
+              "--batch", "128"])
+    cli.main(["test", "--input", "mnist2d-test", "--model", model])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Word2Vec / GloVe / ParagraphVectors on a toy corpus.
+
+On TPU, Word2Vec automatically trains through the VMEM-resident Pallas
+kernel (ops/pallas_word2vec) — one scanned dispatch per epoch slab.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.nlp.glove import Glove, GloveConfig          # noqa
+from deeplearning4j_tpu.nlp.paragraph_vectors import (               # noqa
+    ParagraphVectors, ParagraphVectorsConfig)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig  # noqa
+
+CORPUS = [
+    "the cat sat on the mat", "the dog sat on the rug",
+    "a cat and a dog are friends", "the king rules the castle",
+    "the queen rules the palace", "a king and a queen wear crowns",
+    "waves crash on the beach", "the beach is near the sea",
+] * 40
+
+
+def main() -> None:
+    w2v = Word2Vec(CORPUS, Word2VecConfig(
+        vector_size=48, window=3, epochs=60, negative=5, use_hs=True,
+        batch_size=512, alpha=0.05))
+    wv = w2v.fit()
+    print("word2vec nearest(sea):", wv.words_nearest("sea", 3))
+
+    glove = Glove(CORPUS, GloveConfig(vector_size=64, epochs=25))
+    gv = glove.fit()
+    print("glove  sim(cat,dog) =", round(gv.similarity("cat", "dog"), 3),
+          " sim(cat,crowns) =", round(gv.similarity("cat", "crowns"), 3))
+
+    docs = [(f"doc{i}", s) for i, s in enumerate(CORPUS[:64])]
+    pv = ParagraphVectors(docs, ParagraphVectorsConfig(
+        vector_size=32, window=3, epochs=30, alpha=0.05, batch_size=512))
+    pv.fit()
+    v = pv.infer_vector("the king and the queen", epochs=30)
+    print("paragraph-vectors inferred vector norm:",
+          round(float((v ** 2).sum()) ** 0.5, 4))
+
+
+if __name__ == "__main__":
+    main()
