@@ -73,6 +73,11 @@ class Word2VecConfig:
     #: path; "pallas"/"xla" force a path ("pallas" off-TPU runs the
     #: kernel through the interpreter — test harness only)
     kernel: str = "auto"
+    #: >1 partitions pairs by center Huffman depth into that many
+    #: buckets with per-bucket sliced HS tables — shallow (frequent)
+    #: pairs skip the deep padded levels.  Exact semantics (masked
+    #: levels contribute nothing); costs one jit variant per bucket.
+    depth_buckets: int = 1
 
 
 # -- jitted training steps --------------------------------------------------
@@ -362,7 +367,8 @@ def run_pair_training(syn0, syn1, syn1neg,
                       mask_t, table, window,
                       alpha, min_alpha, use_hs,
                       negative, batch_size, kernel,
-                      seed, dev_cache=None, pairs_iter=None):
+                      seed, dev_cache=None, pairs_iter=None,
+                      hs_lengths=None, hs_weights=None, depth_buckets=1):
     """The shared scanned-epoch training engine (Word2Vec AND
     ParagraphVectors fit through here).
 
@@ -409,6 +415,41 @@ def run_pair_training(syn0, syn1, syn1neg,
     total = max(1, total_words * epochs)
     nkey = jax.random.key(seed + 1)
 
+    # -- depth buckets (opt-in): the HS level loop is static in L, so
+    # every pair pays the vocabulary's MAX Huffman depth even though
+    # zipf makes most centers shallow.  Bucketing pairs by center depth
+    # and slicing the HS tables per bucket trains shallow pairs with a
+    # short loop — exactly (levels beyond a pair's depth are masked
+    # zeros, so dropping them changes nothing but chunk grouping).
+    n_buckets = max(1, depth_buckets) if (use_hs and hs_lengths is not None
+                                          ) else 1
+    if n_buckets > 1:
+        hs_len = np.asarray(hs_lengths)
+        full_l = int(codes_t.shape[1])
+        # pair-weighted boundaries: word count is the center-frequency
+        # proxy (pairs per center scale with its occurrences)
+        w = (np.asarray(hs_weights, np.float64)
+             if hs_weights is not None else np.ones_like(hs_len, float))
+        order = np.argsort(hs_len)
+        cw = np.cumsum(w[order])
+        cw /= cw[-1]
+        qs = [hs_len[order][np.searchsorted(cw, i / n_buckets)]
+              for i in range(1, n_buckets)]
+        bounds = sorted(set(int(q) for q in qs) | {full_l})
+        bounds = [b for b in bounds if b > 0]
+        bucket_l = bounds                       # max depth per bucket
+        tables = [(codes_t, points_t, mask_t) if lb == full_l else
+                  (codes_t[:, :lb], points_t[:, :lb], mask_t[:, :lb])
+                  for lb in bucket_l]
+
+        def bucket_of(cen):
+            return np.searchsorted(np.asarray(bucket_l),
+                                   hs_len[cen], side="left")
+    else:
+        bucket_l = [int(codes_t.shape[1])]
+        tables = [(codes_t, points_t, mask_t)]
+        bucket_of = None
+
     def prep_slab(blk, resident):
         cen, ctx, cpos, dlt, woff = blk
         P = cen.size
@@ -427,14 +468,15 @@ def run_pair_training(syn0, syn1, syn1neg,
         return (ch(cen), ch(ctx), ch(cpos), ch(dlt),
                 jnp.asarray(woff[::B].copy()), jnp.asarray(n_real))
 
-    def dispatch(slab, cid0, epoch, state):
+    def dispatch(slab, cid0, bidx, epoch, state):
         syn0, syn1, neg_tab = state
         cen_d, ctx_d, cpos_d, dlt_d, woff_d, n_real = slab
         NC = n_real.shape[0]
         cids = jnp.arange(cid0, cid0 + NC, dtype=jnp.int32)
+        c_t, p_t, m_t = tables[bidx]
         return _scan_slab(
             syn0, syn1, neg_tab, cen_d, ctx_d, cpos_d, dlt_d,
-            woff_d, cids, n_real, codes_t, points_t, mask_t, table,
+            woff_d, cids, n_real, c_t, p_t, m_t, table,
             nkey, jnp.int32(epoch), jnp.float32(total_words),
             jnp.float32(total), jnp.float32(alpha),
             jnp.float32(min_alpha),
@@ -442,6 +484,12 @@ def run_pair_training(syn0, syn1, syn1neg,
             pallas_block=pallas_block, pallas_interpret=pallas_interpret)
 
     state = (syn0, syn1, neg_tab)
+    if dev_cache is not None and dev_cache["bucket_l"] != bucket_l:
+        raise ValueError(
+            f"cached pair slabs were built for depth buckets "
+            f"{dev_cache['bucket_l']} but the config now implies "
+            f"{bucket_l}; refit with a fresh instance (or keep "
+            f"depth_buckets stable across fits)")
     if dev_cache is None:
         if pairs_iter is None:
             if pairs is None:
@@ -455,24 +503,65 @@ def run_pair_training(syn0, syn1, syn1neg,
             pairs_iter = _slices()
         # epoch 0 streams: prep slab k+1 on host while the device (async
         # dispatch) trains slab k; prepared slabs are cached for replay
-        dev_cache = []
+        dev_cache = {"bucket_l": bucket_l, "slabs": []}
+        slabs = dev_cache["slabs"]
         seen_pairs = 0
         cid0 = 0
+        # per-bucket carry buffers so every bucket emits uniform
+        # PAIRS_PER_SLAB slabs (one jit variant per bucket)
+        bufs: List[List[Tuple[np.ndarray, ...]]] = \
+            [[] for _ in range(len(bucket_l))]
+        buf_n = [0] * len(bucket_l)
+
+        def emit(bidx, blk_b, final):
+            nonlocal seen_pairs, cid0, state
+            bufs[bidx].append(blk_b)
+            buf_n[bidx] += blk_b[0].size
+            while buf_n[bidx] >= PAIRS_PER_SLAB or (final and buf_n[bidx]):
+                cat = tuple(np.concatenate([b[k] for b in bufs[bidx]])
+                            for k in range(5))
+                take = min(PAIRS_PER_SLAB, cat[0].size)
+                part = tuple(a[:take] for a in cat)
+                bufs[bidx] = [tuple(a[take:] for a in cat)]
+                buf_n[bidx] -= take
+                resident = seen_pairs + take <= RESIDENT_PAIR_CAP
+                slab = prep_slab(part, resident)
+                state = dispatch(slab, cid0, bidx, 0, state)
+                slabs.append((slab, cid0, bidx))
+                seen_pairs += take
+                cid0 += slab[5].shape[0]
+                if final and buf_n[bidx] == 0:
+                    break
+
+        empty = tuple(np.empty(0, np.int32) for _ in range(4)) + (
+            np.empty(0, np.float32),)
         for blk in pairs_iter:
             if blk[0].size == 0:
                 continue
-            resident = seen_pairs + blk[0].size <= RESIDENT_PAIR_CAP
-            slab = prep_slab(blk, resident)
-            state = dispatch(slab, cid0, 0, state)
-            dev_cache.append((slab, cid0))
-            seen_pairs += blk[0].size
-            cid0 += slab[5].shape[0]
+            if len(bucket_l) == 1:
+                # already exact-size slabs: dispatch directly, no rebuffer
+                resident = seen_pairs + blk[0].size <= RESIDENT_PAIR_CAP
+                slab = prep_slab(blk, resident)
+                state = dispatch(slab, cid0, 0, 0, state)
+                slabs.append((slab, cid0, 0))
+                seen_pairs += blk[0].size
+                cid0 += slab[5].shape[0]
+            else:
+                which = bucket_of(blk[0])
+                for bidx in range(len(bucket_l)):
+                    sel = which == bidx
+                    if sel.any():
+                        emit(bidx, tuple(a[sel] for a in blk),
+                             final=False)
+        for bidx in range(len(bucket_l)):
+            if buf_n[bidx]:
+                emit(bidx, empty, final=True)
         first_epoch = 1
     else:
         first_epoch = 0
     for epoch in range(first_epoch, epochs):
-        for slab, cid0 in dev_cache:
-            state = dispatch(slab, cid0, epoch, state)
+        for slab, cid0, bidx in dev_cache["slabs"]:
+            state = dispatch(slab, cid0, bidx, epoch, state)
     syn0, syn1, neg_tab = state
     return (syn0, syn1,
             neg_tab if syn1neg is not None else None, dev_cache)
@@ -556,6 +645,8 @@ class Word2Vec:
         codes_t = jnp.asarray(codes_np)
         points_t = jnp.asarray(points_np)
         table = jnp.asarray(unigram_table(self.cache, cfg.table_size))
+        counts = np.asarray([self.cache.vocab[w].count
+                             for w in self.cache.index], np.float64)
 
         if cfg.negative > 0 and self.syn1neg is None:
             raise ValueError(
@@ -589,7 +680,10 @@ class Word2Vec:
                 min_alpha=cfg.min_alpha, use_hs=cfg.use_hs,
                 negative=cfg.negative, batch_size=cfg.batch_size,
                 kernel=cfg.kernel, seed=cfg.seed,
-                dev_cache=self._dev_cache, pairs_iter=pairs_iter)
+                dev_cache=self._dev_cache, pairs_iter=pairs_iter,
+                hs_lengths=np.asarray(lengths_t),
+                hs_weights=counts,
+                depth_buckets=cfg.depth_buckets)
         self._wv = WordVectors(self.cache, self.syn0)
         return self._wv
 

@@ -297,13 +297,31 @@ def test_word2vec_multi_slab_streaming_and_replay(monkeypatch):
                          use_hs=True, batch_size=512, seed=5)
     w2v = Word2Vec(CORPUS, cfg)
     wv = w2v.fit()
-    assert len(w2v._dev_cache) >= 3          # really multi-slab
+    assert len(w2v._dev_cache["slabs"]) >= 3     # really multi-slab
     # at least one slab beyond the cap stayed host-side numpy
     assert any(isinstance(slab[0], np.ndarray)
-               for slab, _ in w2v._dev_cache)
+               for slab, _, _ in w2v._dev_cache["slabs"])
     assert np.isfinite(np.asarray(wv.vectors)).all()
     # replayed fit (cached slabs): same seed + same pair schedule must
     # REPRODUCE the run bit-for-bit — streaming is deterministic
     first = np.asarray(wv.vectors).copy()
     wv2 = w2v.fit()
     np.testing.assert_array_equal(np.asarray(wv2.vectors), first)
+
+
+def test_word2vec_depth_buckets_semantics():
+    """depth_buckets>1 slices the HS tables per center-depth bucket —
+    exact semantics (masked levels are zeros), so convergence quality
+    matches the single-bucket run."""
+    base = dict(vector_size=48, window=3, epochs=30, alpha=0.05,
+                batch_size=128, negative=5, use_hs=True, seed=3)
+    wv1 = Word2Vec(CORPUS, Word2VecConfig(**base)).fit()
+    w2 = Word2Vec(CORPUS, Word2VecConfig(**base, depth_buckets=3))
+    wv2 = w2.fit()
+    # bucketing really happened (regression guard on the boundary math)
+    assert len({b for _, _, b in w2._dev_cache["slabs"]}) > 1
+    for wv in (wv1, wv2):
+        assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")
+        assert wv.similarity("king", "queen") > wv.similarity("king",
+                                                              "mouse")
+    assert np.isfinite(np.asarray(wv2.vectors)).all()
