@@ -513,23 +513,30 @@ def run_pair_training(syn0, syn1, syn1neg,
             [[] for _ in range(len(bucket_l))]
         buf_n = [0] * len(bucket_l)
 
-        def emit(bidx, blk_b, final):
+        def record(part, bidx):
+            """Prep, dispatch (epoch 0) and cache one slab — the single
+            accounting path for both the direct and bucketed branches."""
             nonlocal seen_pairs, cid0, state
+            resident = seen_pairs + part[0].size <= RESIDENT_PAIR_CAP
+            slab = prep_slab(part, resident)
+            state = dispatch(slab, cid0, bidx, 0, state)
+            slabs.append((slab, cid0, bidx))
+            seen_pairs += part[0].size
+            cid0 += slab[5].shape[0]
+
+        def emit(bidx, blk_b, final):
+            # NOTE: bucketed mode re-buffers blocks that corpus_pairs_slabs
+            # already sized — one extra host memcpy per slab, accepted for
+            # the opt-in path (it overlaps the async device dispatches)
             bufs[bidx].append(blk_b)
             buf_n[bidx] += blk_b[0].size
             while buf_n[bidx] >= PAIRS_PER_SLAB or (final and buf_n[bidx]):
                 cat = tuple(np.concatenate([b[k] for b in bufs[bidx]])
                             for k in range(5))
                 take = min(PAIRS_PER_SLAB, cat[0].size)
-                part = tuple(a[:take] for a in cat)
                 bufs[bidx] = [tuple(a[take:] for a in cat)]
                 buf_n[bidx] -= take
-                resident = seen_pairs + take <= RESIDENT_PAIR_CAP
-                slab = prep_slab(part, resident)
-                state = dispatch(slab, cid0, bidx, 0, state)
-                slabs.append((slab, cid0, bidx))
-                seen_pairs += take
-                cid0 += slab[5].shape[0]
+                record(tuple(a[:take] for a in cat), bidx)
                 if final and buf_n[bidx] == 0:
                     break
 
@@ -540,12 +547,7 @@ def run_pair_training(syn0, syn1, syn1neg,
                 continue
             if len(bucket_l) == 1:
                 # already exact-size slabs: dispatch directly, no rebuffer
-                resident = seen_pairs + blk[0].size <= RESIDENT_PAIR_CAP
-                slab = prep_slab(blk, resident)
-                state = dispatch(slab, cid0, 0, 0, state)
-                slabs.append((slab, cid0, 0))
-                seen_pairs += blk[0].size
-                cid0 += slab[5].shape[0]
+                record(blk, 0)
             else:
                 which = bucket_of(blk[0])
                 for bidx in range(len(bucket_l)):
