@@ -93,6 +93,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dl4j_diskqueue_size.argtypes = [ctypes.c_void_p]
     lib.dl4j_diskqueue_destroy.restype = None
     lib.dl4j_diskqueue_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dl4j_pnm_info.restype = ctypes.c_int
+    lib.dl4j_pnm_info.argtypes = [_u8p, ctypes.c_long, _longp, _longp]
+    lib.dl4j_pnm_decode.restype = ctypes.c_int
+    lib.dl4j_pnm_decode.argtypes = [_u8p, ctypes.c_long, _f32p]
+    lib.dl4j_resize_nearest.restype = None
+    lib.dl4j_resize_nearest.argtypes = [_f32p, ctypes.c_long,
+                                        ctypes.c_long, _f32p,
+                                        ctypes.c_long]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -109,6 +117,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib
+        except AttributeError:
+            # stale prebuilt library missing newer symbols: rebuild once
+            # and retry; degrade to the Python paths rather than crash
+            log.warning("native library is stale (missing symbols); "
+                        "rebuilding")
+            try:
+                if _build():
+                    lib = ctypes.CDLL(_LIB_PATH)
+                    _declare(lib)
+                    _lib = lib
+                else:
+                    _lib_failed = True
+            except (OSError, AttributeError) as e:
+                log.warning("native library rebuild failed (%s)", e)
+                _lib_failed = True
         except OSError as e:
             log.warning("native library load failed (%s)", e)
             _lib_failed = True
@@ -195,6 +218,45 @@ def parse_csv(path: str, sep: str = ",",
 # ---------------------------------------------------------------------------
 # threaded batch assembler
 # ---------------------------------------------------------------------------
+
+def decode_pnm(data: bytes) -> Optional[np.ndarray]:
+    """Native PNM -> grayscale float32 [H, W] in [0, 1]; None when the
+    library is unavailable or the buffer is not PNM (callers fall back
+    to the Python decoder in utils/image.py)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    w = ctypes.c_long()
+    h = ctypes.c_long()
+    if lib.dl4j_pnm_info(buf.ctypes.data_as(_u8p), buf.size,
+                         ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    channels = 3 if data[1:2] in (b"3", b"6") else 1
+    # untrusted header: a sample needs >= 1 byte (binary) / >= 2 bytes
+    # (ascii), so dims implying more pixels than the buffer could hold
+    # are corrupt — refuse BEFORE allocating h*w floats
+    if w.value * h.value * channels > buf.size:
+        return None
+    out = np.empty((h.value, w.value), np.float32)
+    if lib.dl4j_pnm_decode(buf.ctypes.data_as(_u8p), buf.size,
+                           out.ctypes.data_as(_f32p)) != 0:
+        return None
+    return out
+
+
+def resize_nearest(img: np.ndarray, size: int) -> Optional[np.ndarray]:
+    """Native nearest-neighbour resize to [size, size]; None without the
+    library."""
+    lib = get_lib()
+    if lib is None or img.shape[0] == 0 or img.shape[1] == 0 or size <= 0:
+        return None
+    img = np.ascontiguousarray(img, np.float32)
+    out = np.empty((size, size), np.float32)
+    lib.dl4j_resize_nearest(img.ctypes.data_as(_f32p), img.shape[0],
+                            img.shape[1], out.ctypes.data_as(_f32p), size)
+    return out
+
 
 class NativeBatcher:
     """Shuffled minibatch stream assembled by a native producer thread.

@@ -17,6 +17,11 @@ import numpy as np
 def _read_pnm(path: str) -> np.ndarray:
     with open(path, "rb") as f:
         data = f.read()
+    # native C++ decoder first (runtime/native.py); exact same output
+    from deeplearning4j_tpu.runtime import native as _native
+    img = _native.decode_pnm(data)
+    if img is not None:
+        return img
     header = re.match(rb"(P[2356])\s+(?:#.*\s+)?(\d+)\s+(\d+)\s+(\d+)\s", data)
     if not header:
         raise ValueError(f"{path}: not a PNM file")
@@ -57,6 +62,10 @@ def load_image(path: str, size: Optional[int] = None) -> np.ndarray:
 
 
 def _resize_nearest(img: np.ndarray, size: int) -> np.ndarray:
+    from deeplearning4j_tpu.runtime import native as _native
+    out = _native.resize_nearest(img, size)
+    if out is not None:
+        return out
     h, w = img.shape
     ys = (np.arange(size) * h / size).astype(int).clip(0, h - 1)
     xs = (np.arange(size) * w / size).astype(int).clip(0, w - 1)

@@ -25,6 +25,110 @@
 extern "C" {
 
 // ---------------------------------------------------------------------------
+// PNM image decode + nearest resize — the native side of the image
+// vectorization path (Canova image readers / util/ImageLoader parity).
+// Grayscale float32 in [0,1]; P2/P3 (ascii) and P5/P6 (binary) supported.
+// ---------------------------------------------------------------------------
+
+static int pnm_skip_ws(const unsigned char* d, long n, long* i) {
+  while (*i < n) {
+    unsigned char c = d[*i];
+    if (c == '#') {                     // comment to end of line
+      while (*i < n && d[*i] != '\n') ++(*i);
+    } else if (c == ' ' || c == '\t' || c == '\r' || c == '\n') {
+      ++(*i);
+    } else {
+      return 1;
+    }
+  }
+  return 0;
+}
+
+static long pnm_read_int(const unsigned char* d, long n, long* i) {
+  if (!pnm_skip_ws(d, n, i)) return -1;
+  long v = 0;
+  int any = 0;
+  while (*i < n && d[*i] >= '0' && d[*i] <= '9') {
+    v = v * 10 + (d[*i] - '0');
+    ++(*i);
+    any = 1;
+  }
+  return any ? v : -1;
+}
+
+// Parse header only: returns 0 on success, fills (w, h).
+int dl4j_pnm_info(const unsigned char* data, long n, long* w, long* h) {
+  if (n < 2 || data[0] != 'P') return -1;
+  char kind = (char)data[1];
+  if (kind != '2' && kind != '3' && kind != '5' && kind != '6') return -1;
+  long i = 2;
+  long ww = pnm_read_int(data, n, &i);
+  long hh = pnm_read_int(data, n, &i);
+  if (ww <= 0 || hh <= 0) return -2;
+  *w = ww;
+  *h = hh;
+  return 0;
+}
+
+// Decode to grayscale float32 [h*w] in [0,1] (RGB averaged).
+// Returns 0 on success.
+int dl4j_pnm_decode(const unsigned char* data, long n, float* out) {
+  if (n < 2 || data[0] != 'P') return -1;
+  char kind = (char)data[1];
+  int channels = (kind == '3' || kind == '6') ? 3 : 1;
+  int binary = (kind == '5' || kind == '6');
+  if (kind != '2' && kind != '3' && !binary) return -1;
+  long i = 2;
+  long w = pnm_read_int(data, n, &i);
+  long h = pnm_read_int(data, n, &i);
+  long maxval = pnm_read_int(data, n, &i);
+  // >8-bit samples (maxval > 255) use 2-byte big-endian words in binary
+  // PNM — unsupported here; error out rather than decode garbage
+  if (w <= 0 || h <= 0 || maxval <= 0 || maxval > 255) return -2;
+  long count = w * h * channels;
+  float inv = 1.0f / (float)maxval;
+  if (binary) {
+    ++i;                                 // single whitespace after maxval
+    if (n - i < count) return -3;
+    const unsigned char* p = data + i;
+    for (long px = 0; px < w * h; ++px) {
+      if (channels == 1) {
+        out[px] = p[px] * inv;
+      } else {
+        long b = px * 3;
+        out[px] = (p[b] + p[b + 1] + p[b + 2]) * inv / 3.0f;
+      }
+    }
+  } else {
+    for (long px = 0; px < w * h; ++px) {
+      float acc = 0.0f;
+      for (int c = 0; c < channels; ++c) {
+        long v = pnm_read_int(data, n, &i);
+        if (v < 0) return -3;
+        acc += (float)v;
+      }
+      out[px] = acc * inv / (float)channels;
+    }
+  }
+  return 0;
+}
+
+// Nearest-neighbour resize [h,w] -> [size,size] (matches the Python
+// _resize_nearest index math exactly: floor(i*h/size) clipped).
+void dl4j_resize_nearest(const float* img, long h, long w,
+                         float* out, long size) {
+  for (long y = 0; y < size; ++y) {
+    long sy = (long)((double)y * h / size);
+    if (sy > h - 1) sy = h - 1;
+    for (long x = 0; x < size; ++x) {
+      long sx = (long)((double)x * w / size);
+      if (sx > w - 1) sx = w - 1;
+      out[y * size + x] = img[sy * w + sx];
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
 // idx (MNIST) parsing — MnistDbFile/MnistImageFile/MnistLabelFile parity
 // ---------------------------------------------------------------------------
 
