@@ -313,8 +313,9 @@ class DistributedRunner:
                  aggregator: JobAggregator,
                  n_workers: int = 2,
                  router_cls=IterativeReduceWorkRouter,
-                 poll_interval_s: float = 0.005):
-        self.tracker = StateTracker()
+                 poll_interval_s: float = 0.005,
+                 max_job_retries: int = 5):
+        self.tracker = StateTracker(max_job_retries=max_job_retries)
         self.update_saver = UpdateSaver()
         self.jobs = job_iterator
         self.performer_factory = performer_factory

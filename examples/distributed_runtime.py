@@ -27,7 +27,7 @@ def main() -> None:
         so.CollectionJobIterator(list(SENTENCES)),
         chaos_factory(WordCountPerformer, p_fail=0.25, seed=7),
         WordCountAggregator(), n_workers=3,
-        router_cls=so.HogWildWorkRouter)
+        router_cls=so.HogWildWorkRouter, max_job_retries=100)
     chaotic = runner.run(timeout_s=60.0)
     print("with 25% injected crashes: identical result ->",
           chaotic == counts)

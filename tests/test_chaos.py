@@ -50,13 +50,18 @@ def test_chaos_schedule_is_deterministic():
 
 def test_runner_completes_under_injected_crashes():
     """20 jobs, 25% injected crash rate: the requeue machinery must still
-    deliver every job's contribution exactly once."""
+    deliver every job's contribution exactly once.  Job->worker
+    assignment is timing-dependent, so a crash-prone worker can draw the
+    same requeued job repeatedly — the retry budget is raised to make
+    full completion deterministic (the default budget's drop-after-N
+    path is covered by the dropped-work accounting in coordinator
+    tests)."""
     shards = [[i, i + 1] for i in range(0, 40, 2)]
     runner = so.DistributedRunner(
         so.CollectionJobIterator(shards),
         chaos_factory(SumPerformer, p_fail=0.25, seed=3),
         SumAggregator(), n_workers=3,
-        router_cls=so.HogWildWorkRouter)
+        router_cls=so.HogWildWorkRouter, max_job_retries=100)
     total = runner.run(timeout_s=60.0)
     assert total == sum(sum(s) for s in shards)
     assert runner.tracker.count("jobs_dropped") == 0
