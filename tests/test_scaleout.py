@@ -257,8 +257,7 @@ def test_poisoned_job_dropped_after_retry_cap():
     runner = so.DistributedRunner(
         so.CollectionJobIterator([1.0, 13.0, 3.0]),
         PoisonPerformer, MeanAggregator(), n_workers=2,
-        router_cls=so.HogWildWorkRouter)
-    runner.tracker.max_job_retries = 3
+        router_cls=so.HogWildWorkRouter, max_job_retries=3)
     result = runner.run(timeout_s=30)
     assert result == pytest.approx((2.0 + 6.0) / 2)
     assert runner.tracker.count("jobs_done") == 2
