@@ -8,21 +8,21 @@ unigram table; lr decay by words seen).
 
 TPU-native redesign — the reference's kernel is per-word BLAS-1 axpy on
 small vectors, the worst possible TPU shape (SURVEY.md "hard parts": sparse
-embedding updates).  Here the whole minibatch of (center, context) pairs is
-trained in ONE jitted program:
+embedding updates).  Here whole [B]-pair chunks train inside one jitted
+scan:
 
-- gather the padded Huffman tables (vocab.encode_hs_tables) for the batch:
-  codes/points [B, L] + mask;
-- one [B, D] x [B, L, D] einsum computes every HS dot in the batch on the
-  MXU; sigmoid, g, and the two rank-1 update families become dense batched
-  ops;
-- parameter updates are scatter-adds (``.at[].add``) into syn0/syn1 —
-  XLA lowers these to efficient TPU scatters;
-- negative sampling draws [B, K] negatives on device from the unigram
-  table and trains syn1neg the same way;
+- the padded Huffman tables (vocab.encode_hs_tables) are gathered per
+  chunk: codes/points [B, L] + mask; negative sampling draws [B, K]
+  negatives on device from the unigram table;
+- on TPU with a VMEM-sized vocabulary, the chunk update runs through the
+  fused Pallas kernel (ops/pallas_word2vec): tables stay resident in
+  VMEM and every row gather/scatter is a one-hot matmul on the MXU;
+- otherwise the XLA path batches the math as einsums + count-normalized
+  scatter-adds into syn0/syn1/syn1neg;
 - the LR schedule (linear decay by words seen, min 1e-4 floor —
-  Word2Vec.java trainSentence) is computed per batch and passed as a
-  scalar.
+  Word2Vec.java trainSentence) is an on-device per-chunk clock, and
+  ``depth_buckets`` optionally partitions pairs by center Huffman depth
+  so frequent (shallow) centers skip padded levels.
 
 Pair generation stays on host but runs ONCE per corpus: full-window
 candidate pairs are built in slabs that STREAM into epoch 0's async
