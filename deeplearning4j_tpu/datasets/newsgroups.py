@@ -17,7 +17,7 @@ label-correlated vocabulary is generated so every downstream consumer
 from __future__ import annotations
 
 import os
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
