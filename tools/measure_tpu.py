@@ -1,0 +1,50 @@
+"""One-shot TPU measurement sweep — run when the axon tunnel is healthy.
+
+Runs the headline benches in sequence in separate processes (the tunnel
+serializes device access) and prints one JSON line per config plus a
+word2vec depth-bucket A/B. Usage:  python tools/measure_tpu.py
+"""
+import json
+import subprocess
+import sys
+
+REPO = __file__.rsplit("/", 2)[0]
+
+AB_SNIPPET = r'''
+import time, numpy as np, sys
+sys.path.insert(0, "%s")
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig
+rng = np.random.RandomState(0)
+words = [f"w{i}" for i in range(2000)]
+p = 1.0 / np.arange(1, 2001) ** 1.05; p /= p.sum()
+sents = [" ".join(rng.choice(words, p=p, size=30)) for _ in range(1600)]
+for db in (1, 2, 3):
+    cfg = Word2VecConfig(vector_size=100, window=5, epochs=2, negative=5,
+                         use_hs=True, batch_size=16384, depth_buckets=db)
+    w = Word2Vec(sents, cfg); w.fit()
+    float(np.asarray(w.syn0).ravel()[0])
+    cold = Word2Vec(sents, cfg, cache=w.cache)
+    t0 = time.perf_counter(); cold.fit()
+    float(np.asarray(cold.syn0).ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f'{{"metric": "w2v_depth_buckets_{db}", '
+          f'"words_per_sec": {96000 / dt:.0f}}}')
+''' % REPO
+
+
+def main() -> None:
+    for cfg in ("probe", "bert", "resnet", "word2vec", "longctx", "lenet"):
+        r = subprocess.run(
+            [sys.executable, f"{REPO}/bench.py", cfg],
+            capture_output=True, text=True, timeout=1800)
+        line = [l for l in r.stdout.splitlines() if l.startswith("{")]
+        print(line[-1] if line else json.dumps(
+            {"config": cfg, "error": r.stderr[-200:]}))
+    r = subprocess.run([sys.executable, "-c", AB_SNIPPET],
+                       capture_output=True, text=True, timeout=1800)
+    print(r.stdout.strip() or json.dumps({"ab": "failed",
+                                          "err": r.stderr[-200:]}))
+
+
+if __name__ == "__main__":
+    main()
