@@ -45,6 +45,10 @@ class GloveConfig:
     batch_size: int = 4096
     symmetric: bool = True
     seed: int = 13
+    #: "auto" uses the VMEM-resident Pallas kernel on TPU when the
+    #: tables fit (ops/pallas_glove); "pallas"/"xla" force a path
+    #: ("pallas" off-TPU runs through the interpreter — tests)
+    kernel: str = "auto"
 
 
 def count_cooccurrences(sentences: Iterable[str], tokenizer,
@@ -141,11 +145,13 @@ def _glove_update(state, rows: Array, cols: Array, x: Array, mask: Array,
 
 
 @partial(jax.jit, donate_argnums=(0,),
-         static_argnames=("x_max", "power", "n_chunks", "batch"))
+         static_argnames=("x_max", "power", "n_chunks", "batch",
+                          "pallas_block", "pallas_interpret"))
 def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
                       mask: Array, key: Array, epoch: Array, alpha: Array,
                       *, x_max: float, power: float, n_chunks: int,
-                      batch: int):
+                      batch: int, pallas_block: int = 0,
+                      pallas_interpret: bool = False):
     """One dispatch per EPOCH: on-device shuffle of the COO triples
     (Glove.java's per-epoch example shuffle) + ``lax.scan`` over fixed
     [batch] chunks.  The eager per-chunk loop paid one 15-20 ms tunnel
@@ -154,15 +160,53 @@ def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
     perm = jax.random.permutation(jax.random.fold_in(key, epoch),
                                   rows.shape[0])
 
-    def body(st, i):
-        idx = jax.lax.dynamic_slice(perm, (i * batch,), (batch,))
-        m = mask[idx]
-        st, loss = _glove_update(st, rows[idx], cols[idx], x[idx], m,
-                                 alpha, x_max, power)
-        return st, (loss, jnp.sum(m))
+    if pallas_block > 0:
+        from deeplearning4j_tpu.ops.pallas_glove import (apply_chunk,
+                                                         fused_glove_chunk)
+        # carry the EXTENDED layout across the epoch: wext = (w|b|1),
+        # wtext = (wt|1|bt), gsq packed (gw|gb)/(gwt|gbt) — built once
+        # here and split back once after the scan, not per chunk
+        w, wt, b, bt, gw, gwt, gb, gbt = state
+        V, D = w.shape
+        ones = jnp.ones((V, 1), jnp.float32)
+        ext = (jnp.concatenate([w, b[:, None], ones], axis=1),
+               jnp.concatenate([wt, ones, bt[:, None]], axis=1),
+               jnp.concatenate([gw, gb[:, None]], axis=1),
+               jnp.concatenate([gwt, gbt[:, None]], axis=1))
 
-    state, (losses, cnts) = jax.lax.scan(body, state,
-                                         jnp.arange(n_chunks))
+        def body(st, i):
+            wext, wtext, gext, gtext = st
+            idx = jax.lax.dynamic_slice(perm, (i * batch,), (batch,))
+            m = mask[idx]
+            accw, accwt, ls = fused_glove_chunk(
+                wext, wtext, rows[idx], cols[idx], x[idx], m,
+                x_max=x_max, power=power, block=pallas_block,
+                interpret=pallas_interpret)
+            wb, gext = apply_chunk(wext[:, :D + 1], gext, accw, alpha)
+            wtb, gtext = apply_chunk(
+                jnp.concatenate([wtext[:, :D], wtext[:, D + 1:]],
+                                axis=1), gtext, accwt, alpha)
+            wext = jnp.concatenate([wb, ones], axis=1)
+            wtext = jnp.concatenate([wtb[:, :D], ones, wtb[:, D:]],
+                                    axis=1)
+            loss = ls[0, 0] / jnp.maximum(ls[0, 1], 1.0)
+            return (wext, wtext, gext, gtext), (loss, ls[0, 1])
+
+        ext, (losses, cnts) = jax.lax.scan(body, ext,
+                                           jnp.arange(n_chunks))
+        wext, wtext, gext, gtext = ext
+        state = (wext[:, :D], wtext[:, :D], wext[:, D], wtext[:, D + 1],
+                 gext[:, :D], gtext[:, :D], gext[:, D], gtext[:, D])
+    else:
+        def body(st, i):
+            idx = jax.lax.dynamic_slice(perm, (i * batch,), (batch,))
+            m = mask[idx]
+            st, loss = _glove_update(st, rows[idx], cols[idx], x[idx],
+                                     m, alpha, x_max, power)
+            return st, (loss, jnp.sum(m))
+
+        state, (losses, cnts) = jax.lax.scan(body, state,
+                                             jnp.arange(n_chunks))
     # count-weighted mean: chunk counts vary under the shuffle (and
     # whole chunks can be padding when n_chunks is bucketed up)
     mean = jnp.sum(losses * cnts) / jnp.maximum(jnp.sum(cnts), 1.0)
@@ -240,13 +284,22 @@ class Glove:
         rows_d, cols_d = jnp.asarray(rows), jnp.asarray(cols)
         x_d = jnp.asarray(x)
         mask_d = jnp.asarray(np.arange(NC * B) < P, jnp.float32)
+        from deeplearning4j_tpu.ops.kernel_select import resolve_kernel
+        from deeplearning4j_tpu.ops.pallas_glove import choose_block
+        platform = jax.devices()[0].platform
+        pallas_block, pallas_interpret = resolve_kernel(
+            cfg.kernel,
+            choose_block(V, D, B, interpret=platform != "tpu"),
+            f"glove vocab {V} x dim {D} (batch {B})")
         key = jax.random.key(cfg.seed)
         alpha = jnp.float32(cfg.alpha)
         for epoch in range(cfg.epochs):
             state, loss = _glove_scan_epoch(
                 state, rows_d, cols_d, x_d, mask_d, key,
                 jnp.int32(epoch), alpha, x_max=cfg.x_max,
-                power=cfg.weight_power, n_chunks=NC, batch=B)
+                power=cfg.weight_power, n_chunks=NC, batch=B,
+                pallas_block=pallas_block,
+                pallas_interpret=pallas_interpret)
             self.losses.append(float(loss))
         self.state = state
         w, wt = state[0], state[1]

@@ -385,9 +385,6 @@ def run_pair_training(syn0, syn1, syn1neg,
     globally-unique chunk ids (negative-sample draws never repeat within
     an epoch).  Returns ``(syn0, syn1, syn1neg, dev_cache)`` — thread
     ``dev_cache`` back in to replay the prepared slabs on later fits."""
-    if kernel not in ("auto", "pallas", "xla"):
-        raise ValueError(
-            f"kernel must be 'auto', 'pallas' or 'xla', got {kernel!r}")
     B = batch_size
     neg_tab = (syn1neg if syn1neg is not None
                else jnp.zeros((1, 1), jnp.float32))
@@ -395,20 +392,14 @@ def run_pair_training(syn0, syn1, syn1neg,
     # kernel selection: VMEM-resident Pallas kernel on TPU whenever the
     # tables fit (2.7x the XLA path on v5e at bench shapes);
     # kernel="pallas" forces it (via the interpreter off-TPU: tests)
-    pallas_block, pallas_interpret = 0, False
-    if kernel != "xla":
-        from deeplearning4j_tpu.ops.pallas_word2vec import choose_block
-        platform = jax.devices()[0].platform
-        blk = choose_block(vocab_size, dim, negative, B,
-                           interpret=platform != "tpu")
-        if blk and (platform == "tpu" or kernel == "pallas"):
-            pallas_block = blk
-            pallas_interpret = platform != "tpu"
-        elif kernel == "pallas":
-            raise ValueError(
-                f"kernel='pallas' but vocab {vocab_size} x dim {dim} "
-                f"exceeds the VMEM-resident budget (or batch_size {B} "
-                f"not divisible by the block)")
+    from deeplearning4j_tpu.ops.kernel_select import resolve_kernel
+    from deeplearning4j_tpu.ops.pallas_word2vec import choose_block
+    platform = jax.devices()[0].platform
+    pallas_block, pallas_interpret = resolve_kernel(
+        kernel,
+        choose_block(vocab_size, dim, negative, B,
+                     interpret=platform != "tpu"),
+        f"word2vec vocab {vocab_size} x dim {dim} (batch {B})")
 
     if epochs <= 0:
         return syn0, syn1, syn1neg, dev_cache

@@ -1,0 +1,38 @@
+"""Shared Pallas-vs-XLA kernel selection for the NLP trainers.
+
+Word2Vec and GloVe both auto-select a VMEM-resident Pallas kernel on TPU
+when their tables fit, fall back to the XLA gather/scatter path
+otherwise, and honor a forced ``kernel=`` config value ("pallas" off-TPU
+runs through the interpreter — the test harness).  This is the one copy
+of that policy.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+KERNELS = ("auto", "pallas", "xla")
+
+
+def resolve_kernel(kernel: str, block: int, desc: str
+                   ) -> Tuple[int, bool]:
+    """(pallas_block, pallas_interpret) for a requested ``kernel`` mode
+    and a precomputed VMEM ``block`` (0 = doesn't fit).  Raises for
+    unknown modes and for ``kernel='pallas'`` when the budget excludes
+    it — never a silent fallback on an explicit request."""
+    if kernel not in KERNELS:
+        raise ValueError(
+            f"kernel must be one of {KERNELS}, got {kernel!r}")
+    if kernel == "xla":
+        return 0, False
+    platform = jax.devices()[0].platform
+    if block and (platform == "tpu" or kernel == "pallas"):
+        return block, platform != "tpu"
+    if kernel == "pallas":
+        raise ValueError(
+            f"kernel='pallas' but {desc} exceeds the VMEM-resident "
+            f"budget (or the batch size is not divisible by a "
+            f"supported block)")
+    return 0, False

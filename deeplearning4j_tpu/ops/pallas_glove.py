@@ -1,0 +1,170 @@
+"""Fused GloVe chunk update as a Pallas TPU kernel (small-vocab path).
+
+Reference parity: ``GloveWeightLookupTable.iterateSample`` (the
+f(X) = (X/xMax)^0.75-weighted WLS update with per-row AdaGrad).  The XLA
+path (``nlp/glove._glove_update``) batches it as gathers + einsums +
+count-normalized AdaGrad scatter-adds; like word2vec, those row
+gathers/scatters dominate chunk time on TPU.
+
+Same redesign as ``ops/pallas_word2vec``: for vocabularies whose tables
+fit in VMEM, rows move exclusively through one-hot matmuls on the MXU.
+The bias terms fold into EXTENDED tables so the whole pair score is one
+row-dot:
+
+    wext[i]  = (w[i]  | b[i] | 1)          [V, D+2]
+    wtext[j] = (wt[j] | 1 | bt[j])         [V, D+2]
+    score(i, j) = wext[i] . wtext[j] = w[i].wt[j] + b[i] + bt[j]
+
+Per side the kernel emits dense accumulators
+``(sum g*p | sum (g*p)^2 | hit count)`` over the D+1 update columns
+(weights + own bias; ``p`` = the partner's matching columns), from which
+the EXACT XLA AdaGrad semantics reconstruct outside the kernel:
+per-occurrence grads are ``g*p/k`` (k = row hits in the chunk), so
+``gsq += sum_sq / k^2`` and ``step = alpha * (sum/k) / sqrt(gsq + eps)``
+— algebraically identical to ``_glove_update.adagrad_scatter``, asserted
+to bf16 precision by tests/test_nlp_glove_pv.py in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:                                     # TPU-only compiler knobs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                      # pragma: no cover
+    pltpu = None
+
+Array = jax.Array
+
+VMEM_BUDGET_BYTES = 14 * 2 ** 20
+
+
+def choose_block(vocab: int, dim: int, batch: int,
+                 interpret: bool = False) -> int:
+    """Largest grid block for which the VMEM model fits, else 0."""
+    # 2 extended fp32 tables + bf16 casts + 2 fp32 [V, 2D+3] accumulators
+    fixed = vocab * ((dim + 2) * (2 * 4 + 2 * 2) + 2 * (2 * dim + 3) * 4)
+    for blk in (2048, 1024):
+        if batch % blk:
+            continue
+        if fixed + 2 * vocab * blk <= VMEM_BUDGET_BYTES:
+            return blk
+    if interpret and batch <= 1024:
+        return batch
+    return 0
+
+
+def _kernel(rows_ref, cols_ref, x_ref, mask_ref,
+            wext_ref, wtext_ref, accw_ref, accwt_ref, loss_ref,
+            *, x_max: float, power: float):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        accw_ref[...] = jnp.zeros_like(accw_ref)
+        accwt_ref[...] = jnp.zeros_like(accwt_ref)
+        loss_ref[...] = jnp.zeros_like(loss_ref)
+
+    bf = jnp.bfloat16
+    BLK = rows_ref.shape[0]
+    V = wext_ref.shape[0]
+    E = wext_ref.shape[1]                       # D + 2
+    D = E - 2
+
+    def one_hot_t(r):
+        iota = lax.broadcasted_iota(jnp.int32, (V, BLK), 0)
+        return (iota == r[None, :]).astype(bf)
+
+    ohr = one_hot_t(rows_ref[:])
+    ohc = one_hot_t(cols_ref[:])
+    wi = lax.dot_general(ohr, wext_ref[...].astype(bf),
+                         (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)  # [BLK, E]
+    wj = lax.dot_general(ohc, wtext_ref[...].astype(bf),
+                         (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    x = x_ref[:]
+    mask = mask_ref[:]
+    diff = jnp.sum(wi * wj, axis=1) - jnp.log(jnp.maximum(x, 1e-12))
+    fx = jnp.minimum((x / x_max) ** power, 1.0)
+    g = fx * diff * mask                                       # [BLK]
+    loss_ref[0, 0] += 0.5 * jnp.sum(fx * diff * diff * mask)
+    loss_ref[0, 1] += jnp.sum(mask)
+
+    def accumulate(acc_ref, oht, partner_cols):
+        grad = g[:, None] * partner_cols                       # [BLK, D+1]
+        payload = jnp.concatenate(
+            [grad, grad * grad, mask[:, None]], axis=1).astype(bf)
+        acc_ref[...] += lax.dot_general(
+            oht, payload, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [V, 2D+3]
+
+    # row side updates (w | b): partner columns = (wt_j | 1)
+    accumulate(accw_ref, ohr, wj[:, :D + 1])
+    # col side updates (wt | bt): partner columns = (w_i | 1)
+    accumulate(accwt_ref, ohc,
+               jnp.concatenate([wi[:, :D], wi[:, D + 1:D + 2]], axis=1))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("x_max", "power", "block", "interpret"))
+def fused_glove_chunk(wext: Array, wtext: Array, rows: Array, cols: Array,
+                      x: Array, mask: Array,
+                      *, x_max: float, power: float, block: int = 1024,
+                      interpret: bool = False):
+    """One chunk's dense gradient accumulators via the VMEM kernel.
+
+    Returns (accw, accwt, loss_sums): acc* [V, 2D+3] =
+    (grad sums [D+1] | grad-square sums [D+1] | hit count);
+    loss_sums [1, 2] = (weighted sq-err sum, mask sum).
+    """
+    B = rows.shape[0]
+    BLK = min(block, B)
+    NB = B // BLK
+    assert NB * BLK == B, f"B={B} not a multiple of block={BLK}"
+    V, E = wext.shape
+    D = E - 2
+    W = 2 * D + 3
+    accw, accwt, loss = pl.pallas_call(
+        functools.partial(_kernel, x_max=x_max, power=power),
+        grid=(NB,),
+        in_specs=[
+            pl.BlockSpec((BLK,), lambda i: (i,)),          # rows
+            pl.BlockSpec((BLK,), lambda i: (i,)),          # cols
+            pl.BlockSpec((BLK,), lambda i: (i,)),          # x
+            pl.BlockSpec((BLK,), lambda i: (i,)),          # mask
+            pl.BlockSpec((V, E), lambda i: (0, 0)),
+            pl.BlockSpec((V, E), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((V, W), lambda i: (0, 0)),
+            pl.BlockSpec((V, W), lambda i: (0, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((V, W), jnp.float32),
+            jax.ShapeDtypeStruct((V, W), jnp.float32),
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=None if (interpret or pltpu is None) else
+        pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+    )(rows, cols, x.astype(jnp.float32), mask.astype(jnp.float32),
+      wext, wtext)
+    return accw, accwt, loss
+
+
+def apply_chunk(table_b: Array, gsq_b: Array, acc: Array, alpha):
+    """Apply one side's accumulators to (weights|bias) [V, D+1] and
+    their AdaGrad state [V, D+1] — the exact scatter-path algebra:
+    gsq += sum_sq / k^2 ; step = alpha * (sum/k) / sqrt(gsq + eps)."""
+    d1 = table_b.shape[1]
+    cnt = jnp.maximum(acc[:, 2 * d1:2 * d1 + 1], 1.0)
+    grad = acc[:, :d1] / cnt
+    gsq_b = gsq_b + acc[:, d1:2 * d1] / (cnt * cnt)
+    return table_b - alpha * grad / jnp.sqrt(gsq_b + 1e-8), gsq_b

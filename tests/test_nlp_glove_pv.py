@@ -102,3 +102,72 @@ def test_paragraph_vectors_infer_vector():
     assert an > ro, (an, ro)
     # empty/unknown text -> zero vector, no crash
     assert not pv.infer_vector("zzz qqq").any()
+
+
+def test_glove_pallas_kernel_matches_xla():
+    """The VMEM-resident GloVe kernel (interpret mode) must reproduce the
+    XLA scatter path's AdaGrad chunk update to bf16 precision, biases and
+    accumulators included."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.glove import _glove_update
+    from deeplearning4j_tpu.ops.pallas_glove import (apply_chunk,
+                                                     fused_glove_chunk)
+
+    V, D, B = 64, 32, 128
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(V, D), jnp.float32) * 0.1
+    wt = jnp.asarray(rng.randn(V, D), jnp.float32) * 0.1
+    b = jnp.asarray(rng.randn(V), jnp.float32) * 0.1
+    bt = jnp.asarray(rng.randn(V), jnp.float32) * 0.1
+    gw = jnp.full((V, D), 1e-8)
+    gwt = jnp.full((V, D), 1e-8)
+    gb = jnp.full((V,), 1e-8)
+    gbt = jnp.full((V,), 1e-8)
+    rows = jnp.asarray(rng.randint(0, V, B), jnp.int32)
+    cols = jnp.asarray(rng.randint(0, V, B), jnp.int32)
+    x = jnp.asarray(rng.rand(B).astype(np.float32) * 50 + 1)
+    mask = jnp.asarray((rng.rand(B) < 0.9).astype(np.float32))
+    alpha = jnp.float32(0.05)
+
+    (rw, rwt, rb, rbt, rgw, rgwt, rgb, rgbt), _ = _glove_update(
+        (w, wt, b, bt, gw, gwt, gb, gbt), rows, cols, x, mask,
+        alpha, 100.0, 0.75)
+
+    ones = jnp.ones((V, 1), jnp.float32)
+    accw, accwt, ls = fused_glove_chunk(
+        jnp.concatenate([w, b[:, None], ones], axis=1),
+        jnp.concatenate([wt, ones, bt[:, None]], axis=1),
+        rows, cols, x, mask, x_max=100.0, power=0.75, block=64,
+        interpret=True)
+    wb, gwb = apply_chunk(jnp.concatenate([w, b[:, None]], axis=1),
+                          jnp.concatenate([gw, gb[:, None]], axis=1),
+                          accw, alpha)
+    wtb, gwtb = apply_chunk(jnp.concatenate([wt, bt[:, None]], axis=1),
+                            jnp.concatenate([gwt, gbt[:, None]], axis=1),
+                            accwt, alpha)
+    np.testing.assert_allclose(np.asarray(wb[:, :D]), np.asarray(rw),
+                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(wtb[:, :D]), np.asarray(rwt),
+                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(wb[:, D]), np.asarray(rb),
+                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(wtb[:, D]), np.asarray(rbt),
+                               atol=2e-3)
+    # gsq channels square O(1) values through bf16 matmuls: compare
+    # with a relative tolerance matched to bf16's ~0.4% mantissa
+    np.testing.assert_allclose(np.asarray(gwb[:, :D]), np.asarray(rgw),
+                               rtol=3e-2, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(gwb[:, D]), np.asarray(rgb),
+                               rtol=3e-2, atol=5e-3)
+
+
+def test_glove_pallas_path_converges():
+    corpus = ["the cat sat on the mat", "the dog sat on the rug",
+              "a cat and a dog are friends",
+              "a king and a queen wear crowns"] * 30
+    g = Glove(corpus, GloveConfig(vector_size=32, epochs=25,
+                                  batch_size=1024, kernel="pallas"))
+    wv = g.fit()
+    assert g.losses[-1] < g.losses[0] * 0.5
+    assert wv.similarity("cat", "dog") > wv.similarity("cat", "crowns")
