@@ -2,7 +2,7 @@
 
 Prints ONE JSON line: the headline metric (BERT MLM samples/sec/chip) at
 the top level plus a ``suite`` object with one entry per config
-(lenet / resnet / word2vec / longctx / scaling).  ``python bench.py <name>``
+(lenet / resnet / word2vec / glove / longctx / scaling).  ``python bench.py <name>``
 runs a single config and prints that config's line instead.
 
 Robustness contract (round-1 postmortem): the process that prints the JSON
@@ -464,14 +464,65 @@ def bench_longctx(batch_size: int = 1, seq_len: int = 8192,
     }
 
 
+def bench_glove(n_sentences: int = 1600, sent_len: int = 30,
+                vocab: int = 2000, epochs: int = 15):
+    """GloVe training throughput in co-occurrence triples/sec — the
+    scanned-epoch AdaGrad WLS fit (VMEM Pallas kernel on TPU)."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp.glove import Glove, GloveConfig
+
+    platform, kind, n_dev = _platform_info()
+    if platform == "cpu":
+        n_sentences, epochs = 120, 3
+
+    rng = np.random.RandomState(0)
+    words = [f"w{i}" for i in range(vocab)]
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.05
+    probs /= probs.sum()
+    sentences = [
+        " ".join(rng.choice(words, p=probs) for _ in range(sent_len))
+        for _ in range(n_sentences)]
+    cfg = GloveConfig(vector_size=100, epochs=epochs, batch_size=4096)
+    from deeplearning4j_tpu.nlp.glove import count_cooccurrences
+    from deeplearning4j_tpu.nlp.vocab import build_vocab
+    g = Glove(sentences, cfg)
+    # counting is a one-time corpus pass shared by warmup + measurement
+    g.cache = build_vocab(sentences, g.tokenizer, cfg.min_word_frequency)
+    triples = count_cooccurrences(sentences, g.tokenizer, g.cache,
+                                  cfg.window, cfg.symmetric)
+    g.fit(cooccurrences=triples)           # warmup: compile
+    _value_sync(g.state[0])
+    # measured: training only
+    g2 = Glove(sentences, cfg, cache=g.cache)
+    t0 = time.perf_counter()
+    g2.fit(cooccurrences=triples)
+    _value_sync(g2.state[0])
+    dt = time.perf_counter() - t0
+    n_triples = triples[0].size * epochs
+    return {
+        "metric": "glove_adagrad_wls_train_triples_per_sec",
+        "value": round(n_triples / dt, 1),
+        "unit": "triples/sec",
+        "vs_baseline": round(g2.losses[0] / max(g2.losses[-1], 1e-9), 2),
+        "platform": platform,
+        "n_devices": n_dev,
+        "unique_triples": int(triples[0].size),
+        "final_loss": round(g2.losses[-1], 4),
+        "note": "vs_baseline = loss-reduction factor (no published "
+                "reference number exists)",
+    }
+
+
 INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
-         "scaling": bench_scaling, "longctx": bench_longctx}
+         "scaling": bench_scaling, "longctx": bench_longctx,
+         "glove": bench_glove}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices)
 TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             "lenet": (600, 420), "word2vec": (600, 420),
-            "scaling": (0, 600), "longctx": (720, 420)}
+            "scaling": (0, 600), "longctx": (720, 420),
+            "glove": (600, 420)}
 
 
 # -- orchestrator -----------------------------------------------------------
@@ -548,7 +599,8 @@ def main() -> None:
     headline = run_config("bert", tpu_ok)
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
-    for name in ("lenet", "resnet", "longctx", "word2vec", "scaling"):
+    for name in ("lenet", "resnet", "longctx", "word2vec", "glove",
+                 "scaling"):
         if time.time() > budget_end:
             suite[name] = {"metric": name, "value": None,
                            "unit": "skipped", "error": "suite time budget"}

@@ -160,6 +160,10 @@ def test_glove_pallas_kernel_matches_xla():
                                rtol=3e-2, atol=5e-3)
     np.testing.assert_allclose(np.asarray(gwb[:, D]), np.asarray(rgb),
                                rtol=3e-2, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(gwtb[:, :D]), np.asarray(rgwt),
+                               rtol=3e-2, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(gwtb[:, D]), np.asarray(rgbt),
+                               rtol=3e-2, atol=5e-3)
 
 
 def test_glove_pallas_path_converges():
