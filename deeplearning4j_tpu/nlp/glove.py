@@ -285,12 +285,20 @@ class Glove:
         x_d = jnp.asarray(x)
         mask_d = jnp.asarray(np.arange(NC * B) < P, jnp.float32)
         from deeplearning4j_tpu.ops.kernel_select import resolve_kernel
-        from deeplearning4j_tpu.ops.pallas_glove import choose_block
+        from deeplearning4j_tpu.ops.pallas_glove import (choose_block,
+                                                         probe_compile)
         platform = jax.devices()[0].platform
         pallas_block, pallas_interpret = resolve_kernel(
             cfg.kernel,
             choose_block(V, D, B, interpret=platform != "tpu"),
             f"glove vocab {V} x dim {D} (batch {B})")
+        if (pallas_block and not pallas_interpret
+                and cfg.kernel == "auto"
+                and not probe_compile(pallas_block)):
+            # Mosaic rejected the kernel on this hardware: silently use
+            # the XLA path for auto (an explicit kernel="pallas" would
+            # have surfaced the compile error instead)
+            pallas_block = 0
         key = jax.random.key(cfg.seed)
         alpha = jnp.float32(cfg.alpha)
         for epoch in range(cfg.epochs):

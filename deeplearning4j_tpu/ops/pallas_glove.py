@@ -168,3 +168,34 @@ def apply_chunk(table_b: Array, gsq_b: Array, acc: Array, alpha):
     grad = acc[:, :d1] / cnt
     gsq_b = gsq_b + acc[:, d1:2 * d1] / (cnt * cnt)
     return table_b - alpha * grad / jnp.sqrt(gsq_b + 1e-8), gsq_b
+
+
+_PROBE_CACHE: dict = {}
+
+
+def probe_compile(block: int) -> bool:
+    """One tiny real compile of the kernel at the given block size —
+    ``auto`` selection on hardware goes through here so a Mosaic
+    rejection degrades to the XLA path instead of crashing fit()
+    (the same guard pattern as the flash-attention bench probe).
+    Cached per (process, block)."""
+    if block in _PROBE_CACHE:
+        return _PROBE_CACHE[block]
+    try:
+        V, D = 128, 8
+        wext = jnp.zeros((V, D + 2), jnp.float32)
+        rows = jnp.zeros((block,), jnp.int32)
+        x = jnp.ones((block,), jnp.float32)
+        accw, _, _ = fused_glove_chunk(
+            wext, wext, rows, rows, x, x, x_max=100.0, power=0.75,
+            block=block, interpret=False)
+        float(accw[0, 0])
+        ok = True
+    except Exception as e:                # Mosaic/compile-specific
+        import logging
+        logging.getLogger(__name__).warning(
+            "glove Pallas kernel unavailable on this backend (%s); "
+            "using the XLA path", e)
+        ok = False
+    _PROBE_CACHE[block] = ok
+    return ok
