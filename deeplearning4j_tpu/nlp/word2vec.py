@@ -393,13 +393,17 @@ def run_pair_training(syn0, syn1, syn1neg,
     # tables fit (2.7x the XLA path on v5e at bench shapes);
     # kernel="pallas" forces it (via the interpreter off-TPU: tests)
     from deeplearning4j_tpu.ops.kernel_select import resolve_kernel
-    from deeplearning4j_tpu.ops.pallas_word2vec import choose_block
+    from deeplearning4j_tpu.ops.pallas_word2vec import (choose_block,
+                                                        probe_compile)
     platform = jax.devices()[0].platform
     pallas_block, pallas_interpret = resolve_kernel(
         kernel,
         choose_block(vocab_size, dim, negative, B,
                      interpret=platform != "tpu"),
         f"word2vec vocab {vocab_size} x dim {dim} (batch {B})")
+    if (pallas_block and not pallas_interpret and kernel == "auto"
+            and not probe_compile(pallas_block, use_hs, negative)):
+        pallas_block = 0        # Mosaic rejected: degrade to XLA
 
     if epochs <= 0:
         return syn0, syn1, syn1neg, dev_cache

@@ -236,3 +236,37 @@ def fused_chunk_update(syn0: Array, syn1: Array, syn1neg: Array,
     upd0 = acc0[:, :D] / jnp.maximum(acc0[:, D:D + 1], 1.0) \
         + acc0[:, D + 1:2 * D + 1] / jnp.maximum(acc0[:, 2 * D + 1:], 1.0)
     return syn0 + upd0, syn1, syn1neg
+
+
+_PROBE_CACHE: dict = {}
+
+
+def probe_compile(block: int, use_hs: bool, negative: int) -> bool:
+    """One tiny real compile at the given statics — ``auto`` selection on
+    hardware goes through here so a Mosaic rejection degrades to the XLA
+    path instead of crashing fit() (explicit kernel='pallas' still
+    surfaces the error).  Cached per (process, statics)."""
+    key = (block, use_hs, negative)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    try:
+        V, D, L = 128, 8, 4
+        z = jnp.zeros
+        _out = fused_chunk_update(
+            z((V, D)), z((V, D)) if use_hs else z((1, D)),
+            z((V, D)) if negative else z((1, D)),
+            z((block,), jnp.int32), z((block,), jnp.int32),
+            z((block, L)), z((block, L), jnp.int32), z((block, L)),
+            z((block, max(negative, 1)), jnp.int32), jnp.ones((block,)),
+            jnp.float32(0.01), use_hs=use_hs, negative=negative,
+            block=block, interpret=False)
+        float(_out[0][0, 0])
+        ok = True
+    except Exception as e:                # Mosaic/compile-specific
+        import logging
+        logging.getLogger(__name__).warning(
+            "word2vec Pallas kernel unavailable on this backend (%s); "
+            "using the XLA path", e)
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok
