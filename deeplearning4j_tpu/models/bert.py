@@ -103,10 +103,8 @@ def mlm_logits(cfg: TransformerConfig, params: PyTree, hidden: Array) -> Array:
     return logits + m["out_b"]
 
 
-def mlm_loss(cfg: TransformerConfig, params: PyTree, batch: Batch,
-             dropout_key: Optional[Array] = None,
-             attn_fn=tfm.attention) -> Array:
-    hidden = forward_hidden(cfg, params, batch, dropout_key, attn_fn)
+def mlm_loss_from_hidden(cfg: TransformerConfig, params: PyTree,
+                         hidden: Array, batch: Batch) -> Array:
     logits = mlm_logits(cfg, params, hidden)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, batch.labels[..., None], axis=-1)[..., 0]
@@ -114,10 +112,42 @@ def mlm_loss(cfg: TransformerConfig, params: PyTree, batch: Batch,
     return -jnp.sum(ll * batch.mlm_mask) / denom
 
 
+def mlm_loss(cfg: TransformerConfig, params: PyTree, batch: Batch,
+             dropout_key: Optional[Array] = None,
+             attn_fn=tfm.attention) -> Array:
+    hidden = forward_hidden(cfg, params, batch, dropout_key, attn_fn)
+    return mlm_loss_from_hidden(cfg, params, hidden, batch)
+
+
 class TrainState(NamedTuple):
     params: PyTree
     opt_state: PyTree
     step: Array
+
+
+def _opt_state_shardings(optimizer, params_shape: PyTree, pshard: PyTree,
+                         mesh: Mesh) -> PyTree:
+    """Opt-state sharding mirrors param sharding: any subtree of the optax
+    state that has the params' tree STRUCTURE (adam mu/nu, momentum
+    buffers, ...) gets the params' shardings; remaining leaves (step
+    counters etc.) replicate."""
+    ostate_shape = jax.eval_shape(optimizer.init, params_shape)
+    ptreedef = jax.tree_util.tree_structure(params_shape)
+
+    def assign(node):
+        if jax.tree_util.tree_structure(node) == ptreedef:
+            return pshard
+        if isinstance(node, tuple):
+            mapped = [assign(c) for c in node]
+            return (type(node)(*mapped) if hasattr(node, "_fields")
+                    else tuple(mapped))
+        if isinstance(node, list):
+            return [assign(c) for c in node]
+        if isinstance(node, dict):
+            return {k: assign(v) for k, v in node.items()}
+        return NamedSharding(mesh, P())
+
+    return assign(ostate_shape)
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Mesh,
@@ -163,32 +193,9 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
             return jax.lax.scan(body, state, jnp.arange(n_steps))
         # loss comes back [n_steps]; callers take the last entry
 
-    # opt-state sharding mirrors param sharding: any subtree of the optax
-    # state that has the params' tree STRUCTURE (adam mu/nu, momentum
-    # buffers, ...) gets the params' shardings; remaining leaves (step
-    # counters etc.) replicate.
-    def opt_shardings(params_shape):
-        ostate_shape = jax.eval_shape(optimizer.init, params_shape)
-        ptreedef = jax.tree_util.tree_structure(params_shape)
-
-        def assign(node):
-            if jax.tree_util.tree_structure(node) == ptreedef:
-                return pshard
-            if isinstance(node, tuple):
-                mapped = [assign(c) for c in node]
-                return (type(node)(*mapped) if hasattr(node, "_fields")
-                        else tuple(mapped))
-            if isinstance(node, list):
-                return [assign(c) for c in node]
-            if isinstance(node, dict):
-                return {k: assign(v) for k, v in node.items()}
-            return NamedSharding(mesh, P())
-
-        return assign(ostate_shape)
-
     params_shape = jax.eval_shape(
         lambda: init_params(jax.random.key(0), cfg))
-    oshard = opt_shardings(params_shape)
+    oshard = _opt_state_shardings(optimizer, params_shape, pshard, mesh)
     state_shard = TrainState(params=pshard, opt_state=oshard,
                              step=NamedSharding(mesh, P()))
 
@@ -199,6 +206,105 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
         out_shardings=(state_shard, NamedSharding(mesh, P())),
         donate_argnums=(0,),
     )
+    return jit_init, jit_step
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel training — the REAL encoder staged over the `pipe` axis
+# ---------------------------------------------------------------------------
+
+def make_pipeline_train_step(cfg: TransformerConfig, mesh: Mesh,
+                             n_micro: int,
+                             optimizer: Optional[
+                                 optax.GradientTransformation] = None
+                             ) -> Tuple[Callable, Callable]:
+    """GPipe dp×pp training step on the real transformer stack.
+
+    The ``cfg.n_layers`` encoder blocks are split into
+    ``mesh.shape['pipe']`` equal stages; each pipe shard scans (and
+    remat-s) only its own run of blocks, and activations ring-shift
+    between stages via ``lax.ppermute`` with the attention mask riding
+    along as a second pytree leaf.  Embedding and the MLM head run outside
+    the pipelined region (replicated over ``pipe``, batch sharded over
+    ``data``); reverse-mode autodiff through the scan+ppermute yields the
+    mirrored backward pipeline.  Dropout is not applied inside the
+    pipelined region — pass ``cfg.dropout == 0`` configs (pretraining
+    benches run dropout-free; same convention as the bench step).
+
+    Returns ``(init_fn(key) -> TrainState, step_fn(state, batch) ->
+    (state, loss))``, both jitted with the dp/pp shardings baked in.
+    Parity of rigor with tensor parallelism: ``make_train_step`` stages
+    the real BERT over ``model``; this stages the same blocks over
+    ``pipe`` (layout documented at parallel/pipeline.py).
+    """
+    from deeplearning4j_tpu.parallel import pipeline as pl
+    from deeplearning4j_tpu.parallel.mesh import PIPE_AXIS
+
+    optimizer = optimizer or optax.adamw(1e-4, weight_decay=0.01)
+    n_stages = mesh.shape[PIPE_AXIS]
+    if cfg.n_layers % n_stages != 0:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pipe "
+                         f"degree {n_stages}")
+    if cfg.dropout != 0.0:
+        raise ValueError(
+            f"pipeline train step is dropout-free; got cfg.dropout="
+            f"{cfg.dropout} (use dataclasses.replace(cfg, dropout=0.0))")
+
+    def stage_fn(stage_blocks, xm):
+        x, mask = xm          # x [mb, T, H] fp32, mask [mb, T] rides along
+
+        def body(h, p):
+            return tfm._block(cfg, h, p, mask, None), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, stage_blocks)
+        return (x, mask)
+
+    fwd = pl.make_pipeline_fn(mesh, stage_fn, n_micro)
+
+    def loss_of(params, batch: Batch) -> Array:
+        x = tfm.embed(cfg, params, batch.token_ids, batch.type_ids)
+        hidden, _ = fwd(params["blocks"], (x, batch.attention_mask))
+        return mlm_loss_from_hidden(cfg, params, hidden, batch)
+
+    def init_fn(key: Array) -> TrainState:
+        params = init_params(key, cfg)
+        params["blocks"] = pl.split_layers_into_stages(
+            params["blocks"], n_stages)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    def step_fn(state: TrainState, batch: Batch):
+        loss, grads = jax.value_and_grad(loss_of)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    # shardings: stage-stacked blocks over `pipe` (leading axis), everything
+    # else replicated; batch over `data` only (no seq axis in a pp mesh).
+    base = param_specs(cfg)
+    pspecs = dict(base)
+    pspecs["blocks"] = jax.tree.map(lambda _: P(PIPE_AXIS), base["blocks"])
+    pspecs["embed"] = jax.tree.map(lambda _: P(), base["embed"])
+    pspecs["mlm"] = jax.tree.map(lambda _: P(), base["mlm"])
+    pspecs["pooler"] = jax.tree.map(lambda _: P(), base["pooler"])
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                          is_leaf=lambda x: isinstance(x, P))
+    bshard = jax.tree.map(lambda _: NamedSharding(mesh, P(DATA_AXIS, None)),
+                          Batch(*Batch._fields))
+
+    params_shape = jax.eval_shape(lambda: init_fn(jax.random.key(0)).params)
+    oshard = _opt_state_shardings(optimizer, params_shape, pshard, mesh)
+    state_shard = TrainState(params=pshard, opt_state=oshard,
+                             step=NamedSharding(mesh, P()))
+
+    jit_init = jax.jit(init_fn, out_shardings=state_shard)
+    jit_step = jax.jit(step_fn,
+                       in_shardings=(state_shard, bshard),
+                       out_shardings=(state_shard, NamedSharding(mesh, P())),
+                       donate_argnums=(0,))
     return jit_init, jit_step
 
 

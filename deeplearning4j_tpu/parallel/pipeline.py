@@ -42,19 +42,23 @@ StageFn = Callable[[PyTree, Array], Array]
 
 
 def pipeline_forward(stage_fn: StageFn, stage_params: PyTree,
-                     microbatches: Array,
-                     axis_name: str = PIPE_AXIS) -> Array:
+                     microbatches: PyTree,
+                     axis_name: str = PIPE_AXIS) -> PyTree:
     """SPMD pipelined forward.  MUST run inside shard_map with ``axis_name``
     bound; every shard holds its own ``stage_params`` and the same
-    ``microbatches`` ``[n_micro, mb, ...]``; returns ``[n_micro, mb, ...]``
-    outputs (identical on every shard).
+    ``microbatches`` — a ``[n_micro, mb, ...]`` array or a pytree of such
+    arrays (e.g. ``(hidden, attention_mask)``: everything a stage needs that
+    varies per microbatch rides the ring together); returns the same
+    structure of ``[n_micro, mb, ...]`` outputs (identical on every shard).
+    ``stage_fn`` must map its input structure to the SAME structure/shapes
+    (pass riders like masks through unchanged).
 
     Tick ``t``: stage ``s`` processes microbatch ``t - s`` (when in range),
     so the last stage emits microbatch ``t - (n_stages-1)`` at tick ``t``.
     """
     n_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
-    n_micro = microbatches.shape[0]
+    n_micro = jax.tree.leaves(microbatches)[0].shape[0]
     shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     is_first = stage == 0
     is_last = stage == n_stages - 1
@@ -62,37 +66,49 @@ def pipeline_forward(stage_fn: StageFn, stage_params: PyTree,
     def tick(carry, t):
         state, outputs = carry
         # stage 0 ingests microbatch t; everyone else takes the ring input.
-        inject = lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-        x = jnp.where(is_first, inject, state)
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inject = jax.tree.map(
+            lambda m: lax.dynamic_index_in_dim(m, mb_idx, 0, keepdims=False),
+            microbatches)
+        x = jax.tree.map(lambda i, s: jnp.where(is_first, i, s),
+                         inject, state)
         y = stage_fn(stage_params, x)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         valid = jnp.logical_and(is_last, t >= n_stages - 1)
-        prev = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
-        outputs = lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(valid, y, prev), out_idx, 0)
-        state = lax.ppermute(y, axis_name, shift)
+
+        def upd(outs, yl):
+            prev = lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, yl, prev), out_idx, 0)
+
+        outputs = jax.tree.map(upd, outputs, y)
+        state = jax.tree.map(lambda yl: lax.ppermute(yl, axis_name, shift), y)
         return (state, outputs), None
 
-    state0 = jnp.zeros_like(microbatches[0])
-    out0 = jnp.zeros_like(microbatches)
+    state0 = jax.tree.map(lambda m: jnp.zeros_like(m[0]), microbatches)
+    out0 = jax.tree.map(jnp.zeros_like, microbatches)
     (state, outputs), _ = lax.scan(
         tick, (state0, out0), jnp.arange(n_micro + n_stages - 1))
     # outputs are only populated on the last stage; psum-broadcast them so
     # every shard (and the caller outside shard_map) sees the result.
-    return lax.psum(jnp.where(is_last, outputs, 0.0), axis_name)
+    return jax.tree.map(
+        lambda o: lax.psum(jnp.where(is_last, o, jnp.zeros_like(o)),
+                           axis_name), outputs)
 
 
-def to_microbatches(x: Array, n_micro: int) -> Array:
-    """[B, ...] -> [n_micro, B/n_micro, ...]."""
-    b = x.shape[0]
-    if b % n_micro != 0:
-        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
-    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+def to_microbatches(x: PyTree, n_micro: int) -> PyTree:
+    """[B, ...] -> [n_micro, B/n_micro, ...] on every leaf."""
+    def split(leaf):
+        b = leaf.shape[0]
+        if b % n_micro != 0:
+            raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+        return leaf.reshape((n_micro, b // n_micro) + leaf.shape[1:])
+    return jax.tree.map(split, x)
 
 
-def from_microbatches(x: Array) -> Array:
-    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+def from_microbatches(x: PyTree) -> PyTree:
+    return jax.tree.map(
+        lambda l: l.reshape((l.shape[0] * l.shape[1],) + l.shape[2:]), x)
 
 
 def stack_stage_params(per_stage: Sequence[PyTree]) -> PyTree:

@@ -5,10 +5,11 @@ serializes device access) and prints one JSON line per config plus a
 word2vec depth-bucket A/B. Usage:  python tools/measure_tpu.py
 """
 import json
+import os
 import subprocess
 import sys
 
-REPO = __file__.rsplit("/", 2)[0]
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 AB_SNIPPET = r'''
 import time, numpy as np, sys
@@ -33,7 +34,8 @@ for db in (1, 2, 3):
 
 
 def main() -> None:
-    for cfg in ("probe", "bert", "resnet", "word2vec", "longctx", "lenet"):
+    for cfg in ("probe", "bert", "resnet", "word2vec", "glove", "longctx",
+                "lenet"):
         r = subprocess.run(
             [sys.executable, f"{REPO}/bench.py", cfg],
             capture_output=True, text=True, timeout=1800)

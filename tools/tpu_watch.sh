@@ -1,0 +1,28 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; the moment backend init succeeds, run the
+# full measurement sweep (tools/measure_tpu.py) once and exit.
+# Status lines -> tools/tpu_watch.status ; sweep output -> TPU_SWEEP_r03.log
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+STATUS="$REPO/tools/tpu_watch.status"
+SWEEP="$REPO/TPU_SWEEP_r03.log"
+
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 150 env JAX_PLATFORMS=axon python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu'
+x = jnp.ones((128, 128))
+(x @ x).block_until_ready()
+" >/dev/null 2>&1; then
+    echo "$ts TUNNEL UP - starting sweep" >> "$STATUS"
+    # worst case: 7 configs x 1800s each + the word2vec A/B
+    cd "$REPO" && timeout 16200 python tools/measure_tpu.py > "$SWEEP" 2>&1
+    rc=$?
+    echo "$(date -u +%H:%M:%S) sweep done exit=$rc -> $SWEEP" >> "$STATUS"
+    [ "$rc" -eq 0 ] && exit 0
+    # truncated/failed sweep: keep watching and try again
+  else
+    echo "$ts tunnel down" >> "$STATUS"
+  fi
+  sleep 420
+done
