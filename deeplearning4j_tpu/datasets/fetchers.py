@@ -165,17 +165,56 @@ class CurvesDataFetcher(ArrayFetcher):
         return self.features.shape[-1]
 
 
+def find_lfw() -> Optional[str]:
+    """Tiered local discovery (same pattern as mnist.find_mnist_dir):
+    $LFW_DIR, ./data/lfw, ~/.dl4j-tpu/lfw — each may be an extracted
+    person-subdirectory tree, a directory containing an ``lfw*.tgz``
+    archive, or a path directly to the archive.  Returns the usable path
+    (dir or archive) or None."""
+    candidates = [os.environ.get("LFW_DIR"),
+                  os.path.join(os.getcwd(), "data", "lfw"),
+                  os.path.expanduser("~/.dl4j-tpu/lfw")]
+    exts = (".jpg", ".jpeg", ".pgm", ".ppm")
+    for c in candidates:
+        if not c:
+            continue
+        if os.path.isfile(c) and c.endswith((".tgz", ".tar.gz", ".tar")):
+            return c
+        if not os.path.isdir(c):
+            continue
+        for entry in sorted(os.listdir(c)):
+            full = os.path.join(c, entry)
+            if entry.lower().startswith("lfw") and \
+                    entry.endswith((".tgz", ".tar.gz", ".tar")):
+                return full
+            if os.path.isdir(full) and any(
+                    f.lower().endswith(exts) for f in os.listdir(full)):
+                return c
+    return None
+
+
 class LFWDataFetcher(ArrayFetcher):
     """LFW faces (datasets/fetchers/LFWDataFetcher.java parity): reads a
-    directory of per-person subdirectories of images via the image loader;
-    synthetic face-like blobs otherwise."""
+    directory of per-person subdirectories of images (or an lfw.tgz
+    archive, decoded in memory via the native JPEG path) through the image
+    loader; auto-discovers a local copy via ``find_lfw()``; synthetic
+    face-like blobs otherwise."""
 
     def __init__(self, image_dir: Optional[str] = None, image_size: int = 28,
                  n: int = 256, num_people: int = 8, seed: int = 5):
-        if image_dir and os.path.isdir(image_dir):
-            from deeplearning4j_tpu.utils.image import load_image_directory
-            x, labels, _names = load_image_directory(image_dir, image_size)
+        image_dir = image_dir or find_lfw()
+        if image_dir and os.path.isfile(image_dir) and \
+                image_dir.endswith((".tgz", ".tar.gz", ".tar")):
+            from deeplearning4j_tpu.utils.image import load_lfw_archive
+            x, labels, self.names = load_lfw_archive(image_dir, image_size)
             y = one_hot(labels, int(labels.max()) + 1)
+            self.synthetic = False
+        elif image_dir and os.path.isdir(image_dir):
+            from deeplearning4j_tpu.utils.image import load_image_directory
+            x, labels, self.names = load_image_directory(image_dir,
+                                                         image_size)
+            y = one_hot(labels, int(labels.max()) + 1)
+            self.synthetic = False
         else:
             rng = np.random.default_rng(seed)
             labels = rng.integers(0, num_people, size=n)
@@ -191,6 +230,8 @@ class LFWDataFetcher(ArrayFetcher):
                 face += rng.normal(0, 0.05, face.shape)
                 x[i] = face.ravel()
             y = one_hot(labels, num_people)
+            self.names = [f"person_{i}" for i in range(num_people)]
+            self.synthetic = True
         super().__init__(x, np.asarray(y))
 
 

@@ -97,6 +97,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dl4j_pnm_info.argtypes = [_u8p, ctypes.c_long, _longp, _longp]
     lib.dl4j_pnm_decode.restype = ctypes.c_int
     lib.dl4j_pnm_decode.argtypes = [_u8p, ctypes.c_long, _f32p]
+    lib.dl4j_jpeg_info.restype = ctypes.c_int
+    lib.dl4j_jpeg_info.argtypes = [_u8p, ctypes.c_long, _longp, _longp]
+    lib.dl4j_jpeg_decode.restype = ctypes.c_int
+    lib.dl4j_jpeg_decode.argtypes = [_u8p, ctypes.c_long, _f32p]
     lib.dl4j_resize_nearest.restype = None
     lib.dl4j_resize_nearest.argtypes = [_f32p, ctypes.c_long,
                                         ctypes.c_long, _f32p,
@@ -241,6 +245,30 @@ def decode_pnm(data: bytes) -> Optional[np.ndarray]:
     out = np.empty((h.value, w.value), np.float32)
     if lib.dl4j_pnm_decode(buf.ctypes.data_as(_u8p), buf.size,
                            out.ctypes.data_as(_f32p)) != 0:
+        return None
+    return out
+
+
+def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
+    """Native baseline-JPEG -> grayscale float32 [H, W] in [0, 1] (the Y
+    channel == BT.601 luma, what PIL's convert("L") computes); None when
+    the library is unavailable or the file is an unsupported flavor
+    (progressive / 12-bit) — callers fall back to PIL in utils/image.py."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    w = ctypes.c_long()
+    h = ctypes.c_long()
+    if lib.dl4j_jpeg_info(buf.ctypes.data_as(_u8p), buf.size,
+                          ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    # untrusted header: cap the allocation (64 MPix ~ 256 MB float32)
+    if w.value * h.value > (1 << 26):
+        return None
+    out = np.empty((h.value, w.value), np.float32)
+    if lib.dl4j_jpeg_decode(buf.ctypes.data_as(_u8p), buf.size,
+                            out.ctypes.data_as(_f32p)) != 0:
         return None
     return out
 

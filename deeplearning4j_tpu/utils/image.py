@@ -49,16 +49,78 @@ def load_image(path: str, size: Optional[int] = None) -> np.ndarray:
     elif ext in (".pgm", ".ppm", ".pnm"):
         img = _read_pnm(path)
     else:
+        # native JPEG/PNM decoders with PIL fallback — one policy, shared
+        # with archive members (load_image_bytes)
+        with open(path, "rb") as f:
+            img = load_image_bytes(f.read(), None, ext)
+    if size is not None and img.shape != (size, size):
+        img = _resize_nearest(img, size)
+    return img
+
+
+def load_image_bytes(data: bytes, size: Optional[int] = None,
+                     ext: str = ".jpg") -> np.ndarray:
+    """Decode an in-memory image (archive members, network blobs) to
+    grayscale float32 [H, W] in [0,1] — native JPEG/PNM decoders first,
+    PIL fallback.  Mirrors load_image for byte buffers."""
+    from deeplearning4j_tpu.runtime import native as _native
+
+    img = None
+    ext = ext.lower()
+    if ext in (".jpg", ".jpeg"):
+        img = _native.decode_jpeg(data)
+    elif ext in (".pgm", ".ppm", ".pnm"):
+        img = _native.decode_pnm(data)
+    if img is None:
+        import io
         try:
             from PIL import Image
         except ImportError as e:
             raise ValueError(
-                f"cannot load {path}: install PIL for {ext} or use "
-                ".npy/.pgm/.ppm") from e
-        img = np.asarray(Image.open(path).convert("L"), dtype=np.float32) / 255.0
+                f"cannot decode {ext} bytes without PIL") from e
+        img = np.asarray(Image.open(io.BytesIO(data)).convert("L"),
+                         dtype=np.float32) / 255.0
     if size is not None and img.shape != (size, size):
         img = _resize_nearest(img, size)
     return img
+
+
+def load_lfw_archive(path: str, size: int = 28
+                     ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """Read an LFW-style tarball (lfw.tgz: ``lfw/<person>/<img>.jpg``)
+    without extracting to disk — the local-archive tier of
+    ``base/LFWLoader.java``'s untarFile path (reference downloads +
+    untars; zero-egress build reads a local copy).  Returns the same
+    triple as load_image_directory."""
+    import tarfile
+
+    by_person: dict = {}
+    with tarfile.open(path, "r:*") as tf:
+        for m in tf:
+            if not m.isfile():
+                continue
+            low = m.name.lower()
+            if not low.endswith((".jpg", ".jpeg", ".pgm", ".ppm")):
+                continue
+            parts = m.name.strip("/").split("/")
+            if len(parts) < 2:
+                continue
+            person = parts[-2]
+            f = tf.extractfile(m)
+            if f is None:
+                continue
+            by_person.setdefault(person, []).append((m.name, f.read()))
+    if not by_person:
+        raise ValueError(f"no images found in archive {path}")
+    names = sorted(by_person)
+    feats, labels = [], []
+    for idx, name in enumerate(names):
+        for fname, data in sorted(by_person[name]):
+            ext = os.path.splitext(fname)[1]
+            feats.append(load_image_bytes(data, size, ext).ravel())
+            labels.append(idx)
+    return (np.stack(feats).astype(np.float32),
+            np.asarray(labels, dtype=np.int64), names)
 
 
 def _resize_nearest(img: np.ndarray, size: int) -> np.ndarray:

@@ -12,6 +12,7 @@
 // synchronized with a mutex + condvars.
 
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -46,9 +47,13 @@ static int pnm_skip_ws(const unsigned char* d, long n, long* i) {
 
 static long pnm_read_int(const unsigned char* d, long n, long* i) {
   if (!pnm_skip_ws(d, n, i)) return -1;
+  // cap the accumulator: a hostile header with a long digit run must not
+  // reach signed-overflow UB, and no sane dimension/maxval exceeds 2^30
+  const long kMax = 1L << 30;
   long v = 0;
   int any = 0;
   while (*i < n && d[*i] >= '0' && d[*i] <= '9') {
+    if (v >= kMax) return -1;
     v = v * 10 + (d[*i] - '0');
     ++(*i);
     any = 1;
@@ -85,6 +90,8 @@ int dl4j_pnm_decode(const unsigned char* data, long n, float* out) {
   // >8-bit samples (maxval > 255) use 2-byte big-endian words in binary
   // PNM — unsupported here; error out rather than decode garbage
   if (w <= 0 || h <= 0 || maxval <= 0 || maxval > 255) return -2;
+  // bound dims so w*h*channels can never overflow long
+  if (w > (1L << 24) || h > (1L << 24)) return -2;
   long count = w * h * channels;
   float inv = 1.0f / (float)maxval;
   if (binary) {
@@ -500,6 +507,375 @@ void dl4j_diskqueue_destroy(void* handle, int unlink_file) {
   fclose(q->f);
   if (unlink_file) remove(q->path.c_str());
   delete q;
+}
+
+// ---------------------------------------------------------------------------
+// Baseline JPEG (SOF0/SOF1) decode -> grayscale float32 [0,1].
+//
+// The native side of real-image ingestion (util/ImageLoader.java decodes
+// via javax ImageIO; base/LFWLoader.java feeds it .jpg files).  JPEG's Y
+// channel IS ITU-R BT.601 luma — exactly what the Python fallback
+// (PIL convert("L")) computes from RGB — so for the grayscale pipeline only
+// the Y component is inverse-transformed; chroma blocks are still
+// entropy-decoded (the bitstream is serial) but skip dequant/IDCT.
+// Supported: baseline + extended-sequential Huffman, 1 or 3 components,
+// any Hi/Vi sampling (4:4:4 / 4:2:2 / 4:2:0), restart markers.  Not
+// supported (clean error, Python fallback takes over): progressive
+// (SOF2), arithmetic coding, 12-bit precision.
+// ---------------------------------------------------------------------------
+
+namespace jpeg {
+
+static const int kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+struct Huff {
+  unsigned char bits[17] = {0};
+  unsigned char vals[256] = {0};
+  int mincode[17], maxcode[17], valptr[17];
+  bool present = false;
+
+  void build() {
+    int code = 0, k = 0;
+    for (int l = 1; l <= 16; ++l) {
+      valptr[l] = k;
+      mincode[l] = code;
+      code += bits[l];
+      k += bits[l];
+      maxcode[l] = code - 1;  // < mincode when bits[l] == 0
+      code <<= 1;
+    }
+    present = true;
+  }
+};
+
+struct Bits {
+  const unsigned char* d;
+  long n, i;
+  int acc = 0, cnt = 0;
+
+  // next entropy-coded bit; -1 at a marker or end of data
+  int next() {
+    if (cnt == 0) {
+      if (i >= n) return -1;
+      unsigned char b = d[i++];
+      if (b == 0xFF) {
+        if (i >= n) return -1;
+        if (d[i] == 0x00) {
+          ++i;                       // byte stuffing
+        } else {
+          --i;                       // real marker: rewind, stop
+          return -1;
+        }
+      }
+      acc = b;
+      cnt = 8;
+    }
+    --cnt;
+    return (acc >> cnt) & 1;
+  }
+
+  void align() { cnt = 0; }
+};
+
+static int huff_decode(Bits* br, const Huff* t) {
+  int code = 0;
+  for (int l = 1; l <= 16; ++l) {
+    int b = br->next();
+    if (b < 0) return -1;
+    code = (code << 1) | b;
+    if (t->bits[l] && code >= t->mincode[l] && code <= t->maxcode[l])
+      return t->vals[t->valptr[l] + (code - t->mincode[l])];
+  }
+  return -1;
+}
+
+static int receive_extend(Bits* br, int s, int* out) {
+  int v = 0;
+  for (int k = 0; k < s; ++k) {
+    int b = br->next();
+    if (b < 0) return -1;
+    v = (v << 1) | b;
+  }
+  if (s > 0 && v < (1 << (s - 1))) v += 1 - (1 << s);
+  *out = v;
+  return 0;
+}
+
+struct IdctTab {
+  float m[8][8];
+  IdctTab() {
+    for (int x = 0; x < 8; ++x)
+      for (int u = 0; u < 8; ++u)
+        m[x][u] = 0.5f * (u == 0 ? 0.70710678f : 1.0f) *
+                  (float)cos((2 * x + 1) * u * 3.14159265358979323846 / 16.0);
+  }
+};
+static const IdctTab g_idct;
+
+// coef (natural order, dequantized) -> spatial samples (+128 level shift)
+static void idct8x8(const float* coef, float* out) {
+  float tmp[64];
+  for (int x = 0; x < 8; ++x)          // rows: tmp = coef * M^T
+    for (int v = 0; v < 8; ++v) {
+      float s = 0;
+      for (int u = 0; u < 8; ++u) s += g_idct.m[x][u] * coef[u * 8 + v];
+      tmp[x * 8 + v] = s;
+    }
+  for (int x = 0; x < 8; ++x)
+    for (int y = 0; y < 8; ++y) {
+      float s = 0;
+      for (int v = 0; v < 8; ++v) s += tmp[x * 8 + v] * g_idct.m[y][v];
+      out[x * 8 + y] = s + 128.0f;
+    }
+}
+
+struct Comp {
+  int id = 0, hs = 1, vs = 1, tq = 0, td = 0, ta = 0, dcpred = 0;
+};
+
+struct Decoder {
+  const unsigned char* d;
+  long n;
+  int w = 0, h = 0, ncomp = 0;
+  Comp comp[4];
+  unsigned short qt[4][64] = {{0}};
+  Huff hdc[4], hac[4];
+  int restart_interval = 0;
+  long scan_start = -1;              // entropy data offset after SOS
+
+  int u16(long i) const { return (d[i] << 8) | d[i + 1]; }
+
+  // parse markers up to (and including) SOS; 0 on success
+  int parse_headers() {
+    if (n < 4 || d[0] != 0xFF || d[1] != 0xD8) return -1;  // SOI
+    long i = 2;
+    while (i + 4 <= n) {
+      if (d[i] != 0xFF) return -1;
+      int m = d[i + 1];
+      i += 2;
+      if (m == 0xD8 || (m >= 0xD0 && m <= 0xD7) || m == 0x01) continue;
+      if (i + 2 > n) return -1;
+      long len = u16(i);
+      if (len < 2 || i + len > n) return -1;
+      long seg = i + 2, seg_end = i + len;
+      switch (m) {
+        case 0xC0:                                   // SOF0 baseline
+        case 0xC1: {                                 // SOF1 ext sequential
+          if (seg + 6 > seg_end || d[seg] != 8) return -2;   // 8-bit only
+          h = u16(seg + 1);
+          w = u16(seg + 3);
+          ncomp = d[seg + 5];
+          if (w <= 0 || h <= 0 || w > (1 << 16) || h > (1 << 16)) return -1;
+          if (ncomp != 1 && ncomp != 3) return -2;
+          if (seg + 6 + 3 * ncomp > seg_end) return -1;
+          for (int c = 0; c < ncomp; ++c) {
+            const unsigned char* p = d + seg + 6 + 3 * c;
+            comp[c].id = p[0];
+            comp[c].hs = p[1] >> 4;
+            comp[c].vs = p[1] & 15;
+            comp[c].tq = p[2];
+            if (comp[c].hs < 1 || comp[c].hs > 4 || comp[c].vs < 1 ||
+                comp[c].vs > 4 || comp[c].tq > 3)
+              return -1;
+          }
+          break;
+        }
+        case 0xC2: case 0xC3: case 0xC5: case 0xC6: case 0xC7:
+        case 0xC9: case 0xCA: case 0xCB: case 0xCD: case 0xCE: case 0xCF:
+          return -2;                                 // progressive etc.
+        case 0xC4: {                                 // DHT (1+ tables)
+          long p = seg;
+          while (p < seg_end) {
+            int tc = d[p] >> 4, th = d[p] & 15;
+            if (tc > 1 || th > 3 || p + 17 > seg_end) return -1;
+            Huff* t = tc ? &hac[th] : &hdc[th];
+            int total = 0;
+            for (int l = 1; l <= 16; ++l) {
+              t->bits[l] = d[p + l];
+              total += t->bits[l];
+            }
+            if (total > 256 || p + 17 + total > seg_end) return -1;
+            for (int k = 0; k < total; ++k) t->vals[k] = d[p + 17 + k];
+            t->build();
+            p += 17 + total;
+          }
+          break;
+        }
+        case 0xDB: {                                 // DQT (1+ tables)
+          long p = seg;
+          while (p < seg_end) {
+            int pq = d[p] >> 4, tq_ = d[p] & 15;
+            if (pq > 1 || tq_ > 3) return -1;
+            ++p;
+            int sz = pq ? 2 : 1;
+            if (p + 64 * sz > seg_end) return -1;
+            for (int k = 0; k < 64; ++k) {
+              qt[tq_][kZigzag[k]] =
+                  pq ? (unsigned short)u16(p + 2 * k) : d[p + k];
+            }
+            p += 64 * sz;
+          }
+          break;
+        }
+        case 0xDD:                                   // DRI
+          if (len != 4) return -1;
+          restart_interval = u16(seg);
+          break;
+        case 0xDA: {                                 // SOS
+          if (seg >= seg_end) return -1;
+          int ns = d[seg];
+          if (ns != ncomp || seg + 1 + 2 * ns + 3 > seg_end) return -2;
+          for (int s = 0; s < ns; ++s) {
+            int cid = d[seg + 1 + 2 * s];
+            int tab = d[seg + 2 + 2 * s];
+            int found = -1;
+            for (int c = 0; c < ncomp; ++c)
+              if (comp[c].id == cid) found = c;
+            if (found < 0) return -1;
+            comp[found].td = tab >> 4;
+            comp[found].ta = tab & 15;
+          }
+          scan_start = seg_end;
+          return 0;
+        }
+        default:
+          break;                                     // APPn / COM: skip
+      }
+      i = seg_end;
+    }
+    return -1;
+  }
+
+  // full entropy decode; writes the Y plane cropped to [h, w] in [0,1]
+  int decode(float* out) {
+    if (w <= 0 || h <= 0 || scan_start < 0) return -1;
+    if (ncomp == 1) {
+      // single-component scans are NON-interleaved (JPEG B.2.3): one data
+      // unit per MCU in raster order, sampling factors do not apply
+      comp[0].hs = comp[0].vs = 1;
+    }
+    int hmax = 1, vmax = 1;
+    for (int c = 0; c < ncomp; ++c) {
+      if (comp[c].hs > hmax) hmax = comp[c].hs;
+      if (comp[c].vs > vmax) vmax = comp[c].vs;
+    }
+    for (int c = 0; c < ncomp; ++c) {
+      if (!hdc[comp[c].td].present || !hac[comp[c].ta].present) return -1;
+    }
+    long mcux = (w + 8 * hmax - 1) / (8 * hmax);
+    long mcuy = (h + 8 * vmax - 1) / (8 * vmax);
+    long yw = mcux * hmax * 8;        // padded Y plane width
+    std::vector<float> yplane((size_t)yw * mcuy * vmax * 8, 0.0f);
+
+    Bits br{d, n, scan_start};
+    float coef[64], pix[64];
+    long mcu_count = 0;
+    int next_rst = 0;
+
+    for (long my = 0; my < mcuy; ++my) {
+      for (long mx = 0; mx < mcux; ++mx) {
+        if (restart_interval && mcu_count == restart_interval) {
+          // byte-align and consume RSTn, reset DC predictions
+          br.align();
+          if (br.i + 2 > n || br.d[br.i] != 0xFF ||
+              br.d[br.i + 1] != (0xD0 | next_rst))
+            return -3;
+          br.i += 2;
+          next_rst = (next_rst + 1) & 7;
+          mcu_count = 0;
+          for (int c = 0; c < ncomp; ++c) comp[c].dcpred = 0;
+        }
+        for (int c = 0; c < ncomp; ++c) {
+          const Huff* dc = &hdc[comp[c].td];
+          const Huff* ac = &hac[comp[c].ta];
+          const unsigned short* q = qt[comp[c].tq];
+          for (int by = 0; by < comp[c].vs; ++by) {
+            for (int bx = 0; bx < comp[c].hs; ++bx) {
+              // -- DC --
+              int s = huff_decode(&br, dc);
+              if (s < 0 || s > 15) return -3;
+              int diff = 0;
+              if (s && receive_extend(&br, s, &diff) != 0) return -3;
+              comp[c].dcpred += diff;
+              bool want = (c == 0);
+              if (want) {
+                memset(coef, 0, sizeof coef);
+                coef[0] = (float)comp[c].dcpred * q[0];
+              }
+              // -- AC --
+              int k = 1;
+              while (k < 64) {
+                int rs = huff_decode(&br, ac);
+                if (rs < 0) return -3;
+                int r = rs >> 4, sz = rs & 15;
+                if (sz == 0) {
+                  if (r == 15) { k += 16; continue; }   // ZRL
+                  break;                                // EOB
+                }
+                k += r;
+                if (k > 63) return -3;
+                int v;
+                if (receive_extend(&br, sz, &v) != 0) return -3;
+                if (want) {
+                  int nat = kZigzag[k];
+                  coef[nat] = (float)v * q[nat];
+                }
+                ++k;
+              }
+              if (want) {
+                idct8x8(coef, pix);
+                long px = (mx * comp[c].hs + bx) * 8;
+                long py = (my * comp[c].vs + by) * 8;
+                for (int yy = 0; yy < 8; ++yy) {
+                  float* row = &yplane[(size_t)(py + yy) * yw + px];
+                  for (int xx = 0; xx < 8; ++xx) row[xx] = pix[yy * 8 + xx];
+                }
+              }
+            }
+          }
+        }
+        ++mcu_count;
+      }
+    }
+    // crop + normalize.  Y may be subsampled relative to the padded plane
+    // only when hmax/vmax belong to another component (rare); scale indices
+    const int ysx = hmax / comp[0].hs, ysy = vmax / comp[0].vs;
+    for (long y = 0; y < h; ++y)
+      for (long x = 0; x < w; ++x) {
+        float v = yplane[(size_t)(y / ysy) * yw + (x / ysx)] / 255.0f;
+        out[y * w + x] = v < 0.0f ? 0.0f : (v > 1.0f ? 1.0f : v);
+      }
+    return 0;
+  }
+};
+
+}  // namespace jpeg
+
+// Parse header only: 0 on success (fills w, h); -2 = valid JPEG but an
+// unsupported flavor (progressive/12-bit) — caller falls back to PIL.
+int dl4j_jpeg_info(const unsigned char* data, long n, long* w, long* h) {
+  jpeg::Decoder dec;
+  dec.d = data;
+  dec.n = n;
+  int rc = dec.parse_headers();
+  if (rc != 0) return rc;
+  *w = dec.w;
+  *h = dec.h;
+  return 0;
+}
+
+// Decode to grayscale float32 [h*w] in [0,1] (the JPEG Y channel).
+int dl4j_jpeg_decode(const unsigned char* data, long n, float* out) {
+  jpeg::Decoder dec;
+  dec.d = data;
+  dec.n = n;
+  int rc = dec.parse_headers();
+  if (rc != 0) return rc;
+  return dec.decode(out);
 }
 
 }  // extern "C"

@@ -64,6 +64,49 @@ def test_rntn_learns_toy_sentiment():
     assert correct >= 7, correct
 
 
+def test_rntn_eval_counts_and_accuracy():
+    """RNTNEval parity: confusion over internal nodes only, plus root
+    accuracy; on a learnable toy corpus trained accuracy must be high."""
+    pos = ["(1 (1 good) (1 movie))", "(1 (1 great) (1 film))",
+           "(1 (1 nice) (1 story))", "(1 (1 great) (1 movie))"]
+    neg = ["(0 (0 bad) (0 movie))", "(0 (0 awful) (0 film))",
+           "(0 (0 boring) (0 story))", "(0 (0 bad) (0 ending))"]
+    trees = [rntn.parse_tree(s) for s in pos + neg]
+    model = rntn.RNTN(rntn.RNTNConfig(vocab_size=32, dim=6, n_classes=2,
+                                      max_nodes=8, adagrad_lr=0.1),
+                      trees=trees, seed=1)
+    model.fit(epochs=80)
+
+    ev = rntn.RNTNEval()
+    ev.eval(model, trees)
+    # each toy tree has exactly 1 internal node (the root)
+    assert ev.confusion.sum() == len(trees)
+    assert ev.accuracy() >= 0.75, ev.stats()
+    assert ev.root_accuracy() == ev.accuracy()   # roots ARE the internals here
+    s = ev.stats()
+    assert "Actual Class" in s and "Root accuracy" in s
+
+
+def test_treeparser_rntn_eval_e2e():
+    """Raw sentences -> treeparser -> RNTN.fit -> RNTNEval reports sane
+    accuracy numbers (the reference's RNTN pipeline end to end)."""
+    from deeplearning4j_tpu.nlp.treeparser import trees_from_raw
+
+    labeled = [("good movie", 4), ("great film", 4), ("nice story", 4),
+               ("bad movie", 0), ("awful film", 0), ("boring story", 0)]
+    trees = trees_from_raw(labeled)
+    assert len(trees) == len(labeled)
+    model = rntn.RNTN(rntn.RNTNConfig(vocab_size=64, dim=8, n_classes=5,
+                                      max_nodes=16, adagrad_lr=0.1),
+                      trees=trees, seed=0)
+    model.fit(epochs=100)
+    ev = rntn.RNTNEval()
+    ev.eval(model, trees)
+    assert 0.0 <= ev.accuracy() <= 1.0
+    assert ev.root_accuracy() >= 0.5, ev.stats()
+    assert ev._root_counts.sum() == len(trees)
+
+
 # -- viterbi ----------------------------------------------------------------
 
 def test_viterbi_prefers_transition_consistent_path():

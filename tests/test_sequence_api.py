@@ -1,0 +1,37 @@
+"""SequenceClassifier contract (nn/api/SequenceClassifier.java parity):
+per-timestep classification over [B, T, D] batches via the LSTM layer."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.api import LSTMSequenceClassifier, SequenceClassifier
+
+
+def _toy_sequences(n=32, t=12, d=4, seed=0):
+    """Label at each timestep = sign of feature 0 (learnable per-step)."""
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, t, d).astype(np.float32)
+    ys = (xs[:, :, 0] > 0).astype(np.int32)
+    return xs, ys
+
+
+def test_lstm_sequence_classifier_learns_per_timestep_labels():
+    xs, ys = _toy_sequences()
+    clf = LSTMSequenceClassifier(n_in=4, n_classes=2, hidden=16,
+                                 learning_rate=2e-2, seed=1)
+    assert isinstance(clf, SequenceClassifier)
+    losses = clf.fit(xs, ys, epochs=150)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    probs = clf.predict(xs)
+    assert probs.shape == (32, 12, 2)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, rtol=1e-4)
+    acc = (clf.predict_labels(xs) == ys).mean()
+    assert acc > 0.85, acc
+
+    # mostLikelyInSequence: argmax of summed scores over the batch
+    xs_pos = xs.copy()
+    xs_pos[:, :, 0] = np.abs(xs_pos[:, :, 0])       # all timesteps class 1
+    assert clf.most_likely_in_sequence(xs_pos) == 1
+
+    # classifier() exposes the underlying per-timestep model
+    assert clf.classifier() is clf._layer
