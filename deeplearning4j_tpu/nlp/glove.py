@@ -294,7 +294,7 @@ class Glove:
             f"glove vocab {V} x dim {D} (batch {B})")
         if (pallas_block and not pallas_interpret
                 and cfg.kernel == "auto"
-                and not probe_compile(pallas_block)):
+                and not probe_compile(pallas_block, V, D)):
             # Mosaic rejected the kernel on this hardware: silently use
             # the XLA path for auto (an explicit kernel="pallas" would
             # have surfaced the compile error instead)

@@ -107,7 +107,9 @@ class ParagraphVectors:
 
         lens = np.asarray([a.size for a in indexed])
         starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
-        seen_before = starts.astype(np.float32)
+        # int64 like corpus_pairs' word_offset: the lr clock stays exact
+        # however large the corpus (float happens at ratio time)
+        seen_before = starts.astype(np.int64)
         # label pairs: (center=word, input=label row, pos=token position)
         lb_cen = np.concatenate(indexed)
         lb_ctx = np.repeat(np.asarray(label_rows, np.int32), lens)

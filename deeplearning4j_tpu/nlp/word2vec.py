@@ -78,6 +78,14 @@ class Word2VecConfig:
     #: pairs skip the deep padded levels.  Exact semantics (masked
     #: levels contribute nothing); costs one jit variant per bucket.
     depth_buckets: int = 1
+    #: "masked" (default): candidate pairs at the full window are built
+    #: once and the per-epoch dynamic window shrink masks on device —
+    #: zero host pair work after epoch 0, but ~45% of pair compute is
+    #: masked waste at window 5.  "exact": the shrink is applied host-
+    #: side per epoch (the reference's actual algorithm) so the device
+    #: trains only real pairs — fresh streaming every epoch (overlapped
+    #: with dispatch), no replay cache.
+    pair_mode: str = "masked"
 
 
 # -- jitted training steps --------------------------------------------------
@@ -146,16 +154,17 @@ def _neg_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2),
-         static_argnames=("use_hs", "negative", "window",
+         static_argnames=("use_hs", "negative", "window", "window_mask",
                           "pallas_block", "pallas_interpret"))
 def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
                centers: Array, contexts: Array, cpos: Array, deltas: Array,
                offsets: Array, chunk_ids: Array, n_real: Array,
                codes_t: Array, points_t: Array, mask_t: Array,
                table: Array, key: Array, epoch: Array,
-               total_words: Array, total: Array, alpha0: Array,
+               epoch_frac: Array, alpha0: Array,
                min_alpha: Array,
                *, use_hs: bool, negative: int, window: int,
+               window_mask: bool = True,
                pallas_block: int = 0, pallas_interpret: bool = False):
     """One dispatch per SLAB of chunks: ``lax.scan`` over [NC, B] pair
     chunks so the whole epoch costs one host->device round trip.
@@ -173,10 +182,12 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
     candidate pair list (all offsets up to ``window``) exactly ONCE per
     corpus instead of re-running pair generation every epoch.
 
-    ``offsets`` [NC] = corpus word offset at each chunk's first pair, so
-    the linear lr decay by words seen (trainSentence:298) stays exact:
-    ``alpha = max(min_alpha, alpha0 * (1 - seen/total))`` with
-    ``seen = epoch * total_words + offsets[c]``.  ``n_real`` [NC] = real
+    ``offsets`` [NC] = each chunk's first-pair word offset as a FRACTION
+    of the total decay span (formed in float64 on host from exact int64
+    word counts), and ``epoch_frac`` = total_words/total, so the linear
+    lr decay by words seen (trainSentence:298) stays exact:
+    ``alpha = max(min_alpha, alpha0 * (1 - (epoch*epoch_frac +
+    offsets[c])))``.  ``n_real`` [NC] = real
     (unpadded) pairs per chunk; ``chunk_ids`` stay globally unique across
     slabs so negative draws never repeat within an epoch.
     """
@@ -200,12 +211,16 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
     def body(carry, inp):
         syn0, syn1, syn1neg = carry
         cen, ctx, pos, dlt, off, cid, nr = inp
-        shrink = window - b_draw(pos)                        # [B]
-        wmask = (jnp.abs(dlt) <= shrink).astype(jnp.float32)
         pmask = (col < nr).astype(jnp.float32)
-        m = wmask * pmask
-        seen = epoch * total_words + off
-        alpha = jnp.maximum(min_alpha, alpha0 * (1.0 - seen / total))
+        if window_mask:
+            shrink = window - b_draw(pos)                    # [B]
+            m = (jnp.abs(dlt) <= shrink).astype(jnp.float32) * pmask
+        else:
+            # pairs arrive pre-shrunk from the host (pair_mode="exact"):
+            # every real pair trains
+            m = pmask
+        frac = epoch.astype(jnp.float32) * epoch_frac + off
+        alpha = jnp.maximum(min_alpha, alpha0 * (1.0 - frac))
         if negative > 0:
             draws = jax.random.randint(
                 jax.random.fold_in(ekey, 1 + cid),
@@ -294,23 +309,32 @@ def corpus_pairs(indexed: Sequence[np.ndarray], window: int,
     corpus."""
     outs = list(_corpus_pair_blocks(indexed, window, slab))
     if not outs:
-        return (np.empty(0, np.int32),) * 4 + (np.empty(0, np.float32),)
+        return (np.empty(0, np.int32),) * 4 + (np.empty(0, np.int64),)
     return tuple(np.concatenate([o[k] for o in outs])        # type: ignore
                  for k in range(5))
 
 
 def _corpus_pair_blocks(indexed: Sequence[np.ndarray], window: int,
-                        slab: int = 1 << 20):
+                        slab: int = 1 << 20, shrink_rng=None):
     """Yield candidate-pair 5-tuples per position slab (corpus_pairs'
-    loop body, exposed for the streaming trainer)."""
+    loop body, exposed for the streaming trainer).
+
+    ``shrink_rng`` applies the reference's dynamic window shrink HOST-side
+    (skipGram:314's ``b = rand % window``: position trains offsets
+    ``|delta| <= window - b``): only surviving pairs are emitted, so the
+    device trains ~(window+1)/(2*window) as many pairs instead of masking
+    them out on-chip (pair_mode="exact")."""
     if not indexed:
         return
     tok = np.concatenate(indexed).astype(np.int32)
     lens = np.asarray([a.size for a in indexed])
     sid = np.repeat(np.arange(len(indexed)), lens)
     # words seen AFTER each sentence is processed (trainSentence:298
-    # increments per sentence) — broadcast to its positions
-    seen_after = np.cumsum(lens).astype(np.float32)
+    # increments per sentence) — broadcast to its positions.  Kept int64
+    # through prep: float32 loses integer exactness past 2^24 (~16.7M)
+    # corpus words, which would drift the linear lr decay; the offset only
+    # becomes float when the alpha RATIO is formed (in float64, prep_slab)
+    seen_after = np.cumsum(lens, dtype=np.int64)
     word_off = seen_after[sid] - lens[sid]
     n = tok.size
     deltas = np.concatenate([np.arange(-window, 0),
@@ -321,6 +345,9 @@ def _corpus_pair_blocks(indexed: Sequence[np.ndarray], window: int,
         j = pos[:, None] + deltas[None, :]                   # [S, 2W] i32
         jc = np.clip(j, 0, n - 1)
         valid = (j >= 0) & (j < n) & (sid[jc] == sid[s0:s1, None])
+        if shrink_rng is not None:
+            b = shrink_rng.randint(0, window, size=s1 - s0)
+            valid &= np.abs(deltas)[None, :] <= (window - b)[:, None]
         ci, di = np.nonzero(valid)
         p = pos[ci]
         yield (tok[p], tok[j[ci, di]], p.astype(np.int32),
@@ -328,7 +355,7 @@ def _corpus_pair_blocks(indexed: Sequence[np.ndarray], window: int,
 
 
 def corpus_pairs_slabs(indexed: Sequence[np.ndarray], window: int,
-                       pairs_per_slab: int):
+                       pairs_per_slab: int, shrink_rng=None):
     """Yield ``corpus_pairs``-shaped blocks of ~``pairs_per_slab`` pairs.
     Streaming form: the scanned trainer dispatches each block (async)
     before the host builds the next, so cold-fit wall time is
@@ -338,7 +365,8 @@ def corpus_pairs_slabs(indexed: Sequence[np.ndarray], window: int,
     # position-slab sized so each block stays well under the pair budget
     # (a position contributes up to 2*window candidate pairs)
     pos_slab = max(1024, pairs_per_slab // (8 * window))
-    for arr_slab in _corpus_pair_blocks(indexed, window, pos_slab):
+    for arr_slab in _corpus_pair_blocks(indexed, window, pos_slab,
+                                        shrink_rng):
         bufs.append(arr_slab)
         n += arr_slab[0].size
         while n >= pairs_per_slab:
@@ -368,6 +396,7 @@ def run_pair_training(syn0, syn1, syn1neg,
                       alpha, min_alpha, use_hs,
                       negative, batch_size, kernel,
                       seed, dev_cache=None, pairs_iter=None,
+                      pairs_iter_factory=None, window_mask=True,
                       hs_lengths=None, hs_weights=None, depth_buckets=1):
     """The shared scanned-epoch training engine (Word2Vec AND
     ParagraphVectors fit through here).
@@ -378,6 +407,11 @@ def run_pair_training(syn0, syn1, syn1neg,
     blocks (``pairs_iter``, e.g. ``corpus_pairs_slabs``).  In streaming
     form epoch 0 interleaves host pair generation with async device
     dispatch: cold-fit wall time is max(host, device), not their sum.
+
+    ``pairs_iter_factory(epoch) -> blocks`` streams a FRESH pair set
+    every epoch (pair_mode="exact": the host applies the window shrink,
+    so pass ``window_mask=False`` — no on-device masking, ~45% fewer
+    trained pairs at window 5); no replay cache is kept in this mode.
 
     Handles kernel validation/selection (VMEM-resident Pallas kernel on
     TPU when the tables fit; ``kernel='pallas'`` raises when they
@@ -402,7 +436,9 @@ def run_pair_training(syn0, syn1, syn1neg,
                      interpret=platform != "tpu"),
         f"word2vec vocab {vocab_size} x dim {dim} (batch {B})")
     if (pallas_block and not pallas_interpret and kernel == "auto"
-            and not probe_compile(pallas_block, use_hs, negative)):
+            and not probe_compile(pallas_block, use_hs, negative,
+                                  vocab_size, dim,
+                                  int(codes_t.shape[1]) if use_hs else 1)):
         pallas_block = 0        # Mosaic rejected: degrade to XLA
 
     if epochs <= 0:
@@ -459,9 +495,13 @@ def run_pair_training(syn0, syn1, syn1neg,
 
         n_real = np.full(NC, B, np.int32)
         n_real[-1] = P - (NC - 1) * B
-        # per-chunk lr clock = word offset at the chunk's first pair
+        # per-chunk lr clock = word offset at the chunk's first pair,
+        # converted to a FRACTION of the total decay span in float64 on
+        # host (int64 offsets stay exact however large the corpus)
+        off_frac = (woff[::B].astype(np.float64) / float(total)
+                    ).astype(np.float32)
         return (ch(cen), ch(ctx), ch(cpos), ch(dlt),
-                jnp.asarray(woff[::B].copy()), jnp.asarray(n_real))
+                jnp.asarray(off_frac), jnp.asarray(n_real))
 
     def dispatch(slab, cid0, bidx, epoch, state):
         syn0, syn1, neg_tab = state
@@ -472,34 +512,20 @@ def run_pair_training(syn0, syn1, syn1neg,
         return _scan_slab(
             syn0, syn1, neg_tab, cen_d, ctx_d, cpos_d, dlt_d,
             woff_d, cids, n_real, c_t, p_t, m_t, table,
-            nkey, jnp.int32(epoch), jnp.float32(total_words),
-            jnp.float32(total), jnp.float32(alpha),
-            jnp.float32(min_alpha),
+            nkey, jnp.int32(epoch), jnp.float32(total_words / total),
+            jnp.float32(alpha), jnp.float32(min_alpha),
             use_hs=use_hs, negative=negative, window=window,
+            window_mask=window_mask,
             pallas_block=pallas_block, pallas_interpret=pallas_interpret)
 
     state = (syn0, syn1, neg_tab)
-    if dev_cache is not None and dev_cache["bucket_l"] != bucket_l:
-        raise ValueError(
-            f"cached pair slabs were built for depth buckets "
-            f"{dev_cache['bucket_l']} but the config now implies "
-            f"{bucket_l}; refit with a fresh instance (or keep "
-            f"depth_buckets stable across fits)")
-    if dev_cache is None:
-        if pairs_iter is None:
-            if pairs is None:
-                raise ValueError("need pairs, pairs_iter or dev_cache")
 
-            def _slices():
-                P = pairs[0].size
-                for lo in range(0, P, PAIRS_PER_SLAB):
-                    yield tuple(a[lo:lo + PAIRS_PER_SLAB] for a in pairs)
-
-            pairs_iter = _slices()
-        # epoch 0 streams: prep slab k+1 on host while the device (async
-        # dispatch) trains slab k; prepared slabs are cached for replay
-        dev_cache = {"bucket_l": bucket_l, "slabs": []}
-        slabs = dev_cache["slabs"]
+    def stream(blocks, epoch, slabs):
+        """Stream pair blocks through prep+dispatch for one epoch — host
+        preps slab k+1 while the device (async dispatch) trains slab k.
+        ``slabs`` (a list) caches the prepared slabs for replay; None
+        streams without caching (fresh pairs every epoch)."""
+        nonlocal state
         seen_pairs = 0
         cid0 = 0
         # per-bucket carry buffers so every bucket emits uniform
@@ -509,13 +535,15 @@ def run_pair_training(syn0, syn1, syn1neg,
         buf_n = [0] * len(bucket_l)
 
         def record(part, bidx):
-            """Prep, dispatch (epoch 0) and cache one slab — the single
+            """Prep, dispatch and (optionally) cache one slab — the single
             accounting path for both the direct and bucketed branches."""
             nonlocal seen_pairs, cid0, state
-            resident = seen_pairs + part[0].size <= RESIDENT_PAIR_CAP
+            resident = (slabs is not None
+                        and seen_pairs + part[0].size <= RESIDENT_PAIR_CAP)
             slab = prep_slab(part, resident)
-            state = dispatch(slab, cid0, bidx, 0, state)
-            slabs.append((slab, cid0, bidx))
+            state = dispatch(slab, cid0, bidx, epoch, state)
+            if slabs is not None:
+                slabs.append((slab, cid0, bidx))
             seen_pairs += part[0].size
             cid0 += slab[5].shape[0]
 
@@ -536,8 +564,8 @@ def run_pair_training(syn0, syn1, syn1neg,
                     break
 
         empty = tuple(np.empty(0, np.int32) for _ in range(4)) + (
-            np.empty(0, np.float32),)
-        for blk in pairs_iter:
+            np.empty(0, np.int64),)
+        for blk in blocks:
             if blk[0].size == 0:
                 continue
             if len(bucket_l) == 1:
@@ -553,6 +581,37 @@ def run_pair_training(syn0, syn1, syn1neg,
         for bidx in range(len(bucket_l)):
             if buf_n[bidx]:
                 emit(bidx, empty, final=True)
+
+    if pairs_iter_factory is not None:
+        # pair_mode="exact": the pair set changes per epoch (host-side
+        # window shrink, like the reference's per-epoch b draws), so
+        # every epoch streams fresh — no replay cache
+        for epoch in range(epochs):
+            stream(pairs_iter_factory(epoch), epoch, None)
+        syn0, syn1, neg_tab = state
+        return (syn0, syn1,
+                neg_tab if syn1neg is not None else None, None)
+
+    if dev_cache is not None and dev_cache["bucket_l"] != bucket_l:
+        raise ValueError(
+            f"cached pair slabs were built for depth buckets "
+            f"{dev_cache['bucket_l']} but the config now implies "
+            f"{bucket_l}; refit with a fresh instance (or keep "
+            f"depth_buckets stable across fits)")
+    if dev_cache is None:
+        if pairs_iter is None:
+            if pairs is None:
+                raise ValueError("need pairs, pairs_iter or dev_cache")
+
+            def _slices():
+                P = pairs[0].size
+                for lo in range(0, P, PAIRS_PER_SLAB):
+                    yield tuple(a[lo:lo + PAIRS_PER_SLAB] for a in pairs)
+
+            pairs_iter = _slices()
+        # epoch 0 streams; prepared slabs are cached for replay
+        dev_cache = {"bucket_l": bucket_l, "slabs": []}
+        stream(pairs_iter, 0, dev_cache["slabs"])
         first_epoch = 1
     else:
         first_epoch = 0
@@ -589,6 +648,7 @@ class Word2Vec:
         self._wv: Optional[WordVectors] = None
         self._n_positions = 0       # corpus words (the lr-decay clock)
         self._dev_cache = None      # prepared pair slabs (see engine)
+        self._indexed = None        # indexed corpus (pair_mode="exact")
 
     # -- vocab (buildVocab:257 parity) -------------------------------------
     def build_vocab(self) -> VocabCache:
@@ -598,6 +658,17 @@ class Word2Vec:
         if self.config.use_hs:
             build_huffman(self.cache)
         return self.cache
+
+    def _index_sentences(self) -> List[np.ndarray]:
+        """Tokenize + vocab-index the corpus; sets the lr-decay clock."""
+        indexed: List[np.ndarray] = []
+        for sent in self.sentences:
+            idx = [self.cache.index_of(t) for t in self.tokenizer(sent)]
+            arr = np.asarray([i for i in idx if i >= 0], np.int32)
+            if arr.size:
+                indexed.append(arr)
+        self._n_positions = int(sum(a.size for a in indexed))
+        return indexed
 
     def _reset_weights(self) -> None:
         """syn0 ~ U(-0.5, 0.5)/dim (InMemoryLookupTable:98-104)."""
@@ -619,6 +690,10 @@ class Word2Vec:
             raise ValueError(
                 f"Word2VecConfig.kernel must be 'auto', 'pallas' or "
                 f"'xla', got {cfg.kernel!r}")
+        if cfg.pair_mode not in ("masked", "exact"):
+            raise ValueError(
+                f"Word2VecConfig.pair_mode must be 'masked' or 'exact', "
+                f"got {cfg.pair_mode!r}")
         if not cfg.use_hs and cfg.negative <= 0:
             raise ValueError(
                 "no training objective: enable use_hs and/or negative > 0")
@@ -652,21 +727,22 @@ class Word2Vec:
                 "initialize fresh)")
         # COLD fit: index sentences, then STREAM candidate-pair slabs —
         # epoch 0 trains each slab (async dispatch) while the host builds
-        # the next, and the prepared slabs are cached so later fits (and
-        # epochs 1+) replay them with zero host pair work.
-        if self._dev_cache is None:
-            indexed: List[np.ndarray] = []
-            for sent in self.sentences:
-                idx = [self.cache.index_of(t)
-                       for t in self.tokenizer(sent)]
-                arr = np.asarray([i for i in idx if i >= 0], np.int32)
-                if arr.size:
-                    indexed.append(arr)
-            self._n_positions = int(sum(a.size for a in indexed))
-            pairs_iter = corpus_pairs_slabs(indexed, cfg.window,
-                                            PAIRS_PER_SLAB)
-        else:
-            pairs_iter = None
+        # the next.  pair_mode="masked" caches the prepared slabs so later
+        # fits (and epochs 1+) replay them with zero host pair work;
+        # pair_mode="exact" re-streams host-shrunk pairs every epoch.
+        pairs_iter = factory = None
+        if cfg.pair_mode == "exact":
+            if self._indexed is None:
+                self._indexed = self._index_sentences()
+            indexed, w = self._indexed, cfg.window
+
+            def factory(epoch):
+                rng = np.random.RandomState(
+                    (cfg.seed + 7919 * (epoch + 1)) % (2 ** 31 - 1))
+                return corpus_pairs_slabs(indexed, w, PAIRS_PER_SLAB, rng)
+        elif self._dev_cache is None:
+            pairs_iter = corpus_pairs_slabs(self._index_sentences(),
+                                            cfg.window, PAIRS_PER_SLAB)
         self.syn0, self.syn1, self.syn1neg, self._dev_cache = \
             run_pair_training(
                 self.syn0, self.syn1, self.syn1neg,
@@ -678,6 +754,8 @@ class Word2Vec:
                 negative=cfg.negative, batch_size=cfg.batch_size,
                 kernel=cfg.kernel, seed=cfg.seed,
                 dev_cache=self._dev_cache, pairs_iter=pairs_iter,
+                pairs_iter_factory=factory,
+                window_mask=cfg.pair_mode != "exact",
                 hs_lengths=np.asarray(lengths_t),
                 hs_weights=counts,
                 depth_buckets=cfg.depth_buckets)

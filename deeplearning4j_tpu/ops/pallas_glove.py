@@ -175,16 +175,18 @@ def apply_chunk(table_b: Array, gsq_b: Array, acc: Array, alpha):
 _PROBE_CACHE: dict = {}
 
 
-def probe_compile(block: int) -> bool:
-    """One tiny real compile of the kernel at the given block size —
-    ``auto`` selection on hardware goes through here so a Mosaic
-    rejection degrades to the XLA path instead of crashing fit()
-    (the same guard pattern as the flash-attention bench probe).
-    Cached per (process, block)."""
-    if block in _PROBE_CACHE:
-        return _PROBE_CACHE[block]
+def probe_compile(block: int, vocab_size: int = 128, dim: int = 8) -> bool:
+    """One real compile of the kernel at the given block size AND the
+    caller's actual (vocab, dim) — ``auto`` selection on hardware goes
+    through here so a Mosaic rejection degrades to the XLA path instead
+    of crashing fit() (the same guard pattern as the flash-attention
+    bench probe).  VMEM fit depends on the table shapes, so the probe
+    runs at the production shapes; cached per the full key."""
+    key = (block, vocab_size, dim)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
     try:
-        V, D = 128, 8
+        V, D = vocab_size, dim
         wext = jnp.zeros((V, D + 2), jnp.float32)
         rows = jnp.zeros((block,), jnp.int32)
         x = jnp.ones((block,), jnp.float32)
@@ -199,5 +201,5 @@ def probe_compile(block: int) -> bool:
             "glove Pallas kernel unavailable on this backend (%s); "
             "using the XLA path", e)
         ok = False
-    _PROBE_CACHE[block] = ok
+    _PROBE_CACHE[key] = ok
     return ok

@@ -241,16 +241,21 @@ def fused_chunk_update(syn0: Array, syn1: Array, syn1neg: Array,
 _PROBE_CACHE: dict = {}
 
 
-def probe_compile(block: int, use_hs: bool, negative: int) -> bool:
-    """One tiny real compile at the given statics — ``auto`` selection on
-    hardware goes through here so a Mosaic rejection degrades to the XLA
-    path instead of crashing fit() (explicit kernel='pallas' still
-    surfaces the error).  Cached per (process, statics)."""
-    key = (block, use_hs, negative)
+def probe_compile(block: int, use_hs: bool, negative: int,
+                  vocab_size: int = 128, dim: int = 8,
+                  hs_depth: int = 4) -> bool:
+    """One real compile at the given statics AND the caller's actual
+    table shapes — ``auto`` selection on hardware goes through here so a
+    Mosaic rejection degrades to the XLA path instead of crashing fit()
+    (explicit kernel='pallas' still surfaces the error).  Mosaic
+    acceptance and VMEM fit depend on (vocab, dim, Huffman depth), not
+    just the block statics, so the probe runs at the production shapes
+    and is cached per the full key."""
+    key = (block, use_hs, negative, vocab_size, dim, hs_depth)
     if key in _PROBE_CACHE:
         return _PROBE_CACHE[key]
     try:
-        V, D, L = 128, 8, 4
+        V, D, L = vocab_size, dim, max(hs_depth, 1)
         z = jnp.zeros
         _out = fused_chunk_update(
             z((V, D)), z((V, D)) if use_hs else z((1, D)),

@@ -309,6 +309,56 @@ def test_word2vec_multi_slab_streaming_and_replay(monkeypatch):
     np.testing.assert_array_equal(np.asarray(wv2.vectors), first)
 
 
+def test_word2vec_exact_pair_mode():
+    """pair_mode='exact' applies the window shrink host-side: the device
+    trains only surviving pairs (~(W+1)/2W of candidates), fresh per
+    epoch, and convergence quality matches the masked default."""
+    from deeplearning4j_tpu.nlp.word2vec import (_corpus_pair_blocks,
+                                                 corpus_pairs)
+
+    # pair-count: host shrink keeps ~ (W+1)/(2W) of the candidates
+    idx = [np.arange(50, dtype=np.int32) % 7 for _ in range(40)]
+    full = corpus_pairs(idx, window=5)[0].size
+    rng = np.random.RandomState(0)
+    kept = sum(b[0].size for b in _corpus_pair_blocks(idx, 5,
+                                                      shrink_rng=rng))
+    frac = kept / full
+    assert 0.45 < frac < 0.68, frac     # expectation 0.6 at W=5
+
+    base = dict(vector_size=48, window=3, epochs=30, alpha=0.05,
+                batch_size=128, negative=5, use_hs=True, seed=3)
+    w2v = Word2Vec(CORPUS, Word2VecConfig(**base, pair_mode="exact"))
+    wv = w2v.fit()
+    assert w2v._dev_cache is None        # no replay cache in exact mode
+    assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")
+    assert wv.similarity("king", "queen") > wv.similarity("king", "mouse")
+    # refits stream again deterministically
+    first = np.asarray(wv.vectors).copy()
+    wv2 = w2v.fit()
+    np.testing.assert_array_equal(np.asarray(wv2.vectors), first)
+
+    with pytest.raises(ValueError):
+        Word2Vec(CORPUS, Word2VecConfig(pair_mode="nope")).fit()
+
+
+def test_word2vec_exact_mode_with_depth_buckets(monkeypatch):
+    """exact mode + depth_buckets>1 drives the bucketed emit/record path
+    with slabs=None (per-bucket carry buffers, fresh ragged final slabs
+    each epoch) — the combination measure_tpu's exact_db2 A/B runs."""
+    from deeplearning4j_tpu.nlp import word2vec as w2v_mod
+
+    monkeypatch.setattr(w2v_mod, "PAIRS_PER_SLAB", 2048)   # force multi-slab
+    base = dict(vector_size=48, window=3, epochs=30, alpha=0.05,
+                batch_size=128, negative=5, use_hs=True, seed=3)
+    w2v = Word2Vec(CORPUS, Word2VecConfig(**base, pair_mode="exact",
+                                          depth_buckets=2))
+    wv = w2v.fit()
+    assert w2v._dev_cache is None
+    assert np.isfinite(np.asarray(wv.vectors)).all()
+    assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")
+    assert wv.similarity("king", "queen") > wv.similarity("king", "mouse")
+
+
 def test_word2vec_depth_buckets_semantics():
     """depth_buckets>1 slices the HS tables per center-depth bucket —
     exact semantics (masked levels are zeros), so convergence quality

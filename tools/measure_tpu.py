@@ -19,16 +19,20 @@ rng = np.random.RandomState(0)
 words = [f"w{i}" for i in range(2000)]
 p = 1.0 / np.arange(1, 2001) ** 1.05; p /= p.sum()
 sents = [" ".join(rng.choice(words, p=p, size=30)) for _ in range(1600)]
-for db in (1, 2, 3):
+for tag, kw in (("db1", dict(depth_buckets=1)),
+                ("db2", dict(depth_buckets=2)),
+                ("db3", dict(depth_buckets=3)),
+                ("exact", dict(pair_mode="exact")),
+                ("exact_db2", dict(pair_mode="exact", depth_buckets=2))):
     cfg = Word2VecConfig(vector_size=100, window=5, epochs=2, negative=5,
-                         use_hs=True, batch_size=16384, depth_buckets=db)
+                         use_hs=True, batch_size=16384, **kw)
     w = Word2Vec(sents, cfg); w.fit()
     float(np.asarray(w.syn0).ravel()[0])
     cold = Word2Vec(sents, cfg, cache=w.cache)
     t0 = time.perf_counter(); cold.fit()
     float(np.asarray(cold.syn0).ravel()[0])
     dt = time.perf_counter() - t0
-    print(f'{{"metric": "w2v_depth_buckets_{db}", '
+    print(f'{{"metric": "w2v_ab_{tag}", '
           f'"words_per_sec": {96000 / dt:.0f}}}')
 ''' % REPO
 
