@@ -53,6 +53,65 @@ def test_ring_attention_with_padding_mask():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_flash_at_sharded_T(causal):
+    """Three-way equivalence at sharded T: the seq-parallel ring, the
+    Pallas flash kernel (interpreter off-TPU) and plain XLA attention all
+    compute the same function — the long-context story's consistency
+    check (VERDICT r2 #8)."""
+    from deeplearning4j_tpu.ops import pallas_attention as pa
+
+    mesh = make_mesh(MeshSpec(data=1, seq=8))
+    q, k, v = _qkv(jax.random.key(3), B=1, T=1024, H=2, D=32)
+    ref = attention(q, k, v, None, causal=causal)
+    flash = pa.flash_attention(q, k, v, None, causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    spec = P(None, SEQ_AXIS, None, None)
+    f = shard_map(
+        lambda q, k, v: ra.ring_attention(q, k, v, None, causal, SEQ_AXIS),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    out = jax.jit(f)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_beyond_single_device_T(devices):
+    """Capability run at T=32768 over 8 seq shards: the full [T, T] logit
+    matrix would be 4 GB fp32 (infeasible to materialize), while the
+    ring's peak per-shard block is [Tq, Tk] = [4096, 4096] = 64 MB.
+    Correctness is spot-checked against a float64 numpy streaming
+    softmax on sampled query rows."""
+    T, H, D = 32768, 1, 16
+    mesh = make_mesh(MeshSpec(data=1, seq=8))
+    kq, kk, kv = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(kq, (1, T, H, D), jnp.float32)
+    k = jax.random.normal(kk, (1, T, H, D), jnp.float32)
+    v = jax.random.normal(kv, (1, T, H, D), jnp.float32)
+
+    spec = P(None, SEQ_AXIS, None, None)
+    f = shard_map(
+        lambda q, k, v: ra.ring_attention(q, k, v, None, True, SEQ_AXIS),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    out = np.asarray(jax.jit(f)(q, k, v))
+    assert out.shape == (1, T, H, D) and np.isfinite(out).all()
+
+    qn = np.asarray(q[0, :, 0, :], np.float64)
+    kn = np.asarray(k[0, :, 0, :], np.float64)
+    vn = np.asarray(v[0, :, 0, :], np.float64)
+    scale = 1.0 / np.sqrt(D)
+    # sample rows across shard boundaries incl. first/last
+    for i in (0, 1, 4095, 4096, 16384, 32767):
+        logits = (kn[:i + 1] @ qn[i]) * scale          # causal: keys <= i
+        w = np.exp(logits - logits.max())
+        expect = (w / w.sum()) @ vn[:i + 1]
+        np.testing.assert_allclose(out[0, i, 0], expect,
+                                   atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_reference(causal):
     mesh = make_mesh(MeshSpec(data=2, seq=4))
     q, k, v = _qkv(jax.random.key(2), T=32, H=4)

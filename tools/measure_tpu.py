@@ -39,7 +39,7 @@ for tag, kw in (("db1", dict(depth_buckets=1)),
 
 def main() -> None:
     for cfg in ("probe", "bert", "resnet", "word2vec", "glove", "longctx",
-                "lenet"):
+                "longctx32k", "lenet"):
         r = subprocess.run(
             [sys.executable, f"{REPO}/bench.py", cfg],
             capture_output=True, text=True, timeout=1800)
