@@ -309,6 +309,96 @@ def make_pipeline_train_step(cfg: TransformerConfig, mesh: Mesh,
 
 
 # ---------------------------------------------------------------------------
+# sequence-parallel training — ring attention on the REAL encoder stack
+# ---------------------------------------------------------------------------
+
+def make_sp_train_step(cfg: TransformerConfig, mesh: Mesh,
+                       optimizer: Optional[
+                           optax.GradientTransformation] = None
+                       ) -> Tuple[Callable, Callable]:
+    """dp×sp training step on the real BERT via ``shard_map``.
+
+    Sequence parallelism for contexts beyond one chip's memory: every
+    shard holds ``[B/dp, T/sp]`` tokens, embeds its slice with the
+    correct absolute position offset, and attention runs as RING
+    attention (parallel/ring_attention.py) — K/V blocks rotate around
+    the ``seq`` axis via ppermute while the online softmax accumulates,
+    so the full ``[T, T]`` score matrix never exists on any chip.  The
+    MLM head's ``[T, vocab]`` matmul also splits across seq shards; the
+    masked loss reduces with a psum over (data, seq).  Parameters stay
+    replicated (sp shards activations, not weights).
+
+    Dropout must be 0 (same convention as the pipeline step).  Parity of
+    rigor across the parallelism axes: tp (``make_train_step``), pp
+    (``make_pipeline_train_step``) and sp (this) all train the real
+    encoder stack.
+
+    Returns ``(init_fn(key) -> TrainState, step_fn(state, batch) ->
+    (state, loss))``, jitted with the dp/sp shardings baked in.
+    """
+    from jax import shard_map
+    from deeplearning4j_tpu.parallel import ring_attention as ra
+    from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS
+
+    optimizer = optimizer or optax.adamw(1e-4, weight_decay=0.01)
+    if cfg.dropout != 0.0:
+        raise ValueError(
+            f"sp train step is dropout-free; got cfg.dropout="
+            f"{cfg.dropout} (use dataclasses.replace(cfg, dropout=0.0))")
+
+    ring_fn = ra.make_ring_attn_fn(SEQ_AXIS)
+    bspec_tree = batch_spec()         # Batch(P(data, seq), ...) everywhere
+
+    def local_loss(params, batch: Batch) -> Array:
+        t_loc = batch.token_ids.shape[1]
+        off = jax.lax.axis_index(SEQ_AXIS) * t_loc
+        hidden = tfm.encode(cfg, params, batch.token_ids,
+                            batch.attention_mask, batch.type_ids,
+                            position_offset=off, attn_fn=ring_fn)
+        logits = mlm_logits(cfg, params, hidden)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, batch.labels[..., None],
+                                 axis=-1)[..., 0]
+        num = jax.lax.psum(-jnp.sum(ll * batch.mlm_mask),
+                           (DATA_AXIS, SEQ_AXIS))
+        den = jax.lax.psum(jnp.sum(batch.mlm_mask),
+                           (DATA_AXIS, SEQ_AXIS))
+        return num / jnp.maximum(den, 1.0)
+
+    sharded_loss = shard_map(
+        local_loss, mesh=mesh, in_specs=(P(), bspec_tree),
+        out_specs=P(), check_vma=False)
+
+    def init_fn(key: Array) -> TrainState:
+        params = init_params(key, cfg)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    def step_fn(state: TrainState, batch: Batch):
+        loss, grads = jax.value_and_grad(sharded_loss)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    rshard = NamedSharding(mesh, P())
+    params_shape = jax.eval_shape(
+        lambda: init_params(jax.random.key(0), cfg))
+    pshard = jax.tree.map(lambda _: rshard, params_shape)
+    oshard = _opt_state_shardings(optimizer, params_shape, pshard, mesh)
+    state_shard = TrainState(params=pshard, opt_state=oshard, step=rshard)
+    bshard = jax.tree.map(lambda s: NamedSharding(mesh, s), bspec_tree,
+                          is_leaf=lambda s: isinstance(s, P))
+
+    jit_init = jax.jit(init_fn, out_shardings=state_shard)
+    jit_step = jax.jit(step_fn,
+                       in_shardings=(state_shard, bshard),
+                       out_shardings=(state_shard, rshard),
+                       donate_argnums=(0,))
+    return jit_init, jit_step
+
+
+# ---------------------------------------------------------------------------
 # synthetic MLM batch for tests/bench
 # ---------------------------------------------------------------------------
 

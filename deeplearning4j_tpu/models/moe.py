@@ -1,0 +1,258 @@
+"""Mixture-of-Experts transformer LM — expert parallelism on a REAL model.
+
+New capability with no reference counterpart (SURVEY.md §2.9: the
+reference has no attention, let alone MoE).  The layer mechanics live in
+parallel/expert.py (GShard/Switch-style top-k router, capacity slots,
+all_to_all dispatch over the mesh ``expert`` axis); this module lifts
+them into a trainable causal-LM family so expert parallelism gets the
+same rigor as the other axes (tp/pp/sp all train the real encoder —
+models/bert.py).
+
+Design (TPU-first):
+- Blocks scan over stacked [L, ...] params (one compiled body, remat-able)
+  exactly like models/transformer.py; attention is the shared
+  ``tfm.attention`` (causal).
+- Each block's FFN is an MoE layer: tokens [b·T, H] route to
+  ``n_experts`` experts; under a mesh with an ``expert`` axis the whole
+  train step runs in ONE shard_map over (data, expert) — tokens shard
+  over both axes (attention is per-example, so it needs no collectives),
+  expert weights shard over ``expert``, and only the MoE dispatch
+  all_to_alls cross shards.
+- Switch load-balance aux loss accumulates across layers and is averaged
+  into the objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models import transformer as tfm
+from deeplearning4j_tpu.parallel.expert import MoEConfig, moe_ffn
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
+
+Array = jax.Array
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig:
+    vocab_size: int = 256
+    max_len: int = 128
+    hidden: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 128                 # per-expert FFN width
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    layer_norm_eps: float = 1e-12
+    compute_dtype: str = "bfloat16"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden % self.n_heads == 0
+        return self.hidden // self.n_heads
+
+    @property
+    def moe(self) -> MoEConfig:
+        return MoEConfig(n_experts=self.n_experts, top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         d_model=self.hidden, d_ff=self.d_ff,
+                         aux_loss_weight=self.aux_loss_weight)
+
+
+def init_params(key: Array, cfg: MoETransformerConfig) -> PyTree:
+    ks = jax.random.split(key, 12)
+    H, L, NH, D = cfg.hidden, cfg.n_layers, cfg.n_heads, cfg.head_dim
+    E, F = cfg.n_experts, cfg.d_ff
+
+    def stack(fn, k):
+        return jax.vmap(fn)(jax.random.split(k, L))
+
+    tn = tfm._trunc_normal
+    embed = {"tok": tn(ks[0], (cfg.vocab_size, H)),
+             "pos": tn(ks[1], (cfg.max_len, H)),
+             "ln_g": jnp.ones((H,)), "ln_b": jnp.zeros((H,))}
+    blocks = {
+        "wq": stack(lambda k: tn(k, (H, NH, D)), ks[2]),
+        "wk": stack(lambda k: tn(k, (H, NH, D)), ks[3]),
+        "wv": stack(lambda k: tn(k, (H, NH, D)), ks[4]),
+        "wo": stack(lambda k: tn(k, (NH, D, H)), ks[5]),
+        "bq": jnp.zeros((L, NH, D)), "bk": jnp.zeros((L, NH, D)),
+        "bv": jnp.zeros((L, NH, D)), "bo": jnp.zeros((L, H)),
+        "ln1_g": jnp.ones((L, H)), "ln1_b": jnp.zeros((L, H)),
+        "ln2_g": jnp.ones((L, H)), "ln2_b": jnp.zeros((L, H)),
+        # MoE FFN per layer
+        "router": stack(lambda k: tn(k, (H, E)), ks[6]),
+        "wi": stack(lambda k: jax.random.normal(k, (E, H, F))
+                    * (1.0 / jnp.sqrt(H)), ks[7]),
+        "wo_e": stack(lambda k: jax.random.normal(k, (E, F, H))
+                      * (1.0 / jnp.sqrt(F)), ks[8]),
+    }
+    return {"embed": embed, "blocks": blocks}
+
+
+def param_specs(cfg: MoETransformerConfig) -> PyTree:
+    """shard_map in_specs: expert tables shard over ``expert`` (their
+    memory is the point of ep), everything else replicated."""
+    e = EXPERT_AXIS
+    blocks = {k: P() for k in ("wq", "wk", "wv", "wo", "bq", "bk", "bv",
+                               "bo", "ln1_g", "ln1_b", "ln2_g", "ln2_b",
+                               "router")}
+    blocks["wi"] = P(None, e)
+    blocks["wo_e"] = P(None, e)
+    embed = {"tok": P(), "pos": P(), "ln_g": P(), "ln_b": P()}
+    return {"embed": embed, "blocks": blocks}
+
+
+def _block(cfg: MoETransformerConfig, x: Array, p: dict,
+           moe_axis: Optional[str],
+           stat_axes: Tuple[str, ...] = ()) -> Tuple[Array, Array]:
+    """One pre-LN-free (post-LN, BERT convention) causal block with an
+    MoE FFN: x [b, T, H] fp32 -> (x', aux_loss)."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    h = x.astype(cdt)
+    q = jnp.einsum("bth,hnd->btnd", h, p["wq"].astype(cdt),
+                   preferred_element_type=jnp.float32) + p["bq"]
+    k = jnp.einsum("bth,hnd->btnd", h, p["wk"].astype(cdt),
+                   preferred_element_type=jnp.float32) + p["bk"]
+    v = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
+                   preferred_element_type=jnp.float32) + p["bv"]
+    a = tfm.attention(q.astype(cdt), k.astype(cdt), v.astype(cdt),
+                      None, causal=True)
+    a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
+                   preferred_element_type=jnp.float32) + p["bo"]
+    x = tfm.layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+
+    b, T, H = x.shape
+    tok = x.reshape(b * T, H).astype(cdt)
+    y, aux = moe_ffn({"router": p["router"], "wi": p["wi"],
+                      "wo": p["wo_e"]}, tok, cfg.moe, axis_name=moe_axis,
+                     stat_axes=stat_axes)
+    x = tfm.layer_norm(x + y.reshape(b, T, H).astype(jnp.float32),
+                       p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
+    return x, aux
+
+
+def encode(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
+           moe_axis: Optional[str] = None,
+           stat_axes: Tuple[str, ...] = ()) -> Tuple[Array, Array]:
+    """ids [b, T] -> (hidden [b, T, H] fp32, mean aux loss over layers)."""
+    e = params["embed"]
+    T = token_ids.shape[-1]
+    x = e["tok"][token_ids] + e["pos"][:T]
+    x = tfm.layer_norm(x, e["ln_g"], e["ln_b"], cfg.layer_norm_eps)
+
+    def body(x, p):
+        return _block(cfg, x, p, moe_axis, stat_axes)
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, auxs = lax.scan(body, x, params["blocks"])
+    return x, jnp.mean(auxs)
+
+
+def lm_loss(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
+            moe_axis: Optional[str] = None,
+            stat_axes: Tuple[str, ...] = ()) -> Array:
+    """Causal next-token CE + weighted load-balance aux.  Under token
+    sharding pass ``stat_axes`` so the aux forms from globally pmean-ed
+    routing statistics (the Switch aux is nonlinear in them — a mean of
+    per-shard aux values is NOT the global aux); the CE term is a
+    per-shard mean over equal-sized shards, so a cross-shard pmean of the
+    returned value is then exactly the un-sharded loss."""
+    hidden, aux = encode(cfg, params, token_ids, moe_axis, stat_axes)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    logits = jnp.einsum("bth,vh->btv", hidden.astype(cdt),
+                        params["embed"]["tok"].astype(cdt),
+                        preferred_element_type=jnp.float32)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    ll = jnp.take_along_axis(logp, token_ids[:, 1:, None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + cfg.aux_loss_weight * aux
+
+
+class TrainState(NamedTuple):
+    params: PyTree
+    opt_state: PyTree
+    step: Array
+
+
+def make_train_step(cfg: MoETransformerConfig, mesh: Mesh,
+                    optimizer: Optional[optax.GradientTransformation] = None
+                    ) -> Tuple[Callable, Callable]:
+    """dp×ep training step: ONE shard_map over (data, expert) — tokens
+    shard over both axes (attention stays local), expert weights shard
+    over ``expert``, MoE dispatch all_to_alls between shards, loss pmeans
+    across the mesh.  Without an ``expert`` axis (size 1) the same code
+    runs the single-shard MoE math.
+
+    Returns ``(init_fn(key) -> TrainState, step_fn(state, ids) ->
+    (state, loss))`` jitted with shardings baked in (expert tables REMAIN
+    sharded in the optimizer state — the ep memory win).
+    """
+    from jax import shard_map
+
+    optimizer = optimizer or optax.adamw(1e-3, weight_decay=0.01)
+    ep = mesh.shape.get(EXPERT_AXIS, 1)
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
+                         f"expert degree {ep}")
+    moe_axis = EXPERT_AXIS if ep > 1 else None
+    tok_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS)
+                     if mesh.shape.get(a, 1) > 1)
+    bspec = P(tok_axes if tok_axes else None, None)
+    pspecs = param_specs(cfg)
+
+    def local_loss(params, ids):
+        loss = lm_loss(cfg, params, ids, moe_axis, stat_axes=tok_axes)
+        for ax in tok_axes:
+            loss = lax.pmean(loss, ax)
+        return loss
+
+    sharded_loss = shard_map(local_loss, mesh=mesh,
+                             in_specs=(pspecs, bspec), out_specs=P(),
+                             check_vma=False)
+
+    def init_fn(key: Array) -> TrainState:
+        params = init_params(key, cfg)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    def step_fn(state: TrainState, ids: Array):
+        loss, grads = jax.value_and_grad(sharded_loss)(state.params, ids)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                          is_leaf=lambda s: isinstance(s, P))
+    params_shape = jax.eval_shape(
+        lambda: init_params(jax.random.key(0), cfg))
+    from deeplearning4j_tpu.models.bert import _opt_state_shardings
+    oshard = _opt_state_shardings(optimizer, params_shape, pshard, mesh)
+    state_shard = TrainState(params=pshard, opt_state=oshard,
+                             step=NamedSharding(mesh, P()))
+    bshard = NamedSharding(mesh, bspec)
+
+    jit_init = jax.jit(init_fn, out_shardings=state_shard)
+    jit_step = jax.jit(step_fn,
+                       in_shardings=(state_shard, bshard),
+                       out_shardings=(state_shard, NamedSharding(mesh, P())),
+                       donate_argnums=(0,))
+    return jit_init, jit_step
+
+
+def synthetic_ids(key: Array, cfg: MoETransformerConfig, batch: int,
+                  seq_len: int) -> Array:
+    return jax.random.randint(key, (batch, seq_len), 0, cfg.vocab_size,
+                              dtype=jnp.int32)

@@ -59,12 +59,19 @@ def compute_capacity(n_tokens: int, n_experts: int, top_k: int,
     return max(c, 1)
 
 
-def route_topk(gates: Array, top_k: int, capacity: int
+def route_topk(gates: Array, top_k: int, capacity: int,
+               stat_axes: Tuple[str, ...] = ()
                ) -> Tuple[Array, Array, Array]:
     """Top-k routing with per-expert capacity.
 
     gates: [N, E] router probabilities.  Returns (dispatch [N,E,C] {0,1},
     combine [N,E,C] gate-weighted, aux_loss scalar).
+
+    ``stat_axes``: mesh axes the token batch is sharded over.  The Switch
+    aux loss is NONLINEAR in the routing statistics (f_e · p_e), so a
+    mean of per-shard aux values is not the global aux; pmean-ing f_e and
+    p_e over the token shards first (equal shard sizes → global means)
+    makes the sharded aux exactly equal the pooled-token computation.
     """
     N, E = gates.shape
     topv, topi = lax.top_k(gates, top_k)                # [N, k]
@@ -95,6 +102,9 @@ def route_topk(gates: Array, top_k: int, capacity: int
     # accumulated in f32 (a bf16 sum over N tokens is equally lossy).
     f_e = jnp.sum(masks.sum(1), axis=0).astype(jnp.float32) / (N * top_k)
     p_e = jnp.mean(gates.astype(jnp.float32), axis=0)        # [E]
+    for ax in stat_axes:
+        f_e = lax.pmean(f_e, ax)
+        p_e = lax.pmean(p_e, ax)
     aux = E * jnp.sum(f_e * p_e)
     return dispatch, combine, aux
 
@@ -108,13 +118,16 @@ def _expert_ffn(wi: Array, wo: Array, x: Array) -> Array:
 
 
 def moe_ffn(params: dict, x: Array, cfg: MoEConfig,
-            axis_name: Optional[str] = None) -> Tuple[Array, Array]:
+            axis_name: Optional[str] = None,
+            stat_axes: Tuple[str, ...] = ()) -> Tuple[Array, Array]:
     """MoE FFN over tokens x [N, d] -> (y [N, d], aux_loss).
 
     When ``axis_name`` is given (running inside shard_map), x holds this
     shard's N local tokens and params hold the LOCAL experts
     ``[E/ep, ...]``; dispatch crosses shards via all_to_all.  The router
-    table is replicated.
+    table is replicated.  ``stat_axes`` reduces the aux-loss routing
+    statistics across token shards first (see route_topk) so the sharded
+    aux equals the pooled computation exactly.
     """
     N, d = x.shape
     E = cfg.n_experts
@@ -123,7 +136,7 @@ def moe_ffn(params: dict, x: Array, cfg: MoEConfig,
                    preferred_element_type=jnp.float32), axis=-1
     ).astype(x.dtype)
     C = compute_capacity(N, E, cfg.top_k, cfg.capacity_factor)
-    dispatch, combine, aux = route_topk(gates, cfg.top_k, C)
+    dispatch, combine, aux = route_topk(gates, cfg.top_k, C, stat_axes)
 
     # [N,E,C] x [N,d] -> [E,C,d] expert inboxes
     inbox = jnp.einsum("nec,nd->ecd", dispatch, x)

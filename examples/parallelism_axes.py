@@ -1,0 +1,74 @@
+"""Every parallelism axis training the REAL model on one mesh layout each.
+
+Run on any machine:  python tools/run_cpu.py 8 examples/parallelism_axes.py
+(8 virtual CPU devices) — the same code runs unchanged on a TPU slice.
+
+- dp x tp : BERT, param specs over `model` (XLA inserts the collectives)
+- dp x pp : BERT, blocks staged over `pipe` (GPipe microbatch ring)
+- dp x sp : BERT, ring attention rotating K/V over `seq`
+- dp x ep : MoE transformer LM, expert tables sharded over `expert`
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                    # noqa: E402
+import jax.numpy as jnp                                       # noqa: E402
+from deeplearning4j_tpu.models import bert, moe               # noqa: E402
+from deeplearning4j_tpu.models import transformer as tfm      # noqa: E402
+from deeplearning4j_tpu.parallel.mesh import (MeshSpec,       # noqa: E402
+                                              make_mesh)
+
+
+def tiny(n_layers=2, max_len=32):
+    return tfm.TransformerConfig(vocab_size=256, max_len=max_len,
+                                 hidden=32, n_layers=n_layers, n_heads=4,
+                                 ffn_dim=64, dropout=0.0)
+
+
+def main() -> None:
+    devs = jax.devices()
+    assert len(devs) >= 8, "run via: python tools/run_cpu.py 8 examples/..."
+    devs = devs[:8]
+
+    # dp=4 x tp=2 — tensor parallel heads/ffn
+    mesh = make_mesh(MeshSpec(data=4, model=2), devices=devs)
+    cfg = tiny()
+    init_fn, step_fn = bert.make_train_step(cfg, mesh)
+    state = init_fn(jax.random.key(0))
+    batch = bert.synthetic_batch(jax.random.key(1), cfg, 8, 32)
+    state, loss = step_fn(state, batch, jax.random.key(2))
+    print(f"dp4 x tp2  BERT loss {float(loss):.4f}")
+
+    # dp=2 x pp=4 — GPipe pipeline over the same blocks
+    mesh = make_mesh(MeshSpec(data=2, pipe=4), devices=devs)
+    cfg = tiny(n_layers=4)
+    init_fn, step_fn = bert.make_pipeline_train_step(cfg, mesh, n_micro=2)
+    state = init_fn(jax.random.key(3))
+    state, loss = step_fn(state, batch)
+    print(f"dp2 x pp4  BERT loss {float(loss):.4f}")
+
+    # dp=2 x sp=4 — ring attention over the sequence
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devs)
+    init_fn, step_fn = bert.make_sp_train_step(cfg, mesh)
+    state = init_fn(jax.random.key(4))
+    state, loss = step_fn(state, batch)
+    print(f"dp2 x sp4  BERT loss {float(loss):.4f}")
+
+    # dp=2 x ep=4 — MoE transformer, experts sharded
+    mesh = make_mesh(MeshSpec(data=2, expert=4), devices=devs)
+    mcfg = moe.MoETransformerConfig(vocab_size=256, max_len=32, hidden=32,
+                                    n_layers=2, n_heads=4, d_ff=64,
+                                    n_experts=8, top_k=2)
+    init_fn, step_fn = moe.make_train_step(mcfg, mesh)
+    state = init_fn(jax.random.key(5))
+    ids = moe.synthetic_ids(jax.random.key(6), mcfg, 8, 32)
+    state, loss = step_fn(state, ids)
+    print(f"dp2 x ep4  MoE-LM loss {float(loss):.4f}")
+    assert jnp.isfinite(loss)
+    print("all parallelism axes OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -113,3 +113,53 @@ def test_route_topk_bf16_no_slot_collisions():
     dispatch, _, _ = ex.route_topk(gates, k, capacity=400)
     per_slot = np.asarray(jnp.sum(dispatch.astype(jnp.float32), axis=0))
     assert per_slot.max() <= 1.0 + 1e-6, f"slot collision: {per_slot.max()}"
+
+
+# -- MoE transformer LM (models/moe.py): ep on a REAL model -----------------
+
+def test_moe_transformer_sharded_matches_single(devices):
+    """dp=2 x ep=4 MoE-LM loss == the un-sharded computation on identical
+    params when capacity is generous enough that no token drops (slot
+    arrangement differs between layouts, but combine sums over slots)."""
+    from deeplearning4j_tpu.models import moe
+
+    cfg = moe.MoETransformerConfig(
+        vocab_size=128, max_len=32, hidden=32, n_layers=2, n_heads=4,
+        d_ff=64, n_experts=8, top_k=2,
+        capacity_factor=8.0,            # C >= k*N: nothing ever drops
+        compute_dtype="float32")
+    params = moe.init_params(jax.random.key(0), cfg)
+    ids = moe.synthetic_ids(jax.random.key(1), cfg, 8, 32)
+    ref = float(moe.lm_loss(cfg, params, ids, moe_axis=None))
+
+    import optax
+    mesh = make_mesh(MeshSpec(data=2, expert=4), devices=devices[:8])
+    opt = optax.sgd(1e-2)
+    _, step_fn = moe.make_train_step(cfg, mesh, optimizer=opt)
+    state = moe.TrainState(params, opt.init(params),
+                           jnp.zeros((), jnp.int32))
+    state, loss = step_fn(state, ids)
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_moe_transformer_trains(devices):
+    """dp=2 x ep=4 MoE-LM training: loss decreases, aux keeps routing
+    balanced enough that training stays finite at tight capacity."""
+    from deeplearning4j_tpu.models import moe
+
+    cfg = moe.MoETransformerConfig(
+        vocab_size=64, max_len=32, hidden=32, n_layers=2, n_heads=4,
+        d_ff=64, n_experts=8, top_k=2, capacity_factor=1.5)
+    mesh = make_mesh(MeshSpec(data=2, expert=4), devices=devices[:8])
+    init_fn, step_fn = moe.make_train_step(cfg, mesh)
+    state = init_fn(jax.random.key(2))
+    ids = moe.synthetic_ids(jax.random.key(3), cfg, 8, 32)
+    losses = []
+    for _ in range(10):
+        state, loss = step_fn(state, ids)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # expert tables really stayed sharded over the expert axis
+    wi = state.params["blocks"]["wi"]
+    assert "expert" in str(wi.sharding.spec), wi.sharding
