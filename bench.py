@@ -620,18 +620,54 @@ def bench_glove(n_sentences: int = 1600, sent_len: int = 30,
     _value_sync(g2.state[0])
     dt = time.perf_counter() - t0
     n_triples = triples[0].size * epochs
+    tps = n_triples / dt
+
+    # Throughput anchor, measured here on the same data: the reference's
+    # per-cooccurrence update structure (GloVe.java iterates triples one
+    # at a time, a chain of length-D vector ops + AdaGrad history per
+    # triple) as a single-thread numpy loop.  No published number exists,
+    # so this gives vs_baseline a genuine throughput denominator instead
+    # of the old loss-reduction factor.
+    rows, cols, counts = (np.asarray(a) for a in triples)
+    D = cfg.vector_size
+    sample = min(int(rows.size), 20000)
+    W = rng.randn(vocab, D).astype(np.float32) * 0.01
+    bb = np.zeros(vocab, np.float32)
+    hW = np.full((vocab, D), 1e-8, np.float32)
+    hb = np.full(vocab, 1e-8, np.float32)
+    lr, x_max, alpha_p = 0.05, 100.0, 0.75
+    t0 = time.perf_counter()
+    for i in range(sample):
+        w1, w2, x = int(rows[i]), int(cols[i]), float(counts[i])
+        wgt = 1.0 if x >= x_max else (x / x_max) ** alpha_p
+        f = wgt * (W[w1] @ W[w2] + bb[w1] + bb[w2] - np.log(x))
+        g1 = f * W[w2]
+        g2_ = f * W[w1]
+        hW[w1] += g1 * g1
+        hW[w2] += g2_ * g2_
+        W[w1] -= lr * g1 / np.sqrt(hW[w1])
+        W[w2] -= lr * g2_ / np.sqrt(hW[w2])
+        hb[w1] += f * f
+        hb[w2] += f * f
+        bb[w1] -= lr * f / np.sqrt(hb[w1])
+        bb[w2] -= lr * f / np.sqrt(hb[w2])
+    anchor_tps = sample / (time.perf_counter() - t0)
+
     return {
         "metric": "glove_adagrad_wls_train_triples_per_sec",
-        "value": round(n_triples / dt, 1),
+        "value": round(tps, 1),
         "unit": "triples/sec",
-        "vs_baseline": round(g2.losses[0] / max(g2.losses[-1], 1e-9), 2),
+        "vs_baseline": round(tps / anchor_tps, 2),
         "platform": platform,
         "n_devices": n_dev,
         "config_sig": f"n{n_sentences}x{sent_len}_v{vocab}_e{epochs}",
         "unique_triples": int(triples[0].size),
         "final_loss": round(g2.losses[-1], 4),
-        "note": "vs_baseline = loss-reduction factor (no published "
-                "reference number exists)",
+        "loss_reduction": round(g2.losses[0] / max(g2.losses[-1], 1e-9), 2),
+        "anchor_triples_per_sec": round(anchor_tps, 1),
+        "note": "vs_baseline = throughput vs a single-thread numpy "
+                "per-triple loop (the reference's update structure) "
+                "measured on this host",
     }
 
 

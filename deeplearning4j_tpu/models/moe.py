@@ -56,6 +56,8 @@ class MoETransformerConfig:
     layer_norm_eps: float = 1e-12
     compute_dtype: str = "bfloat16"
     remat: bool = True
+    causal: bool = True             # LM convention
+    dropout: float = 0.0            # shared attention sublayer contract
 
     @property
     def head_dim(self) -> int:
@@ -117,21 +119,11 @@ def param_specs(cfg: MoETransformerConfig) -> PyTree:
 def _block(cfg: MoETransformerConfig, x: Array, p: dict,
            moe_axis: Optional[str],
            stat_axes: Tuple[str, ...] = ()) -> Tuple[Array, Array]:
-    """One pre-LN-free (post-LN, BERT convention) causal block with an
-    MoE FFN: x [b, T, H] fp32 -> (x', aux_loss)."""
+    """One post-LN (BERT convention) causal block with an MoE FFN:
+    x [b, T, H] fp32 -> (x', aux_loss).  The attention half is the
+    shared ``tfm._attention_sublayer``; only the FFN differs."""
     cdt = jnp.dtype(cfg.compute_dtype)
-    h = x.astype(cdt)
-    q = jnp.einsum("bth,hnd->btnd", h, p["wq"].astype(cdt),
-                   preferred_element_type=jnp.float32) + p["bq"]
-    k = jnp.einsum("bth,hnd->btnd", h, p["wk"].astype(cdt),
-                   preferred_element_type=jnp.float32) + p["bk"]
-    v = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
-                   preferred_element_type=jnp.float32) + p["bv"]
-    a = tfm.attention(q.astype(cdt), k.astype(cdt), v.astype(cdt),
-                      None, causal=True)
-    a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
-                   preferred_element_type=jnp.float32) + p["bo"]
-    x = tfm.layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+    x, _ = tfm._attention_sublayer(cfg, x, p, None, None)
 
     b, T, H = x.shape
     tok = x.reshape(b * T, H).astype(cdt)

@@ -159,10 +159,15 @@ def attention(q: Array, k: Array, v: Array, mask: Optional[Array],
                       preferred_element_type=jnp.float32).astype(cdt)
 
 
-def _block(cfg: TransformerConfig, x: Array, p: Dict[str, Array],
-           mask: Optional[Array], dropout_key: Optional[Array],
-           attn_fn=attention) -> Array:
-    """One post-LN encoder block (BERT convention): x [B, T, H] fp32."""
+def _attention_sublayer(cfg, x: Array, p: Dict[str, Array],
+                        mask: Optional[Array],
+                        dropout_key: Optional[Array],
+                        attn_fn=attention) -> Tuple[Array, Optional[Array]]:
+    """Attention + residual + post-LN — the first half of an encoder
+    block, shared by the dense-FFN block below and the MoE-FFN block
+    (models/moe.py).  ``cfg`` needs compute_dtype/causal/dropout/
+    layer_norm_eps (TransformerConfig or MoETransformerConfig).  Returns
+    (x', ffn_dropout_key)."""
     cdt = jnp.dtype(cfg.compute_dtype)
     h = x.astype(cdt)
 
@@ -182,7 +187,16 @@ def _block(cfg: TransformerConfig, x: Array, p: Dict[str, Array],
         a = a * jax.random.bernoulli(dk1, keep, a.shape) / keep
     else:
         dk2 = None
-    x = layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+    return layer_norm(x + a, p["ln1_g"], p["ln1_b"],
+                      cfg.layer_norm_eps), dk2
+
+
+def _block(cfg: TransformerConfig, x: Array, p: Dict[str, Array],
+           mask: Optional[Array], dropout_key: Optional[Array],
+           attn_fn=attention) -> Array:
+    """One post-LN encoder block (BERT convention): x [B, T, H] fp32."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    x, dk2 = _attention_sublayer(cfg, x, p, mask, dropout_key, attn_fn)
 
     h = x.astype(cdt)
     f = jnp.einsum("bth,hf->btf", h, p["w1"].astype(cdt),

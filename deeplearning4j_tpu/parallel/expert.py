@@ -176,7 +176,6 @@ def make_moe_layer(mesh: Mesh, cfg: MoEConfig):
             return moe_ffn(params, x, cfg, axis_name=None)
         return apply
 
-    dp = mesh.shape.get(DATA_AXIS, 1)
     # Tokens shard over BOTH data and expert axes: with tokens only on
     # ``data``, every expert shard would route the identical token set and
     # do the full single-device FFN FLOPs — expert parallelism would save
@@ -189,10 +188,13 @@ def make_moe_layer(mesh: Mesh, cfg: MoEConfig):
     pspec = expert_param_specs(cfg)
 
     def inner(params, x):
-        y, aux = moe_ffn(params, x, cfg, axis_name=EXPERT_AXIS)
-        if dp > 1:
-            aux = lax.pmean(aux, DATA_AXIS)
-        aux = lax.pmean(aux, EXPERT_AXIS)
+        # aux forms from routing stats pmean-ed across the token shards
+        # (route_topk docstring: the aux is nonlinear in them, so this —
+        # not a pmean of per-shard aux values — matches the pooled-token
+        # computation); the returned scalar is already identical on all
+        # shards.
+        y, aux = moe_ffn(params, x, cfg, axis_name=EXPERT_AXIS,
+                         stat_axes=tok_axes)
         return y, aux
 
     return shard_map(inner, mesh=mesh, in_specs=(pspec, tok_spec),
