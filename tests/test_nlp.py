@@ -375,3 +375,37 @@ def test_word2vec_depth_buckets_semantics():
         assert wv.similarity("king", "queen") > wv.similarity("king",
                                                               "mouse")
     assert np.isfinite(np.asarray(wv2.vectors)).all()
+
+
+def test_word2vec_real_corpus_tier():
+    """Quality tier over a REAL local text corpus (text8-style plain
+    text) — skipped when absent, like the real-MNIST/LFW tiers.  Set
+    $TEXT_CORPUS or drop a file at ./data/text8."""
+    import os
+
+    path = os.environ.get("TEXT_CORPUS")
+    if not path:
+        for c in ("data/text8", os.path.expanduser("~/.dl4j-tpu/text8")):
+            if os.path.isfile(c):
+                path = c
+                break
+    if not path or not os.path.isfile(path):
+        pytest.skip("no local text corpus (set TEXT_CORPUS to enable)")
+
+    with open(path) as f:
+        text = f.read(2_000_000)            # first ~2 MB
+    words = text.split()
+    sents = [" ".join(words[i:i + 50]) for i in range(0, len(words), 50)]
+    cfg = Word2VecConfig(vector_size=64, window=5, epochs=2, negative=5,
+                         use_hs=True, min_word_frequency=5,
+                         batch_size=8192, pair_mode="exact")
+    wv = Word2Vec(sents, cfg).fit()
+    assert len(wv.cache) > 1000
+    # frequent function words should have sane neighbors (non-empty,
+    # finite similarity structure)
+    probe = next((w for w in ("the", "of", "and", "one")
+                  if w in wv.cache.vocab), None)
+    if probe is None:                       # non-English corpus: fall back
+        probe = wv.cache.word_for(0)        # to the most frequent word
+    near = wv.words_nearest(probe, 5)
+    assert len(near) == 5 and all(np.isfinite(s) for _, s in near)
