@@ -319,21 +319,29 @@ def bench_word2vec(n_sentences: int = 1600, sent_len: int = 30,
     total_words = n_sentences * sent_len * epochs
 
     # large chunks amortize per-dispatch latency (tunneled TPU); the
-    # per-row mean normalization in the update keeps big batches stable
-    cfg = Word2VecConfig(vector_size=100, window=5, epochs=epochs,
-                         negative=5, use_hs=True, batch_size=16384)
-    w2v = Word2Vec(sentences, cfg)
-    w2v.fit()          # warmup: compiles the HS/neg-sampling kernels
-    _value_sync(w2v.syn0)
-    # measured: a COLD fit (fresh instance, prebuilt vocab) — pays
-    # indexing + pair generation, which epoch 0 overlaps with async
-    # device dispatch; compiled executables are process-cached
-    cold = Word2Vec(sentences, cfg, cache=w2v.cache)
-    t0 = time.perf_counter()
-    cold.fit()
-    _value_sync(cold.syn0)
-    dt = time.perf_counter() - t0
-    wps = total_words / dt
+    # per-row mean normalization in the update keeps big batches stable.
+    # Measure BOTH pair modes cold (fresh instance, prebuilt vocab — pays
+    # indexing + pair generation, overlapped with epoch-0 dispatch) and
+    # report the faster as the headline: "masked" replays cached device
+    # slabs across epochs but trains ~1.8x the pairs; "exact" streams
+    # host-shrunk pairs every epoch (the reference's own algorithm order).
+    results = {}
+    cache = None
+    for mode in ("masked", "exact"):
+        cfg = Word2VecConfig(vector_size=100, window=5, epochs=epochs,
+                             negative=5, use_hs=True, batch_size=16384,
+                             pair_mode=mode)
+        warm = Word2Vec(sentences, cfg, cache=cache)
+        warm.fit()                         # compile + vocab build
+        _value_sync(warm.syn0)
+        cache = warm.cache
+        cold = Word2Vec(sentences, cfg, cache=cache)
+        t0 = time.perf_counter()
+        cold.fit()
+        _value_sync(cold.syn0)
+        results[mode] = total_words / (time.perf_counter() - t0)
+    best = max(results, key=results.get)
+    wps = results[best]
     return {
         "metric": "word2vec_hs_neg5_train_words_per_sec",
         "value": round(wps, 1),
@@ -343,6 +351,9 @@ def bench_word2vec(n_sentences: int = 1600, sent_len: int = 30,
         "n_devices": n_dev,
         "config_sig": f"n{n_sentences}x{sent_len}_v{vocab}_e{epochs}",
         "total_words": total_words,
+        "pair_mode": best,
+        "words_per_sec_masked": round(results["masked"], 1),
+        "words_per_sec_exact": round(results["exact"], 1),
     }
 
 
@@ -692,7 +703,9 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
 TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
-            "lenet": (600, 420), "word2vec": (600, 420),
+            "lenet": (600, 420),
+            # word2vec runs warm+cold for BOTH pair modes (4 fits)
+            "word2vec": (1200, 600),
             "scaling": (0, 600), "longctx": (720, 420),
             "longctx32k": (1200, 0), "glove": (600, 420)}
 
@@ -758,6 +771,15 @@ def _flag_regressions(out: dict) -> None:
         if e["value"] < 0.9 * p["value"]:
             e["regressed"] = True
             e["prev_value"] = p["value"]
+        # a best-of-variants headline can mask a single variant's decay:
+        # also compare any shared per-variant sub-measurements
+        dropped = [k for k, v in e.items()
+                   if k.startswith("words_per_sec_")
+                   and isinstance(v, (int, float))
+                   and isinstance(p.get(k), (int, float))
+                   and p[k] and v < 0.9 * p[k]]
+        if dropped:
+            e["regressed_fields"] = dropped
 
     check(out)
     for e in (out.get("suite") or {}).values():
