@@ -66,12 +66,20 @@ def render_launch_script(spec: TpuPodSpec, train_cmd: str,
                          coordinator_port: int = 8476) -> str:
     """Run ``train_cmd`` on EVERY host (HostProvisioner/
     DistributedDeepLearningTrainer equivalent).  gcloud's --worker=all is
-    the jsch loop; JAX process wiring comes from env vars consumed by
-    parallel/mesh.initialize_distributed."""
-    env = dict(spec.env)
-    env.setdefault("DL4J_TPU_COORDINATOR_PORT", str(coordinator_port))
-    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-    inner = f"{exports} {train_cmd}".strip()
+    the jsch loop; JAX process wiring comes from the DL4J_TPU_* env vars
+    consumed by parallel/mesh.initialize_from_env (exercised for real by
+    the executable localhost simulation, render_local_launch_script)."""
+    exports = " ".join(f"{k}={shlex.quote(v)}"
+                       for k, v in spec.env.items())
+    # the wiring trio initialize_from_env consumes, derived on each host
+    # from the TPU-VM environment (worker 0's hostname is the
+    # coordinator; TPU_WORKER_ID is this host's rank) — expanded by the
+    # REMOTE shell, which is why the $ stays quoted here
+    wiring = (f'export DL4J_TPU_COORDINATOR='
+              f'"${{TPU_WORKER_HOSTNAMES%%,*}}:{coordinator_port}" '
+              f'DL4J_TPU_NUM_PROCESSES={spec.n_hosts} '
+              f'DL4J_TPU_PROCESS_ID="${{TPU_WORKER_ID}}"')
+    inner = f"{wiring}; {exports} {train_cmd}".strip()
     args = [
         "gcloud", "compute", "tpus", "tpu-vm", "ssh", spec.name,
         f"--zone={spec.zone}", "--worker=all",
@@ -82,6 +90,40 @@ def render_launch_script(spec: TpuPodSpec, train_cmd: str,
     return ("#!/usr/bin/env bash\nset -euo pipefail\n"
             f"# {spec.n_hosts} host(s), {spec.accelerator_type}\n"
             + " ".join(shlex.quote(a) for a in args) + "\n")
+
+
+def render_local_launch_script(spec: TpuPodSpec, train_cmd: str,
+                               coordinator_port: int = 8476) -> str:
+    """Localhost SIMULATION of the pod launch that actually executes: one
+    process per pod host, each exported the same
+    ``DL4J_TPU_COORDINATOR``/``NUM_PROCESSES``/``PROCESS_ID`` wiring the
+    real per-host command gets, so ``initialize_from_env`` forms a real
+    ``jax.distributed`` cluster.  This is the zero-egress stand-in for
+    the reference's jsch provisioner smoke-run (HostProvisioner connects
+    to real boxes; we connect the processes locally) — and the e2e test
+    executes this generated script."""
+    n = spec.n_hosts
+    env = dict(spec.env)
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    lines = [
+        "#!/usr/bin/env bash",
+        "set -euo pipefail",
+        f"# localhost simulation of {n} pod host(s), "
+        f"{spec.accelerator_type}",
+        f"COORD=\"127.0.0.1:{coordinator_port}\"",
+        "pids=()",
+        f"for p in $(seq 0 {n - 1}); do",
+        # user env first: the per-process wiring must always win
+        f"  env {exports} DL4J_TPU_COORDINATOR=\"$COORD\" "
+        f"DL4J_TPU_NUM_PROCESSES={n} DL4J_TPU_PROCESS_ID=$p "
+        f"{train_cmd} &",
+        "  pids+=($!)",
+        "done",
+        "rc=0",
+        "for p in \"${pids[@]}\"; do wait \"$p\" || rc=$?; done",
+        "exit $rc",
+    ]
+    return "\n".join(lines) + "\n"
 
 
 def render_teardown_script(spec: TpuPodSpec) -> str:
@@ -104,6 +146,8 @@ def write_cluster_scripts(spec: TpuPodSpec, train_cmd: str,
     for name, content in [
             ("create.sh", render_create_script(spec)),
             ("launch.sh", render_launch_script(spec, train_cmd)),
+            ("launch_local_sim.sh",
+             render_local_launch_script(spec, train_cmd)),
             ("teardown.sh", render_teardown_script(spec))]:
         path = os.path.join(directory, name)
         with open(path, "w") as fh:

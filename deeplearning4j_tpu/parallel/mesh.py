@@ -108,6 +108,32 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                                process_id=process_id)
 
 
+def initialize_from_env() -> bool:
+    """Cluster wiring from the environment the cloud/provision.py launch
+    scripts export: ``DL4J_TPU_COORDINATOR`` (host:port),
+    ``DL4J_TPU_NUM_PROCESSES``, ``DL4J_TPU_PROCESS_ID`` — the MASTER_URL
+    role of the reference's worker env (DeepLearning4jDistributed).
+    Returns False (no-op) when no wiring is present; on real TPU pods
+    the launch may instead rely on jax's own pod auto-detection."""
+    import os
+
+    coord = os.environ.get("DL4J_TPU_COORDINATOR")
+    if not coord:
+        return False
+    missing = [k for k in ("DL4J_TPU_NUM_PROCESSES", "DL4J_TPU_PROCESS_ID")
+               if k not in os.environ]
+    if missing:
+        raise ValueError(
+            f"DL4J_TPU_COORDINATOR is set but {missing} missing — the "
+            f"wiring trio (DL4J_TPU_COORDINATOR, DL4J_TPU_NUM_PROCESSES, "
+            f"DL4J_TPU_PROCESS_ID) must be exported together")
+    initialize_distributed(
+        coord,
+        int(os.environ["DL4J_TPU_NUM_PROCESSES"]),
+        int(os.environ["DL4J_TPU_PROCESS_ID"]))
+    return True
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     n = mesh.shape[DATA_AXIS]
     if global_batch % n != 0:
