@@ -1,8 +1,20 @@
-"""One-shot TPU measurement sweep — run when the axon tunnel is healthy.
+"""Incremental TPU measurement sweep — survives a flaky tunnel.
 
-Runs the headline benches in sequence in separate processes (the tunnel
-serializes device access) and prints one JSON line per config plus a
-word2vec depth-bucket A/B. Usage:  python tools/measure_tpu.py
+The axon tunnel flaps (up for minutes, down for hours).  A monolithic
+sweep loses everything after the first drop, so this version:
+
+  * keeps per-config state in ``TPU_SWEEP_STATE.json`` — a config is done
+    once a result with ``platform == "tpu"`` is recorded; re-runs skip it;
+  * probes the tunnel with a cheap matmul before every config and exits
+    rc=1 the moment the link is dead (the watcher resumes polling instead
+    of burning a 25-minute timeout on a hung subprocess);
+  * runs each bench via ``bench.py --inner`` directly (no CPU fallback —
+    a CPU row is worthless here and wastes the healthy window);
+  * benefits from bench.py's persistent compilation cache: a config that
+    timed out mid-compile restarts warm on the next window.
+
+Exit codes: 0 = every config captured on TPU; 1 = tunnel down / partial.
+Usage:  python tools/measure_tpu.py [config ...]   (default: all missing)
 """
 import json
 import os
@@ -10,46 +22,164 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE_PATH = os.path.join(REPO, "TPU_SWEEP_STATE.json")
+
+# (name, inner-timeout seconds).  Ordered cheapest-first so a short
+# healthy window still banks several rows; bert is first because it is
+# the headline (and doubles as a deep tunnel probe).
+CONFIGS = [
+    ("bert", 1200),
+    ("lenet", 600),
+    ("word2vec", 900),
+    ("glove", 900),
+    ("longctx", 1200),
+    ("resnet", 1800),
+    ("longctx32k", 1500),
+]
+
+# word2vec depth-bucket / exact-pair A/B (VERDICT r2 next-step #2): each
+# variant is its own subprocess so a tunnel drop keeps earlier variants.
+AB_VARIANTS = [
+    ("ab_db1", "dict(depth_buckets=1)"),
+    ("ab_db2", "dict(depth_buckets=2)"),
+    ("ab_db3", "dict(depth_buckets=3)"),
+    ("ab_exact", 'dict(pair_mode="exact")'),
+    ("ab_exact_db2", 'dict(pair_mode="exact", depth_buckets=2)'),
+]
 
 AB_SNIPPET = r'''
 import time, numpy as np, sys
-sys.path.insert(0, "%s")
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_compilation_cache_dir", %(cache)r)
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig
 rng = np.random.RandomState(0)
 words = [f"w{i}" for i in range(2000)]
 p = 1.0 / np.arange(1, 2001) ** 1.05; p /= p.sum()
 sents = [" ".join(rng.choice(words, p=p, size=30)) for _ in range(1600)]
-for tag, kw in (("db1", dict(depth_buckets=1)),
-                ("db2", dict(depth_buckets=2)),
-                ("db3", dict(depth_buckets=3)),
-                ("exact", dict(pair_mode="exact")),
-                ("exact_db2", dict(pair_mode="exact", depth_buckets=2))):
-    cfg = Word2VecConfig(vector_size=100, window=5, epochs=2, negative=5,
-                         use_hs=True, batch_size=16384, **kw)
-    w = Word2Vec(sents, cfg); w.fit()
-    float(np.asarray(w.syn0).ravel()[0])
-    cold = Word2Vec(sents, cfg, cache=w.cache)
-    t0 = time.perf_counter(); cold.fit()
-    float(np.asarray(cold.syn0).ravel()[0])
-    dt = time.perf_counter() - t0
-    print(f'{{"metric": "w2v_ab_{tag}", '
-          f'"words_per_sec": {96000 / dt:.0f}}}')
-''' % REPO
+cfg = Word2VecConfig(vector_size=100, window=5, epochs=2, negative=5,
+                     use_hs=True, batch_size=16384, **%(kw)s)
+w = Word2Vec(sents, cfg); w.fit()
+float(np.asarray(w.syn0).ravel()[0])
+cold = Word2Vec(sents, cfg, cache=w.cache)
+t0 = time.perf_counter(); cold.fit()
+float(np.asarray(cold.syn0).ravel()[0])
+dt = time.perf_counter() - t0
+print('{"metric": "w2v_%(tag)s", "platform": "%%s", "words_per_sec": %%d}'
+      %% (jax.devices()[0].platform, round(96000 / dt)))
+'''
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def save_state(state: dict) -> None:
+    tmp = STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1, sort_keys=True)
+    os.replace(tmp, STATE_PATH)
+
+
+def tunnel_up() -> bool:
+    """Cheap end-to-end probe: backend init + matmul + value fetch."""
+    code = ("import jax, jax.numpy as jnp\n"
+            "assert jax.devices()[0].platform != 'cpu'\n"
+            "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+            "print(float(jnp.ravel(x @ x)[0]))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=150,
+                           capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def last_json(stdout: str):
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict):
+                return obj
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def run_bench(name: str, timeout: int):
+    try:
+        r = subprocess.run(
+            [sys.executable, f"{REPO}/bench.py", "--inner", name],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    if r.returncode != 0:
+        return None, f"rc={r.returncode}: " + \
+            (r.stderr or r.stdout or "")[-300:]
+    obj = last_json(r.stdout)
+    if obj is None:
+        return None, "no JSON: " + (r.stderr or r.stdout or "")[-300:]
+    return obj, None
+
+
+def run_ab(tag: str, kw: str):
+    snippet = AB_SNIPPET % {"repo": REPO, "kw": kw, "tag": tag,
+                            "cache": os.path.join(REPO, ".jax_cache")}
+    try:
+        r = subprocess.run([sys.executable, "-c", snippet], timeout=1200,
+                           capture_output=True, text=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, "timeout after 1200s"
+    if r.returncode != 0:
+        return None, f"rc={r.returncode}: " + \
+            (r.stderr or r.stdout or "")[-300:]
+    obj = last_json(r.stdout)
+    if obj is None:
+        return None, "no JSON: " + (r.stderr or r.stdout or "")[-300:]
+    return obj, None
 
 
 def main() -> None:
-    for cfg in ("probe", "bert", "resnet", "word2vec", "glove", "longctx",
-                "longctx32k", "lenet"):
-        r = subprocess.run(
-            [sys.executable, f"{REPO}/bench.py", cfg],
-            capture_output=True, text=True, timeout=1800)
-        line = [l for l in r.stdout.splitlines() if l.startswith("{")]
-        print(line[-1] if line else json.dumps(
-            {"config": cfg, "error": r.stderr[-200:]}))
-    r = subprocess.run([sys.executable, "-c", AB_SNIPPET],
-                       capture_output=True, text=True, timeout=1800)
-    print(r.stdout.strip() or json.dumps({"ab": "failed",
-                                          "err": r.stderr[-200:]}))
+    if sys.argv[1:2] == ["--probe"]:
+        sys.exit(0 if tunnel_up() else 1)
+    only = set(sys.argv[1:])
+    state = load_state()
+    work = [(n, t, None) for n, t in CONFIGS] + \
+           [(n, 0, kw) for n, kw in AB_VARIANTS]
+    known = {w[0] for w in work}
+    if only - known:
+        print(json.dumps({"error": f"unknown configs: {sorted(only - known)}",
+                          "known": sorted(known)}))
+        sys.exit(2)
+    if only:
+        work = [w for w in work if w[0] in only]
+    pending = [w for w in work
+               if (state.get(w[0]) or {}).get("platform") != "tpu"]
+    print(json.dumps({"done": len(work) - len(pending),
+                      "pending": [w[0] for w in pending]}), flush=True)
+    for name, timeout, kw in pending:
+        if not tunnel_up():
+            print(json.dumps({"abort": "tunnel down", "at": name}),
+                  flush=True)
+            sys.exit(1)
+        obj, err = (run_ab(name, kw) if kw is not None
+                    else run_bench(name, timeout))
+        if obj is not None and obj.get("platform") == "tpu":
+            state[name] = obj
+            save_state(state)
+            print(json.dumps(obj), flush=True)
+        else:
+            detail = err if obj is None else \
+                f"platform={obj.get('platform')}"
+            print(json.dumps({"config": name, "error": detail or "empty"}),
+                  flush=True)
+    still = [w[0] for w in work
+             if (load_state().get(w[0]) or {}).get("platform") != "tpu"]
+    sys.exit(1 if still else 0)
 
 
 if __name__ == "__main__":

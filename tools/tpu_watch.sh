@@ -1,26 +1,28 @@
 #!/bin/bash
-# Poll the axon TPU tunnel; the moment backend init succeeds, run the
-# full measurement sweep (tools/measure_tpu.py) once and exit.
-# Status lines -> tools/tpu_watch.status ; sweep output -> TPU_SWEEP_r03.log
+# Poll the axon TPU tunnel; whenever it is up, run the INCREMENTAL sweep
+# (tools/measure_tpu.py — skips configs already captured, exits 1 on a
+# mid-sweep tunnel drop).  Loops until every config is captured on TPU.
+# Status lines -> tools/tpu_watch.status ; sweep output appends to
+# TPU_SWEEP_r03.log ; per-config results -> TPU_SWEEP_STATE.json
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 STATUS="$REPO/tools/tpu_watch.status"
 SWEEP="$REPO/TPU_SWEEP_r03.log"
+LOCK="$REPO/tools/tpu_watch.lock"
+
+exec 9>"$LOCK"
+flock -n 9 || { echo "another watcher is running" >&2; exit 0; }
 
 while true; do
   ts=$(date -u +%H:%M:%S)
-  if timeout 150 env JAX_PLATFORMS=axon python -c "
-import jax, jax.numpy as jnp
-assert jax.devices()[0].platform != 'cpu'
-x = jnp.ones((128, 128))
-(x @ x).block_until_ready()
-" >/dev/null 2>&1; then
-    echo "$ts TUNNEL UP - starting sweep" >> "$STATUS"
-    # worst case: 7 configs x 1800s each + the word2vec A/B
-    cd "$REPO" && timeout 16200 python tools/measure_tpu.py > "$SWEEP" 2>&1
+  if python "$REPO/tools/measure_tpu.py" --probe >/dev/null 2>&1; then
+    echo "$ts TUNNEL UP - incremental sweep" >> "$STATUS"
+    # 18000s > worst-case sum of inner timeouts (~15900s), so a sweep is
+    # never SIGTERMed mid-config (which would orphan the inner bench
+    # process on the serialized tunnel)
+    cd "$REPO" && timeout 18000 python tools/measure_tpu.py >> "$SWEEP" 2>&1
     rc=$?
-    echo "$(date -u +%H:%M:%S) sweep done exit=$rc -> $SWEEP" >> "$STATUS"
-    [ "$rc" -eq 0 ] && exit 0
-    # truncated/failed sweep: keep watching and try again
+    echo "$(date -u +%H:%M:%S) sweep pass exit=$rc" >> "$STATUS"
+    [ "$rc" -eq 0 ] && { echo "ALL CAPTURED" >> "$STATUS"; exit 0; }
   else
     echo "$ts tunnel down" >> "$STATUS"
   fi
