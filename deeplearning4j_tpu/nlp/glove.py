@@ -299,6 +299,10 @@ class Glove:
             # the XLA path for auto (an explicit kernel="pallas" would
             # have surfaced the compile error instead)
             pallas_block = 0
+        #: resolved dispatch for this fit — benches/tools report it so a
+        #: round artifact records the Mosaic accept/reject verdict
+        from deeplearning4j_tpu.ops.kernel_select import kernel_name
+        self.kernel_used = kernel_name(pallas_block, pallas_interpret)
         key = jax.random.key(cfg.seed)
         alpha = jnp.float32(cfg.alpha)
         for epoch in range(cfg.epochs):

@@ -129,7 +129,7 @@ class ParagraphVectors:
                                        lb_off)
 
         total_words = int(lens.sum())
-        self.syn0, self.syn1, _, _ = run_pair_training(
+        self.syn0, self.syn1, _, _, self.kernel_used = run_pair_training(
             self.syn0, self.syn1, None, (cen, ctx, pos, dlt, off),
             vocab_size=V, dim=D, epochs=cfg.epochs,
             total_words=total_words, codes_t=codes_t, points_t=points_t,

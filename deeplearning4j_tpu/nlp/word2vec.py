@@ -417,7 +417,8 @@ def run_pair_training(syn0, syn1, syn1neg,
     TPU when the tables fit; ``kernel='pallas'`` raises when they
     don't), per-slab chunking with the device-residency cap, and
     globally-unique chunk ids (negative-sample draws never repeat within
-    an epoch).  Returns ``(syn0, syn1, syn1neg, dev_cache)`` — thread
+    an epoch).  Returns ``(syn0, syn1, syn1neg, dev_cache,
+    kernel_used)`` — thread
     ``dev_cache`` back in to replay the prepared slabs on later fits."""
     B = batch_size
     neg_tab = (syn1neg if syn1neg is not None
@@ -440,9 +441,13 @@ def run_pair_training(syn0, syn1, syn1neg,
                                   vocab_size, dim,
                                   int(codes_t.shape[1]) if use_hs else 1)):
         pallas_block = 0        # Mosaic rejected: degrade to XLA
+    # resolved dispatch — returned so benches record the Mosaic
+    # accept/reject verdict per fit
+    from deeplearning4j_tpu.ops.kernel_select import kernel_name
+    kernel_used = kernel_name(pallas_block, pallas_interpret)
 
     if epochs <= 0:
-        return syn0, syn1, syn1neg, dev_cache
+        return syn0, syn1, syn1neg, dev_cache, kernel_used
     total = max(1, total_words * epochs)
     nkey = jax.random.key(seed + 1)
 
@@ -590,7 +595,8 @@ def run_pair_training(syn0, syn1, syn1neg,
             stream(pairs_iter_factory(epoch), epoch, None)
         syn0, syn1, neg_tab = state
         return (syn0, syn1,
-                neg_tab if syn1neg is not None else None, None)
+                neg_tab if syn1neg is not None else None, None,
+                kernel_used)
 
     if dev_cache is not None and dev_cache["bucket_l"] != bucket_l:
         raise ValueError(
@@ -620,7 +626,8 @@ def run_pair_training(syn0, syn1, syn1neg,
             state = dispatch(slab, cid0, bidx, epoch, state)
     syn0, syn1, neg_tab = state
     return (syn0, syn1,
-            neg_tab if syn1neg is not None else None, dev_cache)
+            neg_tab if syn1neg is not None else None, dev_cache,
+            kernel_used)
 
 
 def hs_mask_table(codes_t: np.ndarray, lengths_t: np.ndarray) -> Array:
@@ -743,8 +750,8 @@ class Word2Vec:
         elif self._dev_cache is None:
             pairs_iter = corpus_pairs_slabs(self._index_sentences(),
                                             cfg.window, PAIRS_PER_SLAB)
-        self.syn0, self.syn1, self.syn1neg, self._dev_cache = \
-            run_pair_training(
+        (self.syn0, self.syn1, self.syn1neg, self._dev_cache,
+         self.kernel_used) = run_pair_training(
                 self.syn0, self.syn1, self.syn1neg,
                 vocab_size=len(self.cache), dim=cfg.vector_size,
                 epochs=cfg.epochs, total_words=self._n_positions,

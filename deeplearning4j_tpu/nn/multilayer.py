@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import io
 import logging
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -377,24 +378,33 @@ class MultiLayerNetwork:
 
         train_step = jax.jit(step_body)
 
-        @jax.jit
-        def train_epoch(params, ustate, xs, ys, key, it0):
-            """One dispatch per EPOCH: lax.scan the step over device-
-            stacked batches [NB, B, ...].  A python per-step loop costs
-            one host->device dispatch round-trip per step — under a
-            tunneled TPU that latency (10-20 ms) dwarfs small-model step
-            compute by orders of magnitude."""
-            def body(carry, inp):
-                p, u, it = carry
+        def _epoch_scan(carry, xs, ys, key):
+            """lax.scan the step over device-stacked batches [NB, B, ...]."""
+            def body(c, inp):
+                p, u, it = c
                 x, y = inp
                 p, u, score = step_body(p, u, x, y, key, it)
                 return (p, u, it + 1), score
 
+            return lax.scan(body, carry, (xs, ys))
+
+        @partial(jax.jit, static_argnums=(6,))
+        def train_epochs(params, ustate, xs, ys, key, it0, num_epochs):
+            """ONE dispatch for the whole fit: scan over epochs of the
+            scanned step.  A python per-step loop costs one host->device
+            round-trip per step, and even a per-epoch loop pays one per
+            epoch — under a tunneled TPU that latency (10 ms to 100s of
+            ms, link-dependent) dwarfs small-model compute by orders of
+            magnitude.  Returns per-step scores [num_epochs, NB] so
+            listeners replay exactly."""
+            def epoch_body(carry, _):
+                return _epoch_scan(carry, xs, ys, key)
+
             (params, ustate, _), scores = lax.scan(
-                body, (params, ustate, it0), (xs, ys))
+                epoch_body, (params, ustate, it0), None, length=num_epochs)
             return params, ustate, scores
 
-        self._bp_cache = (train_step, train_epoch, updaters)
+        self._bp_cache = (train_step, train_epochs, updaters)
         return self._bp_cache
 
     def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
@@ -411,7 +421,7 @@ class MultiLayerNetwork:
         Each layer gets its OWN updater from its conf, so per-layer
         lr/momentum/l2 overrides (ConfOverride parity) take effect."""
         params = self._require_params()
-        train_step, train_epoch, updaters = self._backprop_machinery()
+        train_step, train_epochs, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         batches = [data] if isinstance(data, DataSet) else list(data)
         run_key = jax.random.key(seed)
@@ -432,14 +442,13 @@ class MultiLayerNetwork:
         if uniform:
             xs = jnp.stack([jnp.asarray(b.features) for b in batches])
             ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
-            for epoch in range(num_epochs):
-                params, ustate, scores = train_epoch(
-                    params, ustate, xs, ys, run_key, it)
-                if self.listeners:
-                    for j, s in enumerate(np.asarray(scores)):
-                        for ls in self.listeners:
-                            ls.iteration_done(self, it + j, float(s))
-                it += len(batches)
+            params, ustate, scores = train_epochs(
+                params, ustate, xs, ys, run_key, it, num_epochs)
+            if self.listeners:
+                for j, s in enumerate(np.asarray(scores).ravel()):
+                    for ls in self.listeners:
+                        ls.iteration_done(self, it + j, float(s))
+            it += num_epochs * len(batches)
         else:
             for epoch in range(num_epochs):
                 for batch in batches:

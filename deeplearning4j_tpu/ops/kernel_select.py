@@ -36,3 +36,12 @@ def resolve_kernel(kernel: str, block: int, desc: str
             f"budget (or the batch size is not divisible by a "
             f"supported block)")
     return 0, False
+
+
+def kernel_name(pallas_block: int, pallas_interpret: bool) -> str:
+    """Human-readable verdict for a resolved (block, interpret) pair —
+    what benches record into round artifacts as the Mosaic
+    accept/reject evidence."""
+    if pallas_block and not pallas_interpret:
+        return "pallas"
+    return "pallas-interpret" if pallas_block else "xla"
