@@ -887,6 +887,36 @@ def run_config(name: str, tpu_ok: bool):
             "vs_baseline": None, **errors}
 
 
+def _attach_sweep_evidence(out: dict) -> None:
+    """Attach TPU rows banked by tools/measure_tpu.py to the output.
+
+    The axon tunnel is up for minutes and down for hours; the incremental
+    sweep (TPU_SWEEP_STATE.json) banks each config the moment a healthy
+    window appears.  When the end-of-round bench run lands in an outage
+    and falls back to CPU, those rows are the only TPU evidence — carry
+    them in the driver artifact, explicitly labeled as sweep-captured
+    (mid-round, builder-run) rather than measured by this invocation."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "TPU_SWEEP_STATE.json")
+    try:
+        with open(path) as f:
+            state = json.load(f)
+        mtime = os.path.getmtime(path)
+    except (OSError, json.JSONDecodeError):
+        return
+    rows = {k: v for k, v in state.items()
+            if isinstance(v, dict) and v.get("platform") == "tpu"}
+    if rows:
+        out["tpu_sweep"] = {
+            "provenance": "banked mid-round by tools/measure_tpu.py "
+                          "during healthy tunnel windows; not measured by "
+                          "this bench invocation",
+            "captured_as_of": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)),
+            "rows": rows,
+        }
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache for the inner bench processes.
 
@@ -953,6 +983,8 @@ def main() -> None:
     out["suite"] = suite
     if not tpu_ok and probe_err:
         out["tpu_error"] = probe_err
+    if out.get("platform") != "tpu":
+        _attach_sweep_evidence(out)
     _flag_regressions(out)
     print(json.dumps(_sanitize(out)))
 

@@ -47,6 +47,15 @@ class ResNetConfig:
     stem_kernel: int = 7
     stem_stride: int = 2
     stem_pool: bool = True
+    # Space-to-depth stem (MLPerf TPU trick): the 7x7/s2 conv on 3 input
+    # channels runs the MXU at 3/128 lane utilization; rearranging the
+    # image into 2x2 blocks ([B,224,224,3] -> [B,112,112,12]) and the
+    # zero-padded 8x8 kernel into an equivalent 4x4x12 stride-1 conv is
+    # the SAME math (test_models asserts exact fp32 equality) with 4x the
+    # contraction depth and half the kernel extent.  Only legal for the
+    # 7x7/s2 ImageNet stem — init_params stores the identical [7,7,3,w]
+    # weights either way, so checkpoints are layout-independent.
+    stem_s2d: bool = False
 
 
 def resnet50(n_classes: int = 1000) -> ResNetConfig:
@@ -165,6 +174,29 @@ def _bn(x: Array, p: Dict[str, Array], st: Dict[str, Array], train: bool,
     return ((x32 - mean) * inv + p["b"]).astype(out_dtype), new_st
 
 
+def _stem_s2d_conv(x: Array, w: Array, cdt) -> Array:
+    """7x7/s2 SAME stem conv computed as a 4x4/s1 conv on the 2x2
+    space-to-depth rearrangement of ``x`` — exact same arithmetic.
+
+    Derivation: output pixel i reads original rows 2i-2..2i+4 (SAME pad
+    (2,3) at stride 2).  Row 2i-2+k lives in 2-block i-1+k//2 at offset
+    k%2, so the 7 taps span 4 blocks with block-space padding (1,2); the
+    zero-padded 8th tap completes the (4,2) factorization of the kernel.
+    """
+    b, h_, w_, c = x.shape
+    kh, kw, cin, cout = w.shape          # 7,7,3,width
+    # x -> [B, H/2, W/2, 2*2*C]; channel index = (dy, dx, c)
+    xs = x.reshape(b, h_ // 2, 2, w_ // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h_ // 2, w_ // 2, 4 * c)
+    # w (zero-pad 7->8 on the high side) -> [4, 4, 2*2*C, cout]
+    wp = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    ws = wp.reshape(4, 2, 4, 2, cin, cout)
+    ws = ws.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * cin, cout)
+    return lax.conv_general_dilated(
+        xs.astype(cdt), ws.astype(cdt), (1, 1), ((1, 2), (1, 2)),
+        dimension_numbers=_DN)
+
+
 def forward(cfg: ResNetConfig, params: PyTree, stats: PyTree, x: Array,
             train: bool = True) -> Tuple[Array, PyTree]:
     """x [B, H, W, 3] -> (logits [B, n_classes], new batch stats)."""
@@ -172,7 +204,12 @@ def forward(cfg: ResNetConfig, params: PyTree, stats: PyTree, x: Array,
     mom, eps = cfg.bn_momentum, cfg.bn_eps
     new_stats: Dict[str, Any] = {}
 
-    h = _conv(x, params["stem"]["w"], cfg.stem_stride, cdt)
+    if cfg.stem_s2d:
+        assert cfg.stem_kernel == 7 and cfg.stem_stride == 2, \
+            "stem_s2d factorizes exactly the 7x7/s2 ImageNet stem"
+        h = _stem_s2d_conv(x, params["stem"]["w"], cdt)
+    else:
+        h = _conv(x, params["stem"]["w"], cfg.stem_stride, cdt)
     h, new_stats["stem"] = _bn(h, params["stem"]["bn"], stats["stem"],
                                train, mom, eps, cdt)
     h = jax.nn.relu(h)
