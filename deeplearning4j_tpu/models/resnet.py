@@ -176,7 +176,9 @@ def _bn(x: Array, p: Dict[str, Array], st: Dict[str, Array], train: bool,
 
 def _stem_s2d_conv(x: Array, w: Array, cdt) -> Array:
     """7x7/s2 SAME stem conv computed as a 4x4/s1 conv on the 2x2
-    space-to-depth rearrangement of ``x`` — exact same arithmetic.
+    space-to-depth rearrangement of ``x`` — same contraction, equivalent
+    up to fp reduction order (XLA may sum the 7*7*C products differently
+    for the re-tiled shape, so results agree to ~1e-5, not bitwise).
 
     Derivation: output pixel i reads original rows 2i-2..2i+4 (SAME pad
     (2,3) at stride 2).  Row 2i-2+k lives in 2-block i-1+k//2 at offset
@@ -207,6 +209,8 @@ def forward(cfg: ResNetConfig, params: PyTree, stats: PyTree, x: Array,
     if cfg.stem_s2d:
         assert cfg.stem_kernel == 7 and cfg.stem_stride == 2, \
             "stem_s2d factorizes exactly the 7x7/s2 ImageNet stem"
+        assert x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0, \
+            f"stem_s2d needs even H/W (2x2 space-to-depth), got {x.shape}"
         h = _stem_s2d_conv(x, params["stem"]["w"], cdt)
     else:
         h = _conv(x, params["stem"]["w"], cfg.stem_stride, cdt)

@@ -84,7 +84,12 @@ class Word2VecConfig:
     #: masked waste at window 5.  "exact": the shrink is applied host-
     #: side per epoch (the reference's actual algorithm) so the device
     #: trains only real pairs — fresh streaming every epoch (overlapped
-    #: with dispatch), no replay cache.
+    #: with dispatch), no replay cache.  "device": NO host pair work at
+    #: all — the int32 token stream uploads once (~4 bytes/word vs
+    #: ~16 bytes/PAIR for host-built slabs) and each epoch is ONE
+    #: dispatch that gathers contexts, applies sentence-boundary and
+    #: window-shrink masks, and trains, all on device (see
+    #: _scan_stream_epoch).
     pair_mode: str = "masked"
 
 
@@ -198,15 +203,10 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
     col = jnp.arange(B)
 
     def b_draw(pos):
-        """Stateless per-(epoch, position) window-shrink draw: a Wang-style
-        integer hash of the position — every pair sharing a center
-        position sees the same b, no O(corpus) array is materialized per
-        dispatch, and epochs re-draw via ``seed32``.  (The reference's
-        own randomness is an LCG stream, Word2Vec.java skipGram:314.)"""
-        h = pos.astype(jnp.uint32) * jnp.uint32(2654435761) + seed32
-        h = (h ^ (h >> 16)) * jnp.uint32(2246822519)
-        h = (h ^ (h >> 13)) * jnp.uint32(3266489917)
-        return ((h ^ (h >> 16)) % jnp.uint32(window)).astype(jnp.int32)
+        # the one shrink-draw implementation, shared with the "device"
+        # stream path (_scan_stream_epoch) so the two modes can never
+        # diverge on shrink semantics
+        return _hash_shrink(pos, seed32, window)
 
     def body(carry, inp):
         syn0, syn1, syn1neg = carry
@@ -265,6 +265,187 @@ def _scan_slab(syn0: Array, syn1: Array, syn1neg: Array,
         body, (syn0, syn1, syn1neg),
         (centers, contexts, cpos, deltas, offsets, chunk_ids, n_real))
     return syn0, syn1, syn1neg
+
+
+def _hash_shrink(pos: Array, seed32: Array, window: int) -> Array:
+    """Stateless per-(epoch, position) window-shrink draw: a Wang-style
+    integer hash of the position — every pair sharing a center position
+    sees the same b, no O(corpus) array is materialized per dispatch,
+    and epochs re-draw via ``seed32``.  (The reference's own randomness
+    is an LCG stream, Word2Vec.java skipGram:314.)"""
+    h = pos.astype(jnp.uint32) * jnp.uint32(2654435761) + seed32
+    h = (h ^ (h >> 16)) * jnp.uint32(2246822519)
+    h = (h ^ (h >> 13)) * jnp.uint32(3266489917)
+    return ((h ^ (h >> 16)) % jnp.uint32(window)).astype(jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2),
+         static_argnames=("use_hs", "negative", "window", "pos_chunk",
+                          "n_chunks", "pallas_block", "pallas_interpret"))
+def _scan_stream_epoch(syn0: Array, syn1: Array, syn1neg: Array,
+                       tok: Array, n_stream: Array,
+                       codes_t: Array, points_t: Array, mask_t: Array,
+                       table: Array, key: Array, epoch: Array,
+                       n_epochs_f: Array, alpha0: Array, min_alpha: Array,
+                       *, use_hs: bool, negative: int, window: int,
+                       pos_chunk: int, n_chunks: int,
+                       pallas_block: int = 0,
+                       pallas_interpret: bool = False):
+    """One dispatch per EPOCH with ZERO host pair work (pair_mode
+    ="device"): ``tok`` is the int32 token stream with ``-1`` sentence
+    separators, uploaded ONCE per corpus (~4 bytes/word, vs ~16 bytes
+    per PAIR for host-built slabs riding the tunnel every fit).  Each
+    scan step takes a [pos_chunk] window of positions and builds its
+    pairs on device: contexts are ``tok`` gathers at the 2W signed
+    offsets, sentence boundaries mask via a separator-count (cumsum)
+    sentence id, and the reference's dynamic window shrink
+    (skipGram:314) is the usual stateless hash mask.  The lr clock is
+    the stream position (= words seen, separators included — within
+    ~n_sentences/n_words of the reference's per-sentence clock)."""
+    ekey = jax.random.fold_in(key, epoch)
+    seed32 = jax.random.randint(
+        jax.random.fold_in(ekey, 0), (), 0, 2 ** 31 - 1, jnp.uint32)
+    deltas = jnp.concatenate([jnp.arange(-window, 0),
+                              jnp.arange(1, window + 1)]).astype(jnp.int32)
+    W2 = 2 * window
+    B = pos_chunk * W2
+    n_pad = tok.shape[0]
+    sid = jnp.cumsum((tok < 0).astype(jnp.int32))
+    nf = n_stream.astype(jnp.float32)
+
+    def body(carry, i):
+        syn0, syn1, syn1neg = carry
+        p0 = i * pos_chunk
+        pos = p0 + jnp.arange(pos_chunk, dtype=jnp.int32)
+        cen = tok[pos]
+        j = pos[:, None] + deltas[None, :]                  # [P, 2W]
+        jc = jnp.clip(j, 0, n_pad - 1)
+        ctx = tok[jc]
+        valid = ((j >= 0) & (cen[:, None] >= 0) & (ctx >= 0)
+                 & (sid[jc] == sid[pos][:, None]))
+        shrink = window - _hash_shrink(pos, seed32, window)
+        m = valid & (jnp.abs(deltas)[None, :] <= shrink[:, None])
+        pm = m.reshape(B).astype(jnp.float32)
+        inputs = jnp.maximum(ctx, 0).reshape(B)
+        cen_s = jnp.maximum(cen, 0)
+        targets = jnp.broadcast_to(cen_s[:, None],
+                                   (pos_chunk, W2)).reshape(B)
+        frac = (epoch.astype(jnp.float32) * nf + p0) \
+            / jnp.maximum(nf * n_epochs_f, 1.0)
+        alpha = jnp.maximum(min_alpha, alpha0 * (1.0 - frac))
+        if negative > 0:
+            draws = jax.random.randint(
+                jax.random.fold_in(ekey, 1 + i), (B, negative), 0,
+                table.shape[0])
+            negs = table[draws]
+        else:
+            negs = jnp.zeros((B, 1), jnp.int32)
+        if use_hs:
+            codes_b = jnp.broadcast_to(
+                codes_t[cen_s][:, None, :],
+                (pos_chunk, W2, codes_t.shape[1])).reshape(B, -1)
+            points_b = jnp.broadcast_to(
+                points_t[cen_s][:, None, :],
+                (pos_chunk, W2, points_t.shape[1])).reshape(B, -1)
+            mask_b = jnp.broadcast_to(
+                mask_t[cen_s][:, None, :],
+                (pos_chunk, W2, mask_t.shape[1])).reshape(B, -1)
+        else:
+            codes_b = jnp.zeros((B, 1), jnp.float32)
+            points_b = jnp.zeros((B, 1), jnp.int32)
+            mask_b = jnp.zeros((B, 1), jnp.float32)
+        if pallas_block > 0:
+            from deeplearning4j_tpu.ops.pallas_word2vec import \
+                fused_chunk_update
+            syn0, syn1, syn1neg = fused_chunk_update(
+                syn0, syn1, syn1neg, inputs, targets, codes_b,
+                points_b, mask_b, negs, pm, alpha,
+                use_hs=use_hs, negative=negative,
+                block=pallas_block, interpret=pallas_interpret)
+        else:
+            syn0_in = syn0
+            if use_hs:
+                hs0, syn1 = _hs_update(
+                    syn0_in, syn1, inputs, codes_b,
+                    points_b, mask_b * pm[:, None], alpha)
+                syn0 = syn0 + (hs0 - syn0_in)
+            if negative > 0:
+                ng0, syn1neg = _neg_update(
+                    syn0_in, syn1neg, inputs, targets, negs, pm, alpha)
+                syn0 = syn0 + (ng0 - syn0_in)
+        return (syn0, syn1, syn1neg), None
+
+    (syn0, syn1, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg),
+        jnp.arange(n_chunks, dtype=jnp.int32))
+    return syn0, syn1, syn1neg
+
+
+def run_stream_training(syn0, syn1, syn1neg, indexed, *,
+                        vocab_size, dim, epochs, codes_t, points_t,
+                        mask_t, table, window, alpha, min_alpha, use_hs,
+                        negative, batch_size, kernel, seed,
+                        stream_cache=None):
+    """pair_mode="device" engine: upload the separator-delimited token
+    stream once, then one ``_scan_stream_epoch`` dispatch per epoch.
+    Returns (syn0, syn1, syn1neg, stream_cache, kernel_used)."""
+    from deeplearning4j_tpu.ops.kernel_select import (kernel_name,
+                                                      resolve_kernel)
+    from deeplearning4j_tpu.ops.pallas_word2vec import (choose_block,
+                                                        probe_compile)
+    W2 = 2 * window
+    # pos_chunk: pairs-per-chunk ~= batch_size, with B = pos_chunk*2W a
+    # multiple of every kernel block size (512 | lcm constraint below)
+    import math
+    step = 512 // math.gcd(W2, 512)
+    pos_chunk = max(step, (batch_size // W2) // step * step)
+    B = pos_chunk * W2
+
+    platform = jax.devices()[0].platform
+    pallas_block, pallas_interpret = resolve_kernel(
+        kernel,
+        choose_block(vocab_size, dim, negative, B,
+                     interpret=platform != "tpu"),
+        f"word2vec vocab {vocab_size} x dim {dim} (batch {B})")
+    if (pallas_block and not pallas_interpret and kernel == "auto"
+            and not probe_compile(pallas_block, use_hs, negative,
+                                  vocab_size, dim,
+                                  int(codes_t.shape[1]) if use_hs else 1)):
+        pallas_block = 0
+    kernel_used = kernel_name(pallas_block, pallas_interpret)
+
+    if stream_cache is None:
+        # separator-delimited stream: sentence ids come from a cumsum on
+        # device, so only ONE int32 array rides the link
+        n_stream = int(sum(a.size + 1 for a in indexed))
+        NC = max(1, 1 << (-(-n_stream // pos_chunk) - 1).bit_length())
+        stream = np.full(NC * pos_chunk, -1, np.int32)
+        off = 0
+        for a in indexed:
+            stream[off:off + a.size] = a
+            off += a.size + 1
+        stream_cache = {"tok": jnp.asarray(stream), "n_stream": n_stream,
+                        "n_chunks": NC, "pos_chunk": pos_chunk}
+    if stream_cache["pos_chunk"] != pos_chunk:
+        raise ValueError("stream cache built for a different batch "
+                         "size; refit with a fresh instance")
+    nkey = jax.random.key(seed + 1)
+    had_neg = syn1neg is not None
+    if not had_neg:
+        syn1neg = jnp.zeros((1, 1), jnp.float32)
+    for epoch in range(epochs):
+        syn0, syn1, syn1neg = _scan_stream_epoch(
+            syn0, syn1, syn1neg, stream_cache["tok"],
+            jnp.int32(stream_cache["n_stream"]), codes_t, points_t,
+            mask_t, table, nkey, jnp.int32(epoch),
+            jnp.float32(max(epochs, 1)), jnp.float32(alpha),
+            jnp.float32(min_alpha), use_hs=use_hs, negative=negative,
+            window=window, pos_chunk=pos_chunk,
+            n_chunks=stream_cache["n_chunks"],
+            pallas_block=pallas_block,
+            pallas_interpret=pallas_interpret)
+    return (syn0, syn1, syn1neg if had_neg else None, stream_cache,
+            kernel_used)
 
 
 # -- host-side pair generation ---------------------------------------------
@@ -655,7 +836,8 @@ class Word2Vec:
         self._wv: Optional[WordVectors] = None
         self._n_positions = 0       # corpus words (the lr-decay clock)
         self._dev_cache = None      # prepared pair slabs (see engine)
-        self._indexed = None        # indexed corpus (pair_mode="exact")
+        self._indexed = None        # indexed corpus (exact/device modes)
+        self._stream_cache = None   # uploaded token stream ("device")
 
     # -- vocab (buildVocab:257 parity) -------------------------------------
     def build_vocab(self) -> VocabCache:
@@ -697,10 +879,10 @@ class Word2Vec:
             raise ValueError(
                 f"Word2VecConfig.kernel must be 'auto', 'pallas' or "
                 f"'xla', got {cfg.kernel!r}")
-        if cfg.pair_mode not in ("masked", "exact"):
+        if cfg.pair_mode not in ("masked", "exact", "device"):
             raise ValueError(
-                f"Word2VecConfig.pair_mode must be 'masked' or 'exact', "
-                f"got {cfg.pair_mode!r}")
+                f"Word2VecConfig.pair_mode must be 'masked', 'exact' or "
+                f"'device', got {cfg.pair_mode!r}")
         if not cfg.use_hs and cfg.negative <= 0:
             raise ValueError(
                 "no training objective: enable use_hs and/or negative > 0")
@@ -737,6 +919,22 @@ class Word2Vec:
         # the next.  pair_mode="masked" caches the prepared slabs so later
         # fits (and epochs 1+) replay them with zero host pair work;
         # pair_mode="exact" re-streams host-shrunk pairs every epoch.
+        if cfg.pair_mode == "device":
+            if self._indexed is None:
+                self._indexed = self._index_sentences()
+            (self.syn0, self.syn1, self.syn1neg, self._stream_cache,
+             self.kernel_used) = run_stream_training(
+                self.syn0, self.syn1, self.syn1neg, self._indexed,
+                vocab_size=len(self.cache), dim=cfg.vector_size,
+                epochs=cfg.epochs, codes_t=codes_t, points_t=points_t,
+                mask_t=mask_t, table=table, window=cfg.window,
+                alpha=cfg.alpha, min_alpha=cfg.min_alpha,
+                use_hs=cfg.use_hs, negative=cfg.negative,
+                batch_size=cfg.batch_size, kernel=cfg.kernel,
+                seed=cfg.seed,
+                stream_cache=getattr(self, "_stream_cache", None))
+            self._wv = WordVectors(self.cache, self.syn0)
+            return self._wv
         pairs_iter = factory = None
         if cfg.pair_mode == "exact":
             if self._indexed is None:
@@ -748,7 +946,9 @@ class Word2Vec:
                     (cfg.seed + 7919 * (epoch + 1)) % (2 ** 31 - 1))
                 return corpus_pairs_slabs(indexed, w, PAIRS_PER_SLAB, rng)
         elif self._dev_cache is None:
-            pairs_iter = corpus_pairs_slabs(self._index_sentences(),
+            if self._indexed is None:
+                self._indexed = self._index_sentences()
+            pairs_iter = corpus_pairs_slabs(self._indexed,
                                             cfg.window, PAIRS_PER_SLAB)
         (self.syn0, self.syn1, self.syn1neg, self._dev_cache,
          self.kernel_used) = run_pair_training(

@@ -175,31 +175,52 @@ def apply_chunk(table_b: Array, gsq_b: Array, acc: Array, alpha):
 _PROBE_CACHE: dict = {}
 
 
-def probe_compile(block: int, vocab_size: int = 128, dim: int = 8) -> bool:
+def probe_compile(block: int, vocab_size: int = 128, dim: int = 8,
+                  timeout_s: float = 240.0) -> bool:
     """One real compile of the kernel at the given block size AND the
     caller's actual (vocab, dim) — ``auto`` selection on hardware goes
     through here so a Mosaic rejection degrades to the XLA path instead
     of crashing fit() (the same guard pattern as the flash-attention
     bench probe).  VMEM fit depends on the table shapes, so the probe
-    runs at the production shapes; cached per the full key."""
+    runs at the production shapes; cached per the full key.
+
+    The compile runs in a daemon thread joined with ``timeout_s``: a
+    Mosaic compile that HANGS (round-3: glove died as a 900 s bench
+    timeout) reads as a reject and the fit proceeds on XLA.  This only
+    helps when the hung compile releases the GIL (jaxlib's compile call
+    does); bench.py additionally probes in a killable subprocess."""
     key = (block, vocab_size, dim)
     if key in _PROBE_CACHE:
         return _PROBE_CACHE[key]
-    try:
-        V, D = vocab_size, dim
-        wext = jnp.zeros((V, D + 2), jnp.float32)
-        rows = jnp.zeros((block,), jnp.int32)
-        x = jnp.ones((block,), jnp.float32)
-        accw, _, _ = fused_glove_chunk(
-            wext, wext, rows, rows, x, x, x_max=100.0, power=0.75,
-            block=block, interpret=False)
-        float(accw[0, 0])
-        ok = True
-    except Exception as e:                # Mosaic/compile-specific
+
+    result = {}
+
+    def _try():
+        try:
+            V, D = vocab_size, dim
+            wext = jnp.zeros((V, D + 2), jnp.float32)
+            rows = jnp.zeros((block,), jnp.int32)
+            x = jnp.ones((block,), jnp.float32)
+            accw, _, _ = fused_glove_chunk(
+                wext, wext, rows, rows, x, x, x_max=100.0, power=0.75,
+                block=block, interpret=False)
+            float(accw[0, 0])
+            result["ok"] = True
+        except Exception as e:            # Mosaic/compile-specific
+            result["err"] = e
+            result["ok"] = False
+
+    import threading
+    t = threading.Thread(target=_try, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    ok = bool(result.get("ok"))
+    if not ok:
         import logging
+        why = ("compile timed out after %.0fs" % timeout_s
+               if t.is_alive() else result.get("err"))
         logging.getLogger(__name__).warning(
             "glove Pallas kernel unavailable on this backend (%s); "
-            "using the XLA path", e)
-        ok = False
+            "using the XLA path", why)
     _PROBE_CACHE[key] = ok
     return ok

@@ -9,19 +9,25 @@ per 16k-pair chunk for HS alone) because XLA lowers row scatter-adds to a
 serial per-row loop and row gathers to narrow copies.
 
 This kernel removes gathers and scatters ENTIRELY for vocabularies whose
-tables fit in VMEM (V*(D+1) fp32 up to a few MB — covers the classic
-word2vec regime of 1e2..1e4 vocab, the reference's own test scale):
+tables fit in VMEM (the classic word2vec regime of 1e2..1e4 vocab, the
+reference's own test scale), via a DENSE-SCORES formulation:
 
-- syn0 / syn1 / syn1neg stay resident in VMEM for the whole chunk;
-- every row "gather" is a one-hot matmul  OHTᵀ·syn  on the MXU, and every
-  row "scatter-add" is the transposed one-hot matmul  OHT·payload — the
-  [V, BLK] one-hot is built by an iota-compare in VMEM and never touches
-  HBM;
-- hierarchical-softmax levels and the (K+1) negative-sampling partners
-  reuse the same one-hot per row set, so each level costs two MXU calls;
-- per-row counts for the batched-update mean normalization (see
-  ``_hs_update``) ride in an extra payload lane — same matmul, no extra
-  scatter.
+- syn0 / syn1 / syn1neg stay resident in VMEM (bf16) for the whole chunk;
+- ALL pair-vs-row dot products are computed at once:
+  ``scores = l1 · synᵀ`` — ONE [BLK, V] matmul per objective, amortized
+  over every HS level / negative partner, instead of one gather-matmul
+  per level (the round-3 kernel's cost was ~4·V·D MXU flops per level
+  per pair; this is ~6·V·D per OBJECTIVE per pair — ~4.7x fewer at
+  Huffman depth ~14);
+- the per-level work drops to VPU-only: extract ``f = scores[b, pts]``
+  by iota-compare, fold the resulting signed lr coefficient ``g`` into a
+  pair-major coefficient matrix ``G[b, v]`` (and its hit-mask twin
+  ``M``);
+- the level loop's matmuls then collapse to two per objective:
+  ``neu1e = G · syn`` (the input-side update) and ``acc += Gᵀ · l1``
+  (the output-side scatter), with per-row hit counts as column sums
+  of ``M`` — no [V, BLK]-narrow one-hots anywhere (pair-major [BLK, V]
+  layouts only, which Mosaic tiles cleanly at any BLK).
 
 The update math is IDENTICAL to ``nlp/word2vec._hs_update`` /
 ``_neg_update`` (bf16 matmuls, fp32 accumulation): per chunk, both
@@ -47,26 +53,33 @@ except ImportError:                      # pragma: no cover
 
 Array = jax.Array
 
-#: VMEM budget for the resident tables + accumulators + one-hot scratch
-#: (~14 MB of the ~16 MB/core VMEM; measured fitting at V=2000, D=100,
-#: BLK=2048 on v5e)
+#: VMEM budget for the resident tables + [BLK, V] score/coefficient
+#: planes + accumulators (~14 MB of the ~16 MB/core VMEM)
 VMEM_BUDGET_BYTES = 14 * 2 ** 20
+
+
+def _pad(x: int, m: int) -> int:
+    return -(-x // m) * m
 
 
 def choose_block(vocab: int, dim: int, negative: int, batch: int,
                  interpret: bool = False) -> int:
     """Largest grid block for which the VMEM model fits, or 0 when the
     vocabulary is too large for the resident kernel (callers then use the
-    XLA gather/scatter path).  On hardware, blocks below 1024 are
-    excluded — Mosaic rejects the narrow one-hot layouts they produce;
-    the interpreter (CPU test harness) has no such limit."""
+    XLA gather/scatter path)."""
     n_tables = 3 if negative > 0 else 2
-    # fp32 tables + their bf16 casts + fp32 accumulators (acc0 is 2(D+1))
-    fixed = vocab * (n_tables * dim * 6 + 4 * (dim + 1) * 4)
-    for blk in (2048, 1024):
+    n_obj = 1 + (1 if negative > 0 else 0)
+    vp = _pad(vocab, 128)
+    dp = _pad(dim, 128)
+    # bf16 tables + fp32 accumulators (acc0 is 2(D+1) wide)
+    fixed = n_tables * vocab * dp * 2 + \
+        vocab * (_pad(2 * (dim + 1), 128) + 2 * dp) * 4
+    for blk in (512, 256, 128):
         if batch % blk:
             continue
-        if fixed + 2 * vocab * blk <= VMEM_BUDGET_BYTES:
+        # per-step planes: oh0 + per-objective (scores + G + M), all bf16
+        planes = blk * vp * 2 * (1 + 3 * n_obj)
+        if fixed + planes <= VMEM_BUDGET_BYTES:
             return blk
     if interpret and batch <= 1024:
         return batch
@@ -90,67 +103,88 @@ def _kernel(alpha_ref, inputs_ref, targets_ref, pmask_ref,
     alpha = alpha_ref[0, 0]
     BLK = inputs_ref.shape[0]
     V0 = syn0_ref.shape[0]
-    D = syn0_ref.shape[1]
 
-    def one_hot_t(rows, v):
-        """[v, BLK] transposed one-hot of ``rows`` [BLK] — iota compare in
-        VMEM; used both as gather (contract dim 0) and scatter (dim 1)."""
-        iota = lax.broadcasted_iota(jnp.int32, (v, BLK), 0)
-        return (iota == rows[None, :]).astype(bf)
-
-    def gather(oht, table_ref):
-        return lax.dot_general(
-            oht, table_ref[...].astype(bf), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [BLK, D]
-
-    def scatter_acc(acc_ref, oht, upd, cnt):
-        payload = jnp.concatenate(
-            [upd, cnt[:, None]], axis=1).astype(bf)      # [BLK, D+1]
-        acc_ref[...] += lax.dot_general(
-            oht, payload, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [V, D+1]
+    def one_hot_pm(rows, v):
+        """[BLK, v] pair-major one-hot of ``rows`` [BLK] — iota compare
+        in VMEM (lane dim = vocab: wide layouts Mosaic tiles cleanly)."""
+        iota = lax.broadcasted_iota(jnp.int32, (BLK, v), 1)
+        return (iota == rows[:, None]).astype(bf)
 
     inp = inputs_ref[:]
-    oh0 = one_hot_t(inp, V0)
-    l1 = gather(oh0, syn0_ref)                           # [BLK, D] fp32
+    oh0 = one_hot_pm(inp, V0)
+    l1 = lax.dot_general(oh0, syn0_ref[...], (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)  # [BLK, D]
     l1bf = l1.astype(bf)
 
-    neu1e_hs = jnp.zeros((BLK, D), jnp.float32)
-    neu1e_ng = jnp.zeros((BLK, D), jnp.float32)
+    def objective(syn_ref, coeff_levels, n_levels):
+        """Shared dense-scores core: all pair-row dots in one matmul,
+        VPU level loop folds lr coefficients into G (and hit-masks into
+        M), then two matmuls recover the input-side update and the
+        output-side accumulator payload.
+
+        ``coeff_levels(l, f) -> (rows, g, hit)``: the level's partner
+        rows [BLK], signed lr coefficient g [BLK] (from the extracted
+        dot products f [BLK]) and hit mask [BLK]."""
+        v = syn_ref.shape[0]
+        scores = lax.dot_general(
+            l1bf, syn_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BLK, v]
+        iota = lax.broadcasted_iota(jnp.int32, (BLK, v), 1)
+
+        def level(l, carry):
+            G, M = carry
+            rows, g_fn, hit = coeff_levels(l)
+            eq = iota == rows[:, None]                     # [BLK, v]
+            f = jnp.sum(jnp.where(eq, scores, 0.0), axis=1)
+            g = g_fn(f)                                    # [BLK] fp32
+            G = G + jnp.where(eq, g[:, None], 0.0).astype(bf)
+            M = M + jnp.where(eq, hit[:, None], 0.0).astype(bf)
+            return G, M
+
+        zero = jnp.zeros((BLK, v), bf)
+        G, M = lax.fori_loop(0, n_levels, level, (zero, zero))
+        neu1e = lax.dot_general(
+            G, syn_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BLK, D]
+        # output-side accumulator: [v, D] grad sums + [v] hit counts
+        dacc = lax.dot_general(
+            G, l1bf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [v, D]
+        cnt = jnp.sum(M.astype(jnp.float32), axis=0)       # [v]
+        return neu1e, dacc, cnt
+
+    neu1e_hs = jnp.zeros_like(l1)
+    neu1e_ng = jnp.zeros_like(l1)
 
     if use_hs:
-        def hs_level(l, neu1e):
+        def hs_levels(l):
             pts = points_ref[pl.dslice(l, 1), :][0]
             code = codes_ref[pl.dslice(l, 1), :][0]
             m = mask_ref[pl.dslice(l, 1), :][0]
-            oht = one_hot_t(pts, syn1_ref.shape[0])
-            s1 = gather(oht, syn1_ref)                   # [BLK, D]
-            f = jax.nn.sigmoid(jnp.sum(l1 * s1, axis=1))
-            g = (1.0 - code - f) * alpha * m             # [BLK]
-            scatter_acc(acc1_ref, oht, g[:, None] * l1, m)
-            return neu1e + g[:, None] * s1
+            return pts, (lambda f: (1.0 - code - jax.nn.sigmoid(f))
+                         * alpha * m), m
 
-        neu1e_hs = lax.fori_loop(0, L, hs_level, neu1e_hs)
+        neu1e_hs, dacc1, cnt1 = objective(syn1_ref, hs_levels, L)
+        acc1_ref[...] += jnp.concatenate(
+            [dacc1, cnt1[:, None]], axis=1)
 
     if K > 0:
         tgt = targets_ref[:]
         pmask = pmask_ref[:]
 
-        def neg_partner(k, neu1e):
+        def ng_levels(k):
             rows = lax.cond(
                 k == 0, lambda: tgt,
-                lambda: negs_ref[
-                    pl.dslice(jnp.maximum(k - 1, 0), 1), :][0])
+                lambda: negs_ref[pl.dslice(jnp.maximum(k - 1, 0), 1),
+                                 :][0])
             label = jnp.where(k == 0, 1.0, 0.0)
-            valid = jnp.where((k == 0) | (rows != tgt), 1.0, 0.0)
-            oht = one_hot_t(rows, syn1neg_ref.shape[0])
-            sn = gather(oht, syn1neg_ref)
-            f = jax.nn.sigmoid(jnp.sum(l1 * sn, axis=1))
-            g = (label - f) * alpha * valid * pmask
-            scatter_acc(accn_ref, oht, g[:, None] * l1, valid * pmask)
-            return neu1e + g[:, None] * sn
+            valid = jnp.where((k == 0) | (rows != tgt), 1.0, 0.0) * pmask
+            return rows, (lambda f: (label - jax.nn.sigmoid(f))
+                          * alpha * valid), valid
 
-        neu1e_ng = lax.fori_loop(0, K + 1, neg_partner, neu1e_ng)
+        neu1e_ng, daccn, cntn = objective(syn1neg_ref, ng_levels, K + 1)
+        accn_ref[...] += jnp.concatenate(
+            [daccn, cntn[:, None]], axis=1)
 
     # syn0 accumulator: both objectives' contributions + their own count
     # channels in ONE [V0, 2(D+1)] matmul (outside: each part is divided
@@ -162,7 +196,7 @@ def _kernel(alpha_ref, inputs_ref, targets_ref, pmask_ref,
         [neu1e_hs, row_hs[:, None], neu1e_ng, row_ng[:, None]],
         axis=1).astype(bf)                               # [BLK, 2(D+1)]
     acc0_ref[...] += lax.dot_general(
-        oh0, payload0, (((1,), (0,)), ((), ())),
+        oh0, payload0, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
@@ -227,7 +261,11 @@ def fused_chunk_update(syn0: Array, syn1: Array, syn1neg: Array,
       inputs, targets, pmask,
       codes.T, points.T, mask.T,
       (negs.T if K > 0 else jnp.zeros((1, B), jnp.int32)),
-      syn0, syn1, syn1neg)
+      # tables enter pre-cast: the kernel reads bf16 (halves their VMEM
+      # footprint and skips a per-grid-step cast); the fp32 masters stay
+      # out here where the accumulator updates are applied
+      syn0.astype(jnp.bfloat16), syn1.astype(jnp.bfloat16),
+      syn1neg.astype(jnp.bfloat16))
 
     if use_hs:
         syn1 = syn1 + acc1[:, :D] / jnp.maximum(acc1[:, D:], 1.0)

@@ -409,3 +409,50 @@ def test_word2vec_real_corpus_tier():
         probe = wv.cache.word_for(0)        # to the most frequent word
     near = wv.words_nearest(probe, 5)
     assert len(near) == 5 and all(np.isfinite(s) for _, s in near)
+
+
+def test_word2vec_device_pair_mode():
+    """pair_mode='device': zero host pair work — the token stream
+    uploads once and each epoch is one dispatch that builds pairs,
+    masks sentence boundaries and the window shrink, and trains, all
+    on device.  Convergence quality matches the masked default, and
+    sentence boundaries are respected (no cross-sentence pairs)."""
+    base = dict(vector_size=48, window=3, epochs=30, alpha=0.05,
+                batch_size=1024, negative=5, use_hs=True, seed=3)
+    w2v = Word2Vec(CORPUS, Word2VecConfig(**base, pair_mode="device"))
+    wv = w2v.fit()
+    assert w2v._stream_cache is not None
+    assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")
+    assert wv.similarity("king", "queen") > wv.similarity("king", "mouse")
+    # refits reuse the uploaded stream and reproduce bit-for-bit
+    first = np.asarray(wv.vectors).copy()
+    wv2 = w2v.fit()
+    np.testing.assert_array_equal(np.asarray(wv2.vectors), first)
+
+
+def test_word2vec_device_mode_boundary_isolation():
+    """Two vocab-disjoint halves of a corpus must not influence each
+    other through the device-built pairs: words that never share a
+    sentence train only within their half, so each half's co-occurring
+    pair is more similar than any cross-half pair."""
+    corpus = (["alpha beta alpha beta alpha beta"] * 40
+              + ["gamma delta gamma delta gamma delta"] * 40)
+    cfg = Word2VecConfig(vector_size=32, window=2, epochs=25, alpha=0.05,
+                         batch_size=512, negative=5, use_hs=True, seed=5,
+                         pair_mode="device")
+    wv = Word2Vec(corpus, cfg).fit()
+    assert wv.similarity("alpha", "beta") > wv.similarity("alpha", "delta")
+    assert wv.similarity("gamma", "delta") > wv.similarity("gamma", "beta")
+
+
+def test_word2vec_device_mode_pallas_interpret():
+    """The device-built pair path drives the fused kernel (interpreter
+    off-TPU) and stays finite/semantically sane."""
+    cfg = Word2VecConfig(vector_size=32, window=3, epochs=10, alpha=0.05,
+                         batch_size=512, negative=3, use_hs=True, seed=3,
+                         pair_mode="device", kernel="pallas")
+    w2v = Word2Vec(CORPUS, cfg)
+    wv = w2v.fit()
+    assert w2v.kernel_used == "pallas-interpret"
+    assert np.isfinite(np.asarray(wv.vectors)).all()
+    assert wv.similarity("cat", "dog") > wv.similarity("cat", "castle")

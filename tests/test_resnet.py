@@ -71,9 +71,10 @@ def test_train_step_dp_mesh_converges(devices):
 
 
 def test_stem_s2d_exact_equivalence():
-    """The space-to-depth stem is the SAME arithmetic as the 7x7/s2 conv
-    — exact fp32 equality at every output element, including all four
-    SAME-padding borders."""
+    """The space-to-depth stem computes the same contraction as the
+    7x7/s2 conv — numerically equivalent up to reduction order (the
+    4x4/s1 re-tiling changes the order XLA sums the 7*7*3 products, so
+    fp32 results differ at ~1e-5 across backends; see VERDICT r3 #1)."""
     rng = np.random.default_rng(7)
     x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
     w = jnp.asarray(rng.normal(size=(7, 7, 3, 16)).astype(np.float32))
@@ -81,7 +82,8 @@ def test_stem_s2d_exact_equivalence():
         x, w, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
     got = resnet._stem_s2d_conv(x, w, jnp.float32)
     assert got.shape == ref.shape == (2, 16, 16, 16)
-    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_stem_s2d_full_model_matches():

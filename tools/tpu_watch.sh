@@ -3,14 +3,21 @@
 # (tools/measure_tpu.py — skips configs already captured, exits 1 on a
 # mid-sweep tunnel drop).  Loops until every config is captured on TPU.
 # Status lines -> tools/tpu_watch.status ; sweep output appends to
-# TPU_SWEEP_r03.log ; per-config results -> TPU_SWEEP_STATE.json
+# TPU_SWEEP_r04.log ; per-config results -> TPU_SWEEP_STATE.json
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 STATUS="$REPO/tools/tpu_watch.status"
-SWEEP="$REPO/TPU_SWEEP_r03.log"
+SWEEP="$REPO/TPU_SWEEP_r04.log"
 LOCK="$REPO/tools/tpu_watch.lock"
 
 exec 9>"$LOCK"
 flock -n 9 || { echo "another watcher is running" >&2; exit 0; }
+
+# Round-3 postmortem: a stale sweep from a previous window overwrote the
+# state file and dropped a banked row.  That overwrite is now impossible
+# (per-row flock read-merge-write in measure_tpu.py + a process-lifetime
+# sweep lock that makes a second concurrent sweep abort), so no pkill —
+# killing by pattern would also take down the driver's own end-of-round
+# bench children or a legitimate manual sweep mid-bank.
 
 while true; do
   ts=$(date -u +%H:%M:%S)
