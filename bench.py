@@ -818,14 +818,21 @@ def bench_longctx32k():
 INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
          "scaling": bench_scaling, "longctx": bench_longctx,
-         "longctx32k": bench_longctx32k, "glove": bench_glove}
+         "longctx32k": bench_longctx32k, "glove": bench_glove,
+         # BERT MFU sweep points (VERDICT r3 next #6): batch scaling at
+         # T=128 and the flash-enabled T=512 point; the sweep banks each
+         # and promotes the best seq128 row to the headline
+         "bert_b64": lambda: bench_bert(64, 128, 20),
+         "bert_b128": lambda: bench_bert(128, 128, 10),
+         "bert_b256": lambda: bench_bert(256, 128, 10),
+         "bert_T512b32": lambda: bench_bert(32, 512, 10)}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
 TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             "lenet": (600, 420),
-            # word2vec runs warm+cold for BOTH pair modes (4 fits)
-            "word2vec": (1200, 600),
+            # word2vec runs warm+cold for all THREE pair modes (6 fits)
+            "word2vec": (1500, 900),
             "scaling": (0, 600), "longctx": (720, 420),
             "longctx32k": (1200, 0), "glove": (600, 420)}
 

@@ -33,11 +33,16 @@ SWEEP_LOCK = os.path.join(REPO, "tools", "tpu_sweep.lock")
 CONFIGS = [
     ("bert", 1200),
     ("lenet", 600),
-    ("word2vec", 900),
+    ("word2vec", 1500),     # 3 pair modes x (warm+cold) since r4
     ("glove", 900),
     ("longctx", 1200),
     ("resnet", 1800),
     ("longctx32k", 1500),
+    # BERT MFU sweep (r4): batch scaling at T=128 + flash T=512 point
+    ("bert_b64", 1200),
+    ("bert_b128", 1200),
+    ("bert_b256", 1200),
+    ("bert_T512b32", 1500),
 ]
 
 # word2vec depth-bucket / exact-pair A/B (VERDICT r2 next-step #2): each
@@ -214,6 +219,18 @@ def main() -> None:
             print(json.dumps({"config": name, "error": detail or "empty"}),
                   flush=True)
     state = load_state()
+    # promote the best captured seq128 BERT row to the headline slot —
+    # the MFU sweep's whole point (value is samples/sec/chip; all
+    # candidates share the seq128 metric name)
+    cands = [state[k] for k in ("bert", "bert_b64", "bert_b128",
+                                "bert_b256")
+             if (state.get(k) or {}).get("platform") == "tpu"]
+    if cands:
+        best = max(cands, key=lambda r: r.get("value") or 0)
+        if best.get("value") != (state.get("bert") or {}).get("value"):
+            bank_row("bert", best)
+            print(json.dumps({"promoted_bert": best.get("config_sig")}),
+                  flush=True)
     still = [w[0] for w in work
              if (state.get(w[0]) or {}).get("platform") != "tpu"]
     sys.exit(1 if still else 0)
