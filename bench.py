@@ -834,7 +834,11 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             # word2vec runs warm+cold for all THREE pair modes (6 fits)
             "word2vec": (1500, 900),
             "scaling": (0, 600), "longctx": (720, 420),
-            "longctx32k": (1200, 0), "glove": (600, 420)}
+            "longctx32k": (1200, 0), "glove": (600, 420),
+            # BERT MFU sweep points: tpu-only, like longctx32k (a CPU
+            # fallback would just repeat the tiny-model bert row)
+            "bert_b64": (1200, 0), "bert_b128": (1200, 0),
+            "bert_b256": (1200, 0), "bert_T512b32": (1500, 0)}
 
 
 # -- perf-regression guard --------------------------------------------------

@@ -171,8 +171,13 @@ def find_lfw() -> Optional[str]:
     person-subdirectory tree, a directory containing an ``lfw*.tgz``
     archive, or a path directly to the archive.  Returns the usable path
     (dir or archive) or None."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     candidates = [os.environ.get("LFW_DIR"),
                   os.path.join(os.getcwd(), "data", "lfw"),
+                  # the committed tiny corpus ships with the repo — found
+                  # regardless of the caller's cwd
+                  os.path.join(repo_root, "data", "lfw"),
                   os.path.expanduser("~/.dl4j-tpu/lfw")]
     exts = (".jpg", ".jpeg", ".pgm", ".ppm")
     for c in candidates:

@@ -163,8 +163,11 @@ def fused_glove_chunk(wext: Array, wtext: Array, rows: Array, cols: Array,
 
 def apply_chunk(table_b: Array, gsq_b: Array, acc: Array, alpha):
     """Apply one side's accumulators to (weights|bias) [V, D+1] and
-    their AdaGrad state [V, D+1] — the exact scatter-path algebra:
-    gsq += sum_sq / k^2 ; step = alpha * (sum/k) / sqrt(gsq + eps)."""
+    their AdaGrad state [V, D+1] — the same ALGEBRA as the scatter path
+    (gsq += sum_sq / k^2 ; step = alpha * (sum/k) / sqrt(gsq + eps)),
+    at bf16 precision: the accumulators arrive from bf16 kernel matmuls,
+    so parity with the fp32 XLA path is approximate (rtol ~3e-2), not
+    bitwise."""
     d1 = table_b.shape[1]
     cnt = jnp.maximum(acc[:, 2 * d1:2 * d1 + 1], 1.0)
     grad = acc[:, :d1] / cnt

@@ -228,7 +228,7 @@ def main() -> None:
     if cands:
         best = max(cands, key=lambda r: r.get("value") or 0)
         if best.get("value") != (state.get("bert") or {}).get("value"):
-            bank_row("bert", best)
+            state = bank_row("bert", best)
             print(json.dumps({"promoted_bert": best.get("config_sig")}),
                   flush=True)
     still = [w[0] for w in work
