@@ -211,8 +211,15 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128,
 
 
 def bench_resnet(batch_size: int = 128, image_size: int = 224,
-                 steps: int = 20):
-    """ResNet-50 training throughput (BASELINE.json configs)."""
+                 steps: int = 20, stem_s2d: bool = False):
+    """ResNet-50 training throughput (BASELINE.json configs).
+
+    ``stem_s2d`` re-tiles the 7x7/s2 stem as a 4x4/s1 conv on the 2x2
+    space-to-depth input (12 input channels instead of 3 — the classic
+    TPU stem trick; same arithmetic, tests/test_resnet.py): a sweep
+    variant, promoted to the headline row when faster."""
+    import dataclasses as _dc
+
     import jax
     from deeplearning4j_tpu.models import resnet
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -223,6 +230,8 @@ def bench_resnet(batch_size: int = 128, image_size: int = 224,
         batch_size, image_size, steps = 8, 32, 3
     else:
         cfg = resnet.resnet50()
+    if stem_s2d and cfg.stem_kernel == 7:   # tiny CPU stem is not 7x7/s2
+        cfg = _dc.replace(cfg, stem_s2d=True)
 
     mesh = make_mesh(MeshSpec(data=n_dev), devices=jax.devices())
     # scanned steps: one dispatch for the whole measured window (see
@@ -249,7 +258,8 @@ def bench_resnet(batch_size: int = 128, image_size: int = 224,
         "vs_baseline": round(sps / A100_RESNET50_IPS, 3),
         "platform": platform,
         "n_devices": n_dev,
-        "config_sig": f"b{batch_size}_{image_size}px_s{steps}",
+        "config_sig": f"b{batch_size}_{image_size}px_s{steps}"
+                      + ("_s2d" if stem_s2d else ""),
         "final_loss": round(final_loss, 4),
         "model_tflops_per_step": round(flops / 1e12, 4),
         "mfu": _mfu(flops, dt / steps / 1, kind, n_dev) if flops else None,
@@ -825,7 +835,8 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "bert_b64": lambda: bench_bert(64, 128, 20),
          "bert_b128": lambda: bench_bert(128, 128, 10),
          "bert_b256": lambda: bench_bert(256, 128, 10),
-         "bert_T512b32": lambda: bench_bert(32, 512, 10)}
+         "bert_T512b32": lambda: bench_bert(32, 512, 10),
+         "resnet_s2d": lambda: bench_resnet(stem_s2d=True)}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
@@ -838,7 +849,8 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             # BERT MFU sweep points: tpu-only, like longctx32k (a CPU
             # fallback would just repeat the tiny-model bert row)
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
-            "bert_b256": (1200, 0), "bert_T512b32": (1500, 0)}
+            "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
+            "resnet_s2d": (1800, 0)}
 
 
 # -- perf-regression guard --------------------------------------------------

@@ -28,6 +28,16 @@ def main() -> None:
     wv = w2v.fit()
     print("word2vec nearest(sea):", wv.words_nearest("sea", 3))
 
+    # pair_mode="device": the token stream uploads once and every epoch
+    # is ONE dispatch building + training all pairs on device (best for
+    # large corpora / high-latency links).  Pass a mesh to fit() to
+    # data-parallel it across chips with per-epoch parameter averaging.
+    w2v_dev = Word2Vec(CORPUS, Word2VecConfig(
+        vector_size=48, window=3, epochs=60, negative=5, use_hs=True,
+        batch_size=4096, alpha=0.05, pair_mode="device"))
+    wv_dev = w2v_dev.fit()
+    print("word2vec[device] nearest(sea):", wv_dev.words_nearest("sea", 3))
+
     glove = Glove(CORPUS, GloveConfig(vector_size=64, epochs=25))
     gv = glove.fit()
     print("glove  sim(cat,dog) =", round(gv.similarity("cat", "dog"), 3),

@@ -257,3 +257,21 @@ def test_sharded_bert_train_state_resharded_resume(tmp_path):
         jax.tree.map(np.asarray, state), jax.tree.map(np.asarray, restored))
     state2, loss = step_b(restored, batch, jax.random.key(3))
     assert int(state2.step) == 2 and np.isfinite(float(loss))
+
+
+def test_sharded_missing_shard_is_hard_error(tmp_path):
+    """A sharded checkpoint with a missing per-process file must refuse
+    to restore (silently zero-filling the absent regions would corrupt a
+    resume)."""
+    tree = {"w": jnp.arange(8.0)}
+    p = str(tmp_path / "s")
+    ckpt.save_pytree_sharded(p, tree)
+    # claim the save involved 2 processes; only p0's file exists
+    idx_path = os.path.join(p, "index.json")
+    with open(idx_path) as f:
+        idx = json.load(f)
+    idx["n_procs"] = 2
+    with open(idx_path, "w") as f:
+        json.dump(idx, f)
+    with pytest.raises(FileNotFoundError, match="incomplete"):
+        ckpt.load_pytree_sharded(p, tree)

@@ -43,7 +43,15 @@ CONFIGS = [
     ("bert_b128", 1200),
     ("bert_b256", 1200),
     ("bert_T512b32", 1500),
+    # space-to-depth stem variant (TPU stem trick)
+    ("resnet_s2d", 1800),
 ]
+
+#: headline slot <- best of its sweep variants (same metric family)
+PROMOTIONS = {
+    "bert": ("bert", "bert_b64", "bert_b128", "bert_b256"),
+    "resnet": ("resnet", "resnet_s2d"),
+}
 
 # word2vec depth-bucket / exact-pair A/B (VERDICT r2 next-step #2): each
 # variant is its own subprocess so a tunnel drop keeps earlier variants.
@@ -219,18 +227,18 @@ def main() -> None:
             print(json.dumps({"config": name, "error": detail or "empty"}),
                   flush=True)
     state = load_state()
-    # promote the best captured seq128 BERT row to the headline slot —
-    # the MFU sweep's whole point (value is samples/sec/chip; all
-    # candidates share the seq128 metric name)
-    cands = [state[k] for k in ("bert", "bert_b64", "bert_b128",
-                                "bert_b256")
-             if (state.get(k) or {}).get("platform") == "tpu"]
-    if cands:
+    # promote each headline slot to the best of its captured sweep
+    # variants (value is per-chip throughput within one metric family)
+    for slot, group in PROMOTIONS.items():
+        cands = [state[k] for k in group
+                 if (state.get(k) or {}).get("platform") == "tpu"]
+        if not cands:
+            continue
         best = max(cands, key=lambda r: r.get("value") or 0)
-        if best.get("value") != (state.get("bert") or {}).get("value"):
-            state = bank_row("bert", best)
-            print(json.dumps({"promoted_bert": best.get("config_sig")}),
-                  flush=True)
+        if best.get("value") != (state.get(slot) or {}).get("value"):
+            state = bank_row(slot, best)
+            print(json.dumps({f"promoted_{slot}":
+                              best.get("config_sig")}), flush=True)
     still = [w[0] for w in work
              if (state.get(w[0]) or {}).get("platform") != "tpu"]
     sys.exit(1 if still else 0)
