@@ -471,11 +471,16 @@ def run_stream_training(syn0, syn1, syn1neg, indexed, *,
         pallas_block = 0
     kernel_used = kernel_name(pallas_block, pallas_interpret)
 
+    n_shards = int(mesh.shape[data_axis]) if mesh is not None else 1
     if stream_cache is None:
         # separator-delimited stream: sentence ids come from a cumsum on
-        # device, so only ONE int32 array rides the link
+        # device, so only ONE int32 array rides the link.  NC is padded
+        # only to a multiple of n_shards (1 when unsharded) — a previous
+        # next-power-of-two pad made up to ~2x of every epoch's scan
+        # steps process fully-masked -1 filler.
         n_stream = int(sum(a.size + 1 for a in indexed))
-        NC = max(1, 1 << (-(-n_stream // pos_chunk) - 1).bit_length())
+        NC = -(-n_stream // pos_chunk)
+        NC = max(n_shards, -(-NC // n_shards) * n_shards)
         stream = np.full(NC * pos_chunk, -1, np.int32)
         off = 0
         for a in indexed:
@@ -491,16 +496,28 @@ def run_stream_training(syn0, syn1, syn1neg, indexed, *,
     if not had_neg:
         syn1neg = jnp.zeros((1, 1), jnp.float32)
     NC = stream_cache["n_chunks"]
-    n_shards = int(mesh.shape[data_axis]) if mesh is not None else 1
-    if n_shards > 1 and NC % n_shards == 0:
-        epoch_fn = stream_cache.get("dp_epoch_fn")
+    if n_shards > 1 and NC % n_shards != 0:
+        # Silently ignoring the mesh would train single-device while the
+        # caller believes it is data-parallel; surface the mismatch.
+        raise ValueError(
+            f"stream cache has {NC} chunks, not divisible by the mesh's "
+            f"{n_shards} '{data_axis}' shards; rebuild the cache (fit a "
+            f"fresh instance with mesh=) instead of reusing this one")
+    if n_shards > 1:
+        # dp epoch fns are keyed by mesh layout: reusing a jitted
+        # shard_map closed over a dead/different mesh trains on the
+        # wrong layout or crashes (ADVICE r4, medium)
+        mesh_key = (tuple(d.id for d in mesh.devices.flat), data_axis,
+                    n_shards, NC // n_shards)
+        dp_fns = stream_cache.setdefault("dp_epoch_fns", {})
+        epoch_fn = dp_fns.get(mesh_key)
         if epoch_fn is None:
             epoch_fn = make_dp_stream_epoch(
                 mesh, data_axis, n_shards, NC // n_shards,
                 use_hs=use_hs, negative=negative, window=window,
                 pos_chunk=pos_chunk, pallas_block=pallas_block,
                 pallas_interpret=pallas_interpret)
-            stream_cache["dp_epoch_fn"] = epoch_fn
+            dp_fns[mesh_key] = epoch_fn
         for epoch in range(epochs):
             syn0, syn1, syn1neg = epoch_fn(
                 syn0, syn1, syn1neg, stream_cache["tok"],

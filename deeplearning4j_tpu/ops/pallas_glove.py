@@ -189,9 +189,15 @@ def probe_compile(block: int, vocab_size: int = 128, dim: int = 8,
 
     The compile runs in a daemon thread joined with ``timeout_s``: a
     Mosaic compile that HANGS (round-3: glove died as a 900 s bench
-    timeout) reads as a reject and the fit proceeds on XLA.  This only
-    helps when the hung compile releases the GIL (jaxlib's compile call
-    does); bench.py additionally probes in a killable subprocess."""
+    timeout) reads as a reject and the fit proceeds on XLA.  CAVEAT
+    (ADVICE r4): a timeout verdict abandons the hung compile thread
+    ALIVE — it may still hold jaxlib's compile lock, so the subsequent
+    in-process XLA compile can block behind it until it finishes or the
+    process exits; there is no way to cancel a compile from Python, and
+    a killable-subprocess probe is impossible here because by fit()
+    time this process already holds the (single-holder) TPU chip.
+    Callers that can probe BEFORE backend init should do so in their
+    own subprocess — bench.py's ``_glove_mosaic_probe`` is that path."""
     key = (block, vocab_size, dim)
     if key in _PROBE_CACHE:
         return _PROBE_CACHE[key]
@@ -220,7 +226,9 @@ def probe_compile(block: int, vocab_size: int = 128, dim: int = 8,
     ok = bool(result.get("ok"))
     if not ok:
         import logging
-        why = ("compile timed out after %.0fs" % timeout_s
+        why = ("compile timed out after %.0fs — the hung Mosaic compile "
+               "thread is abandoned alive and may delay this process's "
+               "next compile" % timeout_s
                if t.is_alive() else result.get("err"))
         logging.getLogger(__name__).warning(
             "glove Pallas kernel unavailable on this backend (%s); "

@@ -71,9 +71,11 @@ def choose_block(vocab: int, dim: int, negative: int, batch: int,
     n_obj = 1 + (1 if negative > 0 else 0)
     vp = _pad(vocab, 128)
     dp = _pad(dim, 128)
-    # bf16 tables + fp32 accumulators (acc0 is 2(D+1) wide)
+    # bf16 tables + fp32 accumulators: acc0 is 2(D+1) wide, acc1/accn
+    # are [V, D+1] — pad(dim+1), not pad(dim): at dim%128==0 the +1
+    # forces a whole extra 128-lane tile per table (ADVICE r4)
     fixed = n_tables * vocab * dp * 2 + \
-        vocab * (_pad(2 * (dim + 1), 128) + 2 * dp) * 4
+        vocab * (_pad(2 * (dim + 1), 128) + 2 * _pad(dim + 1, 128)) * 4
     for blk in (512, 256, 128):
         if batch % blk:
             continue

@@ -232,6 +232,21 @@ def load_pytree_sharded(path: str, like: Optional[PyTree] = None
                  (lambda d=data, k=key: d[k])))
     paths, shapes = index["paths"], index["shapes"]
     dtypes = [np.dtype(d) for d in index["dtypes"]]
+    # every leaf's pieces must tile its full global shape: a truncated or
+    # partially-written piece table would otherwise restore the missing
+    # regions as _assemble's zero-init — the exact corruption the missing-
+    # file guard above exists to prevent.  Pieces are disjoint by
+    # construction (each process saves its addressable shards), so
+    # coverage == sum of piece volumes.  Requires all per-process shard
+    # files on one shared filesystem (same assumption as the save).
+    for i, shp in enumerate(shapes):
+        total = int(np.prod(shp)) if shp else 1
+        got = sum(int(np.prod(ps)) for _, ps, _ in leaf_pieces.get(i, []))
+        if got != total:
+            raise ValueError(
+                f"sharded checkpoint at {path} has incomplete coverage "
+                f"for leaf {paths[i]!r}: pieces cover {got} of {total} "
+                f"elements (truncated piece table?)")
 
     def full(i):
         return _assemble(tuple(slice(0, s) for s in shapes[i]),
