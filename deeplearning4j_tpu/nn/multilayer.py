@@ -452,15 +452,48 @@ class MultiLayerNetwork:
         else:
             for epoch in range(num_epochs):
                 for batch in batches:
-                    params, ustate, score = train_step(
-                        params, ustate, batch.features, batch.labels,
-                        run_key, it)
-                    # float(score) synchronizes host<->device; only pay
-                    # for it when someone is listening
-                    if self.listeners:
-                        for ls in self.listeners:
-                            ls.iteration_done(self, it, float(score))
-                    it += 1
+                    params, ustate, it = self._step_and_notify(
+                        train_step, params, ustate, batch, run_key, it)
+        self.params = params
+
+    def _step_and_notify(self, train_step, params, ustate, batch,
+                         run_key, step):
+        """One train_step dispatch + listener replay — shared by the
+        per-step fit_backprop branch and fit_iterator so the two
+        streaming paths can't drift."""
+        params, ustate, score = train_step(
+            params, ustate, batch.features, batch.labels, run_key, step)
+        # float(score) synchronizes host<->device; only pay for it when
+        # someone is listening
+        if self.listeners:
+            for ls in self.listeners:
+                ls.iteration_done(self, step, float(score))
+        return params, ustate, step + 1
+
+    def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2) -> None:
+        """Streaming supervised training straight from a
+        ``DataSetIterator`` — the reference's ``fit(DataSetIterator)``
+        entry point (nn/multilayer/MultiLayerNetwork.java:918) where the
+        data does NOT live on device up front.
+
+        Each pulled batch is dispatched asynchronously: while the device
+        runs step ``k``, the iterator (e.g. the native producer thread
+        behind ``NativeBatchIterator``, or a prefetching
+        ``StoreDataSetIterator``) assembles batch ``k+1`` on host — so
+        ingestion overlaps compute instead of serializing with it.
+        Updater state persists across the whole call (unlike repeated
+        single-batch ``fit_backprop`` calls, which would reset
+        momentum)."""
+        params = self._require_params()
+        train_step, _, updaters = self._backprop_machinery()
+        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        run_key = jax.random.key(seed)
+        step = 0
+        for _ in range(num_epochs):
+            it.reset()
+            while it.has_next():
+                params, ustate, step = self._step_and_notify(
+                    train_step, params, ustate, it.next(), run_key, step)
         self.params = params
 
     # -- fit (fit:918 parity: pretrain -> finetune -> optional backprop) ---

@@ -142,3 +142,25 @@ def test_batchnorm_running_stats_update_in_fit_backprop():
     assert not np.allclose(rv0, rv1), "running_var never updated"
     # EMA of finite batch stats stays finite and var positive
     assert np.all(np.isfinite(rm1)) and np.all(rv1 > 0)
+
+
+def test_fit_iterator_streams_and_converges():
+    """fit_iterator trains straight from a DataSetIterator (the
+    reference's fit(DataSetIterator) entry, MultiLayerNetwork.java:918)
+    with updater state persisting across the whole call; batches ride
+    host->device inside the loop (the ingestion-inclusive path the lenet
+    bench headline measures)."""
+    from deeplearning4j_tpu.datasets.iterator import NativeBatchIterator
+
+    data = _iris()
+    x = np.asarray(data.features, np.float32)
+    y = np.asarray(data.labels, np.float32)
+    it = NativeBatchIterator(x, y, batch_size=30, seed=7)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    before = net.score(data)
+    net.fit_iterator(it, num_epochs=60)
+    after = net.score(data)
+    it.close()
+    assert after < before
+    ev = net.evaluate(data)
+    assert ev.accuracy() > 0.85, ev.stats()
