@@ -66,9 +66,14 @@ _CANDIDATE_NAMES = {
 
 
 def find_mnist_dir() -> Optional[str]:
-    """Look for idx files in $MNIST_DIR, ./data/mnist, ~/.dl4j-tpu/mnist."""
+    """Look for idx files in $MNIST_DIR, ./data/mnist, the repo's own
+    data/mnist (committed fixture tier — found regardless of cwd), and
+    ~/.dl4j-tpu/mnist."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     candidates = [os.environ.get("MNIST_DIR"),
                   os.path.join(os.getcwd(), "data", "mnist"),
+                  os.path.join(repo_root, "data", "mnist"),
                   os.path.expanduser("~/.dl4j-tpu/mnist")]
     for d in candidates:
         if not d or not os.path.isdir(d):

@@ -471,10 +471,13 @@ class MultiLayerNetwork:
         return params, ustate, step + 1
 
     def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2) -> None:
-        """Streaming supervised training straight from a
-        ``DataSetIterator`` — the reference's ``fit(DataSetIterator)``
-        entry point (nn/multilayer/MultiLayerNetwork.java:918) where the
-        data does NOT live on device up front.
+        """STREAMING supervised backprop straight from a
+        ``DataSetIterator`` — the backprop stage of the reference's
+        ``fit(DataSetIterator)`` (nn/multilayer/MultiLayerNetwork.java:918)
+        for data that does NOT live on device up front.  Confs wanting
+        the pretrain path must use ``fit`` (greedy layer-wise pretrain
+        needs per-layer passes over materialized activations and has no
+        streaming form); this raises rather than silently diverging.
 
         Each pulled batch is dispatched asynchronously: while the device
         runs step ``k``, the iterator (e.g. the native producer thread
@@ -484,6 +487,12 @@ class MultiLayerNetwork:
         Updater state persists across the whole call (unlike repeated
         single-batch ``fit_backprop`` calls, which would reset
         momentum)."""
+        if self.conf.pretrain or not self.conf.backprop:
+            raise ValueError(
+                "fit_iterator is the streaming backprop trainer; this "
+                "conf wants pretrain/finetune (pretrain="
+                f"{self.conf.pretrain}, backprop={self.conf.backprop}) — "
+                "use fit() with materialized batches")
         params = self._require_params()
         train_step, _, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]

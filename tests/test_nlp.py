@@ -383,9 +383,11 @@ def test_word2vec_real_corpus_tier():
     $TEXT_CORPUS or drop a file at ./data/text8."""
     import os
 
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.environ.get("TEXT_CORPUS")
     if not path:
-        for c in ("data/text8", os.path.expanduser("~/.dl4j-tpu/text8")):
+        for c in ("data/text8", os.path.join(repo, "data", "text8"),
+                  os.path.expanduser("~/.dl4j-tpu/text8")):
             if os.path.isfile(c):
                 path = c
                 break

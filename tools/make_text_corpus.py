@@ -1,0 +1,50 @@
+"""Build a local plain-text corpus (``data/text8``) from the Python
+standard library's docstrings — real English prose available on any host
+with zero egress.  The committed ``data/text8`` was produced by this
+script; re-run to regenerate (deterministic module order).
+
+The word2vec quality tier (tests/test_nlp.py real-corpus tier) wants
+text8-style input: lowercase words, single spaces, vocabulary in the
+thousands with a natural Zipf head ("the", "of", "and", ...).
+"""
+import io
+import pkgutil
+import pydoc
+import re
+import sys
+
+
+def harvest(limit_bytes: int = 2_000_000) -> str:
+    out = io.StringIO()
+    seen = set()
+    names = sorted(m.name for m in pkgutil.iter_modules()
+                   if m.name.isidentifier() and not m.name.startswith("_"))
+    for name in names:
+        if out.tell() >= limit_bytes:
+            break
+        try:
+            mod = __import__(name)
+        except Exception:
+            continue
+        for obj in [mod] + [getattr(mod, a, None) for a in dir(mod)
+                            if not a.startswith("_")]:
+            doc = pydoc.getdoc(obj) if obj is not None else ""
+            if not doc or id(obj) in seen:
+                continue
+            seen.add(id(obj))
+            words = re.findall(r"[a-z]+", doc.lower())
+            if len(words) >= 8:
+                out.write(" ".join(words) + " ")
+            if out.tell() >= limit_bytes:
+                break
+    return out.getvalue()
+
+
+if __name__ == "__main__":
+    dest = sys.argv[1] if len(sys.argv) > 1 else "data/text8"
+    text = harvest()
+    with open(dest, "w") as f:
+        f.write(text)
+    words = text.split()
+    print(f"wrote {dest}: {len(text)} bytes, {len(words)} words, "
+          f"{len(set(words))} distinct")
