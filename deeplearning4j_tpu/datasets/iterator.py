@@ -252,7 +252,9 @@ class PrefetchIterator(DataSetIterator):
                     ds = DataSet(jax.device_put(ds.features, self.device),
                                  jax.device_put(ds.labels, self.device))
                 q.put(ds)
-        finally:
+        except Exception as e:      # surfaced by next(); a swallowed
+            q.put(e)                # error would read as a clean (short)
+        finally:                    # end of epoch
             q.put(self._STOP)
 
     def _ensure_started(self) -> None:
@@ -280,6 +282,10 @@ class PrefetchIterator(DataSetIterator):
         if not self.has_next():
             raise StopIteration
         ds, self._peeked = self._peeked, None
+        if isinstance(ds, Exception):
+            # producer died on this batch; the epoch is over (has_next
+            # -> False after the trailing STOP) — callers never hang
+            raise RuntimeError("prefetch producer failed") from ds
         return self._post(ds)
 
     def reset(self) -> None:

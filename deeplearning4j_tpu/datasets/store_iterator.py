@@ -1,0 +1,142 @@
+"""Stream training data straight out of an ``ArtifactStore``.
+
+Reference parity: ``deeplearning4j-aws/src/main/java/org/deeplearning4j/
+aws/s3/reader/BaseS3DataSetIterator.java:29`` + ``BucketIterator.java`` —
+the reference trains directly from serialized DataSets in an S3 bucket.
+Here the store is the SPI (``cloud/artifacts.py``: local shared-filesystem
+store now, GCS later), one key = one serialized minibatch, and the
+existing ``PrefetchIterator`` machinery keeps ``depth`` batches in flight
+so store IO overlaps device compute (one prefetch implementation in the
+codebase, not two).
+
+Worker splits: ``shard_index/num_shards`` give each data-parallel worker
+a disjoint, deterministic subset of the keys (BucketIterator's role in
+the reference's multi-worker S3 reads).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.cloud.artifacts import ArtifactStore
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (DataSetIterator,
+                                                  PrefetchIterator)
+
+
+def dataset_to_bytes(ds: DataSet) -> bytes:
+    """One minibatch -> npz bytes (features + labels, exact dtypes)."""
+    buf = io.BytesIO()
+    np.savez(buf, features=np.asarray(ds.features),
+             labels=np.asarray(ds.labels))
+    return buf.getvalue()
+
+
+def dataset_from_bytes(blob: bytes) -> DataSet:
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        return DataSet(z["features"], z["labels"])
+
+
+def write_batches_to_store(store: ArtifactStore, prefix: str,
+                           batches: Sequence[DataSet]) -> List[str]:
+    """Persist minibatches under ``prefix`` with zero-padded keys so the
+    store's sorted ``list()`` preserves batch order.  Returns the keys."""
+    keys = []
+    width = max(5, len(str(max(len(batches) - 1, 0))))
+    for i, ds in enumerate(batches):
+        key = f"{prefix.rstrip('/')}/batch_{i:0{width}d}.npz"
+        store.put(key, dataset_to_bytes(ds))
+        keys.append(key)
+    return keys
+
+
+class _StoreBatches(DataSetIterator):
+    """Synchronous core: fetch + deserialize one key per ``next()``."""
+
+    def __init__(self, store: ArtifactStore, keys: List[str]):
+        self.store = store
+        self.keys = keys
+        self._cursor = 0
+        # one fetch serves both the shape metadata and the first next()
+        self._first: Optional[DataSet] = dataset_from_bytes(
+            store.get(keys[0]))
+        super().__init__(self._first.num_examples())
+        self._shape = (self._first.num_inputs(),
+                       self._first.num_outcomes())
+        self._last_n: Optional[int] = None
+
+    def has_next(self) -> bool:
+        return self._cursor < len(self.keys)
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        if self._cursor == 0 and self._first is not None:
+            ds, self._first = self._first, None
+        else:
+            ds = dataset_from_bytes(self.store.get(self.keys[self._cursor]))
+        self._cursor += 1
+        return self._post(ds)
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def total_examples(self) -> int:
+        # exact even with a ragged LAST batch (batch_by's shape): all
+        # keys but the last hold ``batch`` examples.  The last batch's
+        # size is fetched lazily once and cached.
+        if len(self.keys) == 1:
+            return self.batch
+        if self._last_n is None:
+            self._last_n = dataset_from_bytes(
+                self.store.get(self.keys[-1])).num_examples()
+        return self.batch * (len(self.keys) - 1) + self._last_n
+
+    def input_columns(self) -> int:
+        return self._shape[0]
+
+    def total_outcomes(self) -> int:
+        return self._shape[1]
+
+
+class StoreDataSetIterator(PrefetchIterator):
+    """DataSetIterator over serialized minibatches in an ArtifactStore,
+    with ``depth`` batches prefetched by the shared ``PrefetchIterator``
+    producer thread (deserialized, ready to dispatch).  ``reset()``
+    restarts the stream — one pass over this worker's shard per epoch.
+    Works anywhere a DataSetIterator does, e.g.
+    ``MultiLayerNetwork.fit_iterator``.  A store fetch failure raises
+    RuntimeError from ``next()`` and cleanly ends the epoch."""
+
+    def __init__(self, store: ArtifactStore, prefix: str,
+                 shard_index: int = 0, num_shards: int = 1,
+                 depth: int = 4, keys: Optional[Sequence[str]] = None,
+                 device=None):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(
+                f"shard_index {shard_index} not in [0, {num_shards})")
+        all_keys = sorted(keys) if keys is not None else store.list(prefix)
+        if not all_keys:
+            raise ValueError(f"no batches under prefix {prefix!r}")
+        mine = all_keys[shard_index::num_shards]
+        if not mine:
+            raise ValueError(
+                f"shard {shard_index}/{num_shards} is empty "
+                f"({len(all_keys)} total keys)")
+        super().__init__(_StoreBatches(store, mine), depth=depth,
+                         device=device)
+
+    @property
+    def keys(self) -> List[str]:
+        return self.inner.keys
+
+    @property
+    def store(self) -> ArtifactStore:
+        return self.inner.store
+
+    def close(self) -> None:
+        """Stop the producer and drop queued batches (reset's drain)."""
+        self.reset()

@@ -31,7 +31,10 @@ def test_idx_roundtrip(tmp_path):
 
 
 def test_mnist_iterator_batching():
-    it = MnistDataSetIterator(batch=32, synthetic_n=100)
+    # num_examples caps the pass regardless of which idx tree (real
+    # archive / committed data-mnist fixture / synthetic surrogate)
+    # find_mnist_dir discovered — this test is about batching mechanics
+    it = MnistDataSetIterator(batch=32, num_examples=100, synthetic_n=100)
     batches = list(it)
     assert sum(b.num_examples() for b in batches) == 100
     assert batches[0].features.shape == (32, 784)

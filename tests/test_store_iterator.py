@@ -1,0 +1,169 @@
+"""StoreDataSetIterator: training data paged out of an ArtifactStore
+(BaseS3DataSetIterator.java:29 / BucketIterator role — VERDICT r4 #5).
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cloud.artifacts import LocalArtifactStore
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import IrisDataFetcher
+from deeplearning4j_tpu.datasets.store_iterator import (
+    StoreDataSetIterator, dataset_from_bytes, dataset_to_bytes,
+    write_batches_to_store,
+)
+
+
+def _iris():
+    f = IrisDataFetcher()
+    f.fetch(150)
+    return f.next().normalize_zero_mean_unit_variance().shuffle(0)
+
+
+def _mlp_conf():
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    return (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).momentum(0.5).use_adagrad(False)
+            .activation("tanh")
+            .list(2).hidden_layer_sizes(12)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+
+
+def test_dataset_bytes_roundtrip():
+    ds = DataSet(np.random.rand(8, 4).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 8)])
+    back = dataset_from_bytes(dataset_to_bytes(ds))
+    np.testing.assert_array_equal(np.asarray(back.features),
+                                  np.asarray(ds.features))
+    np.testing.assert_array_equal(np.asarray(back.labels),
+                                  np.asarray(ds.labels))
+
+
+def test_iterates_in_key_order_with_prefetch(tmp_path):
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    batches = _iris().batch_by(15)
+    keys = write_batches_to_store(store, "iris/train", batches)
+    assert len(keys) == 10 and keys == sorted(keys)
+    it = StoreDataSetIterator(store, "iris/train", depth=3)
+    seen = []
+    while it.has_next():
+        seen.append(np.asarray(it.next().features))
+    assert len(seen) == 10
+    for got, want in zip(seen, batches):
+        np.testing.assert_array_equal(got, np.asarray(want.features))
+    # reset restarts the stream identically (epoch 2)
+    it.reset()
+    again = [np.asarray(it.next().features) for _ in range(10)]
+    np.testing.assert_array_equal(again[0], seen[0])
+    it.close()
+
+
+def test_shards_are_disjoint_and_cover(tmp_path):
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    write_batches_to_store(store, "d", _iris().batch_by(10))
+    shards = [StoreDataSetIterator(store, "d", shard_index=i, num_shards=4)
+              for i in range(4)]
+    key_sets = [set(s.keys) for s in shards]
+    union = set().union(*key_sets)
+    assert len(union) == 15 == sum(len(k) for k in key_sets)
+    for s in shards:
+        s.close()
+    with pytest.raises(ValueError):
+        StoreDataSetIterator(store, "d", shard_index=2, num_shards=2,
+                             keys=["d/batch_00000.npz"])
+
+
+def test_ragged_last_batch_total_examples(tmp_path):
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    write_batches_to_store(store, "d", _iris().batch_by(40))  # 40/40/40/30
+    it = StoreDataSetIterator(store, "d")
+    assert it.total_examples() == 150
+    n = 0
+    while it.has_next():
+        n += it.next().num_examples()
+    assert n == 150
+    it.close()
+
+
+def test_fetch_failure_raises_and_ends_epoch(tmp_path):
+    """A mid-epoch store failure surfaces as RuntimeError and the epoch
+    ends — no silent truncation, and callers that keep polling don't
+    hang."""
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    keys = write_batches_to_store(store, "d", _iris().batch_by(30))
+    it = StoreDataSetIterator(store, "d", depth=1)
+    got = [it.next()]
+    store.delete(keys[3])            # vanish a batch mid-epoch
+    with pytest.raises((RuntimeError, StopIteration)):
+        for _ in range(10):
+            got.append(it.next())
+    assert not it.has_next()         # epoch over, no hang
+    it.reset()
+    it.close()
+
+
+def test_train_mln_straight_from_store(tmp_path):
+    """The reference's S3 training shape: MLN fit pulls every batch out
+    of the store through the prefetching iterator."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    data = _iris()
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    write_batches_to_store(store, "iris/train", data.batch_by(30))
+    it = StoreDataSetIterator(store, "iris/train", depth=2)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    before = net.score(data)
+    net.fit_iterator(it, num_epochs=80)
+    it.close()
+    assert net.score(data) < before
+    assert net.evaluate(data).accuracy() > 0.85
+
+
+def _worker_train(root: str, shard: int, n_shards: int, out_key: str):
+    """Subprocess body: pull MY shard from the shared store, train, and
+    write the trained params back into the store."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    store = LocalArtifactStore(root)
+    it = StoreDataSetIterator(store, "iris/train", shard_index=shard,
+                              num_shards=n_shards)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    net.fit_iterator(it, num_epochs=40)
+    it.close()
+    store.put(out_key, net.to_bytes())
+
+
+@pytest.mark.slow
+def test_multiprocess_workers_pull_their_splits(tmp_path):
+    """Two OS processes share one store; each trains on a disjoint shard
+    and publishes its model back (the S3-bucket multi-worker read the
+    reference runs via BucketIterator + provisioned workers)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    root = str(tmp_path / "bucket")
+    store = LocalArtifactStore(root)
+    data = _iris()
+    write_batches_to_store(store, "iris/train", data.batch_by(15))
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_worker_train,
+                         args=(root, i, 2, f"models/worker_{i}"))
+             for i in range(2)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=300)
+        assert p.exitcode == 0
+    # both models landed and are usable; averaged params still classify
+    nets = [MultiLayerNetwork.from_bytes(store.get(f"models/worker_{i}"))
+            for i in range(2)]
+    nets[0].merge([nets[1]])
+    assert nets[0].evaluate(data).accuracy() > 0.75

@@ -8,17 +8,23 @@ text8-style input: lowercase words, single spaces, vocabulary in the
 thousands with a natural Zipf head ("the", "of", "and", ...).
 """
 import io
-import pkgutil
 import pydoc
 import re
 import sys
+
+#: modules whose import has user-visible side effects (antigravity opens
+#: a browser, ``this`` prints) — never import these
+_SKIP = {"antigravity", "this", "idlelib", "turtledemo", "tkinter"}
 
 
 def harvest(limit_bytes: int = 2_000_000) -> str:
     out = io.StringIO()
     seen = set()
-    names = sorted(m.name for m in pkgutil.iter_modules()
-                   if m.name.isidentifier() and not m.name.startswith("_"))
+    # STDLIB ONLY, sorted: the same module list (and so the same corpus)
+    # on every host with this Python version — site-packages would make
+    # the output host-dependent and can be minutes-slow to import
+    names = sorted(n for n in sys.stdlib_module_names
+                   if not n.startswith("_") and n not in _SKIP)
     for name in names:
         if out.tell() >= limit_bytes:
             break
