@@ -646,6 +646,89 @@ def bench_scaling(ndp: int = 8, steps: int = 20, warmup: int = 3,
     return out
 
 
+def bench_w2v_dp(ndp: int = 8, n_sentences: int = 2000, sent_len: int = 30,
+                 vocab: int = 1000, epochs: int = 4):
+    """Distributed word2vec evidence (VERDICT r4 next #7): the 8-shard
+    device-mode dp fit's step-overlap shape, measured the same honest way
+    as the scaling row — the SAME sharded epoch program twice under
+    identical core contention, once with the per-epoch parameter-average
+    pmean (the reference's Spark each-iteration averaging,
+    models/embeddings/word2vec/Word2Vec.java:97 delta-collect role) and
+    once shard-local only.  value = t_local/t_avg: the fraction of dp
+    epoch time NOT spent on the collective.  Also reports end-to-end
+    dp words/sec (cold fit incl. stream build) as a secondary field."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.word2vec import (Word2Vec, Word2VecConfig,
+                                                 make_dp_stream_epoch,
+                                                 prepare_train_tables)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    platform, kind, n_dev = _platform_info()
+    ndp = min(ndp, n_dev)
+    rng = np.random.RandomState(0)
+    p = 1.0 / np.arange(1, vocab + 1) ** 1.05
+    p /= p.sum()
+    ids = rng.choice(vocab, p=p, size=(n_sentences, sent_len))
+    sents = [" ".join(f"w{i}" for i in row) for row in ids]
+    cfg = Word2VecConfig(vector_size=100, window=5, epochs=epochs,
+                         negative=5, use_hs=True, batch_size=4096,
+                         pair_mode="device", kernel="xla")
+    mesh = make_mesh(MeshSpec(data=ndp), devices=jax.devices()[:ndp])
+
+    w = Word2Vec(sents, cfg)
+    t0 = time.perf_counter()
+    w.fit(mesh=mesh)                     # cold: stream build + dp epochs
+    cold_s = time.perf_counter() - t0
+    total_words = n_sentences * sent_len * epochs
+    sc = w._stream_cache
+    NC, pos_chunk = sc["n_chunks"], sc["pos_chunk"]
+    per = NC // ndp
+
+    codes_t, points_t, mask_t, table = prepare_train_tables(
+        w.cache, cfg.table_size)
+    key = jax.random.key(cfg.seed + 1)   # run_stream_training's stream key
+    args_tail = (sc["tok"], jnp.int32(sc["n_stream"]), codes_t, points_t,
+                 mask_t, table, key, jnp.int32(0), jnp.float32(epochs),
+                 jnp.float32(cfg.alpha), jnp.float32(cfg.min_alpha))
+
+    def time_epochs(average: bool, reps: int = 3):
+        fn = make_dp_stream_epoch(
+            mesh, "data", ndp, per, use_hs=True, negative=cfg.negative,
+            window=cfg.window, pos_chunk=pos_chunk, pallas_block=0,
+            pallas_interpret=False, average=average)
+        # donated args: thread the returned tables through the loop
+        s0 = jnp.array(np.asarray(w.syn0))
+        s1 = jnp.array(np.asarray(w.syn1))
+        sn = jnp.array(np.asarray(w.syn1neg))
+        s0, s1, sn = fn(s0, s1, sn, *args_tail)          # compile+warm
+        float(s0[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s0, s1, sn = fn(s0, s1, sn, *args_tail)
+        float(s0[0, 0])
+        return (time.perf_counter() - t0) / reps
+
+    t_avg = time_epochs(True)
+    t_local = time_epochs(False)
+    frac = min(t_local / t_avg, 1.0)
+    return {
+        "metric": f"w2v_dp_epoch_compute_fraction_{ndp}shard",
+        "value": round(frac, 3),
+        "unit": "frac_of_epoch_not_collective",
+        "vs_baseline": round(frac, 3),   # target: near 1.0
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"dp{ndp}_n{n_sentences}x{sent_len}_v{vocab}",
+        "epoch_ms_averaging": round(t_avg * 1e3, 1),
+        "epoch_ms_local_only": round(t_local * 1e3, 1),
+        "dp_cold_fit_words_per_sec": round(total_words / cold_s, 1),
+        "note": "same 8-shard dp epoch +/- the per-epoch parameter "
+                "pmean under identical core contention",
+    }
+
+
 def bench_longctx(batch_size: int = 1, seq_len: int = 8192,
                   n_heads: int = 12, head_dim: int = 64,
                   steps: int = 10, warmup: int = 2):
@@ -861,7 +944,8 @@ def bench_longctx32k():
 
 INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
-         "scaling": bench_scaling, "longctx": bench_longctx,
+         "scaling": bench_scaling, "w2v_dp": bench_w2v_dp,
+         "longctx": bench_longctx,
          "longctx32k": bench_longctx32k, "glove": bench_glove,
          # BERT MFU sweep points (VERDICT r3 next #6): batch scaling at
          # T=128 and the flash-enabled T=512 point; the sweep banks each
@@ -878,7 +962,8 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             "lenet": (600, 420),
             # word2vec runs warm+cold for all THREE pair modes (6 fits)
             "word2vec": (1500, 900),
-            "scaling": (0, 600), "longctx": (720, 420),
+            "scaling": (0, 600), "w2v_dp": (0, 900),
+            "longctx": (720, 420),
             "longctx32k": (1200, 0), "glove": (600, 420),
             # BERT MFU sweep points: tpu-only, like longctx32k (a CPU
             # fallback would just repeat the tiny-model bert row)
@@ -1131,7 +1216,8 @@ def main() -> None:
     headline = run_config("bert", tpu_ok)
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
-    names = ["lenet", "resnet", "longctx", "word2vec", "glove", "scaling"]
+    names = ["lenet", "resnet", "longctx", "word2vec", "glove", "scaling",
+             "w2v_dp"]
     if tpu_ok:
         # tpu-only capability point LAST: if the suite budget runs out it
         # is the row sacrificed, never the production throughput metrics

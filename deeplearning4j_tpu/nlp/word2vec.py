@@ -404,14 +404,18 @@ def _scan_stream_epoch(syn0: Array, syn1: Array, syn1neg: Array,
 def make_dp_stream_epoch(mesh, axis: str, n_shards: int, per: int, *,
                          use_hs: bool, negative: int, window: int,
                          pos_chunk: int, pallas_block: int,
-                         pallas_interpret: bool):
+                         pallas_interpret: bool, average: bool = True):
     """Data-parallel device-mode epoch over a mesh ``axis``: each shard
     trains its contiguous stripe of ``per`` position chunks on its OWN
     table replica, then replicas are parameter-AVERAGED (pmean) — the
     reference's Spark each-iteration averaging mode
     (SparkDl4jMultiLayer fitDataSet / ParameterAveragingTrainer role),
     per EPOCH at chip scale.  Returns a jitted epoch function with the
-    _scan_stream_epoch signature."""
+    _scan_stream_epoch signature.
+
+    ``average=False`` skips the pmean (shard-local updates; replicas
+    DIVERGE) — only for measuring the collective's share of epoch time
+    (bench.py's w2v-dp row), never for training."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -427,6 +431,8 @@ def make_dp_stream_epoch(mesh, axis: str, n_shards: int, per: int, *,
             use_hs=use_hs, negative=negative, window=window,
             pos_chunk=pos_chunk, n_chunks=per,
             pallas_block=pallas_block, pallas_interpret=pallas_interpret)
+        if not average:
+            return syn0, syn1, syn1neg
         pm = lambda x: jax.lax.pmean(x, axis)
         return pm(syn0), pm(syn1), pm(syn1neg)
 
@@ -903,6 +909,19 @@ def run_pair_training(syn0, syn1, syn1neg,
             kernel_used)
 
 
+def prepare_train_tables(cache, table_size: int):
+    """Device-ready training tables from a built vocab: (codes_t,
+    points_t, mask_t, unigram table) — the Huffman hierarchical-softmax
+    encoding plus the negative-sampling distribution.  Shared by
+    ``Word2Vec.fit`` and bench.py's w2v-dp row so the bench times the
+    EXACT tables training uses (InMemoryLookupTable syn1/expTable/
+    negative-table construction role, InMemoryLookupTable.java:98-180)."""
+    codes_np, points_np, lengths_t = encode_hs_tables(cache)
+    mask_t = hs_mask_table(codes_np, lengths_t)
+    return (jnp.asarray(codes_np), jnp.asarray(points_np), mask_t,
+            jnp.asarray(unigram_table(cache, table_size)))
+
+
 def hs_mask_table(codes_t: np.ndarray, lengths_t: np.ndarray) -> Array:
     """[V, L] float mask from per-word Huffman path lengths."""
     return jnp.asarray(
@@ -1013,11 +1032,8 @@ class Word2Vec:
                 else jnp.array(initial_weights[2]))
         else:
             self._reset_weights()
-        codes_np, points_np, lengths_t = encode_hs_tables(self.cache)
-        mask_t = hs_mask_table(codes_np, lengths_t)
-        codes_t = jnp.asarray(codes_np)
-        points_t = jnp.asarray(points_np)
-        table = jnp.asarray(unigram_table(self.cache, cfg.table_size))
+        codes_t, points_t, mask_t, table = prepare_train_tables(
+            self.cache, cfg.table_size)
         counts = np.asarray([self.cache.vocab[w].count
                              for w in self.cache.index], np.float64)
 
