@@ -686,7 +686,7 @@ def bench_w2v_dp(ndp: int = 8, n_sentences: int = 2000, sent_len: int = 30,
     NC, pos_chunk = sc["n_chunks"], sc["pos_chunk"]
     per = NC // ndp
 
-    codes_t, points_t, mask_t, table = prepare_train_tables(
+    codes_t, points_t, mask_t, table, _ = prepare_train_tables(
         w.cache, cfg.table_size)
     key = jax.random.key(cfg.seed + 1)   # run_stream_training's stream key
     args_tail = (sc["tok"], jnp.int32(sc["n_stream"]), codes_t, points_t,
@@ -695,8 +695,9 @@ def bench_w2v_dp(ndp: int = 8, n_sentences: int = 2000, sent_len: int = 30,
 
     def time_epochs(average: bool, reps: int = 3):
         fn = make_dp_stream_epoch(
-            mesh, "data", ndp, per, use_hs=True, negative=cfg.negative,
-            window=cfg.window, pos_chunk=pos_chunk, pallas_block=0,
+            mesh, "data", ndp, per, use_hs=cfg.use_hs,
+            negative=cfg.negative, window=cfg.window,
+            pos_chunk=pos_chunk, pallas_block=0,
             pallas_interpret=False, average=average)
         # donated args: thread the returned tables through the loop
         s0 = jnp.array(np.asarray(w.syn0))

@@ -4,10 +4,12 @@ Reference parity: ``text/corpora/treeparser/TreeParser.java`` (+ its
 ``transformer/{BinarizeTreeTransformer,CollapseUnaries}.java``) — the
 reference turns plain sentences into binarized constituency trees the
 RNTN can train on, via a CoreNLP/UIMA parser.  Zero-egress equivalent:
-a PoS-driven shallow chunker (NP/VP grouping over the bundled perceptron
-tagger, nlp/pos.py) followed by deterministic binarization, producing
-:class:`deeplearning4j_tpu.nlp.rntn.Tree` nodes directly — already
-binary, so no separate binarize/collapse-unaries passes are needed.
+a TRAINED transition chunker (nlp/chunker.py, averaged perceptron over
+B/I/O chunk actions — the trained-parse-model role) over the bundled
+perceptron tagger (nlp/pos.py), followed by deterministic binarization,
+producing :class:`deeplearning4j_tpu.nlp.rntn.Tree` nodes directly —
+already binary, so no separate binarize/collapse-unaries passes are
+needed.  The round-4 tag-rule chunker remains as ``mode="rules"``.
 
 Labels: constituency parsing gives structure, not sentiment; interior
 nodes get ``neutral_label`` and the root gets the caller's sentence
@@ -91,24 +93,46 @@ def _binarize_right(nodes: List[Tree], label: int) -> Tree:
 class TreeParser:
     """``parse(sentence, label)`` → binary :class:`rntn.Tree`.
 
+    ``mode="model"`` (default) chunks with the TRAINED transition
+    chunker (nlp/chunker.py — the reference's trained-parse-model role,
+    TreeParser.java:57); ``mode="rules"`` keeps the round-4 tag-rule
+    heuristic as the zero-cost fallback.
+
     ``neutral_label`` fills interior/leaf nodes (class 2 of the 5-class
     sentiment scheme); the sentence-level ``label`` lands on the root.
     """
 
     def __init__(self, tagger: Optional[AveragedPerceptronTagger] = None,
-                 neutral_label: int = 2, propagate_label: bool = True):
+                 neutral_label: int = 2, propagate_label: bool = True,
+                 mode: str = "model", chunker=None):
+        if mode not in ("model", "rules"):
+            raise ValueError(f"mode must be 'model' or 'rules': {mode!r}")
         self._tagger = tagger
         self.neutral_label = neutral_label
         #: with only a sentence-level label available, propagate it to
         #: interior phrase nodes (leaves stay neutral) — the RNTN loss is
         #: per-node, so root-only labeling would drown in neutral targets
         self.propagate_label = propagate_label
+        self.mode = mode
+        self._chunker = chunker
 
     @property
     def tagger(self) -> AveragedPerceptronTagger:
         if self._tagger is None:
             self._tagger = default_tagger()
         return self._tagger
+
+    @property
+    def chunker(self):
+        if self._chunker is None:
+            from deeplearning4j_tpu.nlp.chunker import default_chunker
+            self._chunker = default_chunker()
+        return self._chunker
+
+    def _chunks(self, tagged) -> List[List[str]]:
+        if self.mode == "model":
+            return self.chunker.chunk(tagged)
+        return _chunk(tagged)
 
     def parse(self, sentence: str, label: Optional[int] = None) -> Tree:
         tokens = tokenize(sentence)
@@ -119,7 +143,7 @@ class TreeParser:
                     else neutral)
         tagged = self.tagger.tag(tokens)
         phrase_trees: List[Tree] = []
-        for chunk in _chunk(tagged):
+        for chunk in self._chunks(tagged):
             leaves = [Tree(label=neutral, word=w) for w in chunk]
             phrase_trees.append(_binarize_right(leaves, interior))
         root = _binarize_right(phrase_trees, interior)
@@ -133,7 +157,8 @@ class TreeParser:
 
 
 def trees_from_raw(labeled: Sequence[Tuple[str, int]],
-                   tagger: Optional[AveragedPerceptronTagger] = None
-                   ) -> List[Tree]:
-    """Module-level convenience: raw labeled sentences → RNTN trees."""
-    return TreeParser(tagger).parse_labeled(labeled)
+                   tagger: Optional[AveragedPerceptronTagger] = None,
+                   mode: str = "model") -> List[Tree]:
+    """Module-level convenience: raw labeled sentences → RNTN trees
+    (model-chunked by default; ``mode="rules"`` for the heuristic)."""
+    return TreeParser(tagger, mode=mode).parse_labeled(labeled)

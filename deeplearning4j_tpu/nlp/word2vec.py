@@ -911,15 +911,16 @@ def run_pair_training(syn0, syn1, syn1neg,
 
 def prepare_train_tables(cache, table_size: int):
     """Device-ready training tables from a built vocab: (codes_t,
-    points_t, mask_t, unigram table) — the Huffman hierarchical-softmax
-    encoding plus the negative-sampling distribution.  Shared by
-    ``Word2Vec.fit`` and bench.py's w2v-dp row so the bench times the
-    EXACT tables training uses (InMemoryLookupTable syn1/expTable/
-    negative-table construction role, InMemoryLookupTable.java:98-180)."""
+    points_t, mask_t, unigram table, hs code lengths) — the Huffman
+    hierarchical-softmax encoding plus the negative-sampling
+    distribution.  Shared by ``Word2Vec.fit`` and bench.py's w2v-dp row
+    so the bench times the EXACT tables training uses
+    (InMemoryLookupTable syn1/expTable/negative-table construction role,
+    InMemoryLookupTable.java:98-180)."""
     codes_np, points_np, lengths_t = encode_hs_tables(cache)
     mask_t = hs_mask_table(codes_np, lengths_t)
     return (jnp.asarray(codes_np), jnp.asarray(points_np), mask_t,
-            jnp.asarray(unigram_table(cache, table_size)))
+            jnp.asarray(unigram_table(cache, table_size)), lengths_t)
 
 
 def hs_mask_table(codes_t: np.ndarray, lengths_t: np.ndarray) -> Array:
@@ -1032,7 +1033,7 @@ class Word2Vec:
                 else jnp.array(initial_weights[2]))
         else:
             self._reset_weights()
-        codes_t, points_t, mask_t, table = prepare_train_tables(
+        codes_t, points_t, mask_t, table, lengths_t = prepare_train_tables(
             self.cache, cfg.table_size)
         counts = np.asarray([self.cache.vocab[w].count
                              for w in self.cache.index], np.float64)
