@@ -3,10 +3,10 @@
 # (tools/measure_tpu.py — skips configs already captured, exits 1 on a
 # mid-sweep tunnel drop).  Loops until every config is captured on TPU.
 # Status lines -> tools/tpu_watch.status ; sweep output appends to
-# TPU_SWEEP_r04.log ; per-config results -> TPU_SWEEP_STATE.json
+# TPU_SWEEP_r05.log ; per-config results -> TPU_SWEEP_STATE.json
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 STATUS="$REPO/tools/tpu_watch.status"
-SWEEP="$REPO/TPU_SWEEP_r04.log"
+SWEEP="$REPO/TPU_SWEEP_r05.log"
 LOCK="$REPO/tools/tpu_watch.lock"
 
 exec 9>"$LOCK"
