@@ -26,16 +26,22 @@ def main() -> None:
     ids = np.asarray([stoi[c] for c in TEXT], np.int32)
 
     cfg = gpt.gpt_tiny(vocab_size=len(chars), max_len=64)
-    mesh = make_mesh(MeshSpec(data=1))
+    mesh = make_mesh(MeshSpec())       # data=-1: dp absorbs all devices
     init_fn, step_fn = gpt.make_train_step(cfg, mesh)
     state = init_fn(jax.random.key(0))
 
     T = 32
-    n = (ids.size - 1) // T
+    ndev = len(jax.devices())
+    # dp-divisible batch; tile the tiny corpus when a large mesh needs
+    # more rows than the text has
+    reps = -(-(T * ndev + 1) // ids.size)
+    if reps > 1:
+        ids = np.tile(ids, reps)
+    n = max((ids.size - 1) // T // ndev, 1) * ndev
     x = jnp.asarray(ids[:n * T].reshape(n, T))
-    y = jnp.asarray(ids[1:n * T + 1].reshape(n, T))
+    key = jax.random.key(1)
     for epoch in range(300):
-        state, loss = step_fn(state, x, y)
+        state, loss = step_fn(state, x, key)
     print(f"final LM loss: {float(loss):.3f}")
 
     prompt = "the quick "
