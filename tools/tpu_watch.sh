@@ -33,5 +33,8 @@ while true; do
   else
     echo "$ts tunnel down" >> "$STATUS"
   fi
-  sleep 420
+  # a down-probe already burns its 150 s timeout, so the short sleep
+  # gives a ~3.5 min cycle — tunnel windows shorter than the old ~10 min
+  # cycle were being missed entirely
+  sleep 60
 done
