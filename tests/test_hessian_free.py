@@ -103,3 +103,29 @@ def test_multilayer_hessian_free_on_iris():
     after = net.score(data)
     assert after < before * 0.6, (before, after)
     assert net.evaluate(data).accuracy() > 0.85
+
+
+def test_hessian_free_curves_autoencoder():
+    """The reference's own HF proving ground: a curves-dataset
+    autoencoder finetuned with StochasticHessianFree
+    (optimize/solvers/StochasticHessianFree.java tested on curves —
+    SURVEY.md §7 hard parts)."""
+    from deeplearning4j_tpu.datasets.fetchers import CurvesDataFetcher
+
+    f = CurvesDataFetcher(n=128, dim=64)
+    f.fetch(128)
+    data = f.next()
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(64).lr(0.05).use_adagrad(False)
+            .num_iterations(12).activation("sigmoid")
+            .optimization_algo(OptimizationAlgorithm.HESSIAN_FREE)
+            .list(2).hidden_layer_sizes(24)
+            .override(1, kind=LayerKind.OUTPUT, n_out=64,
+                      activation="sigmoid", loss_function="mse")
+            .pretrain(False).backward(False).build())
+    net = MultiLayerNetwork(conf).init()
+    before = net.score(data)
+    net.finetune(data)                    # routes to fit_hessian_free
+    after = net.score(data)
+    assert np.isfinite(after)
+    assert after < before * 0.9, (before, after)
