@@ -241,28 +241,45 @@ class PrefetchIterator(DataSetIterator):
         self.device = device
         self._queue: Optional["queue.Queue"] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
         self._peeked: Optional[DataSet] = None
         self._done = False
 
-    def _producer(self, q) -> None:
+    def _producer(self, q, stop) -> None:
+        import queue as _queue
         try:
-            while self.inner.has_next():
+            while self.inner.has_next() and not stop.is_set():
                 ds = self.inner.next()
                 if self.device is not None:
                     ds = DataSet(jax.device_put(ds.features, self.device),
                                  jax.device_put(ds.labels, self.device))
-                q.put(ds)
+                while not stop.is_set():
+                    try:
+                        q.put(ds, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
         except Exception as e:      # surfaced by next(); a swallowed
-            q.put(e)                # error would read as a clean (short)
-        finally:                    # end of epoch
-            q.put(self._STOP)
+            try:                    # error would read as a clean (short)
+                q.put(e, timeout=1.0)   # end of epoch
+            except _queue.Full:
+                pass
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(self._STOP, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
 
     def _ensure_started(self) -> None:
         if self._thread is None:
             import queue as _queue
             self._queue = _queue.Queue(maxsize=self.depth)
+            self._stop = threading.Event()
             self._thread = threading.Thread(
-                target=self._producer, args=(self._queue,), daemon=True)
+                target=self._producer, args=(self._queue, self._stop),
+                daemon=True)
             self._thread.start()
 
     def has_next(self) -> bool:
@@ -290,12 +307,21 @@ class PrefetchIterator(DataSetIterator):
 
     def reset(self) -> None:
         if self._thread is not None:
-            # drain so the producer can exit, then drop it
-            while not self._done and self._queue.get() is not self._STOP:
-                pass
+            # signal the producer to stop FETCHING (a naive drain would
+            # make it read + deserialize every remaining inner batch just
+            # to throw it away), then discard what is already queued
+            self._stop.set()
+            import queue as _queue
+            while self._thread.is_alive() or not self._queue.empty():
+                try:
+                    self._queue.get(timeout=0.1)
+                except _queue.Empty:
+                    if not self._thread.is_alive():
+                        break
             self._thread.join(timeout=5)
         self._thread = None
         self._queue = None
+        self._stop = None
         self._peeked = None
         self._done = False
         self.inner.reset()

@@ -66,23 +66,35 @@ _CANDIDATE_NAMES = {
 
 
 def find_mnist_dir() -> Optional[str]:
-    """Look for idx files in $MNIST_DIR, ./data/mnist, the repo's own
-    data/mnist (committed fixture tier — found regardless of cwd), and
-    ~/.dl4j-tpu/mnist."""
+    """Look for idx files in $MNIST_DIR (absolute priority), then the
+    LARGEST archive among ./data/mnist, the repo's committed data/mnist
+    fixture tier, and ~/.dl4j-tpu/mnist — so a user's real 60k archive
+    always beats the 2048-sample fixture regardless of which documented
+    location holds it."""
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    candidates = [os.environ.get("MNIST_DIR"),
-                  os.path.join(os.getcwd(), "data", "mnist"),
-                  os.path.join(repo_root, "data", "mnist"),
-                  os.path.expanduser("~/.dl4j-tpu/mnist")]
-    for d in candidates:
-        if not d or not os.path.isdir(d):
-            continue
+
+    def train_images_path(d):
         for name in _CANDIDATE_NAMES["train_images"]:
-            if os.path.exists(os.path.join(d, name)) or \
-               os.path.exists(os.path.join(d, name + ".gz")):
-                return d
-    return None
+            for suffix in ("", ".gz"):
+                p = os.path.join(d, name + suffix)
+                if os.path.exists(p):
+                    return p
+        return None
+
+    env = os.environ.get("MNIST_DIR")
+    if env and os.path.isdir(env) and train_images_path(env):
+        return env
+    best, best_size = None, -1
+    for d in [os.path.join(os.getcwd(), "data", "mnist"),
+              os.path.join(repo_root, "data", "mnist"),
+              os.path.expanduser("~/.dl4j-tpu/mnist")]:
+        if not os.path.isdir(d):
+            continue
+        p = train_images_path(d)
+        if p is not None and os.path.getsize(p) > best_size:
+            best, best_size = d, os.path.getsize(p)
+    return best
 
 
 def load_mnist(data_dir: str, train: bool = True

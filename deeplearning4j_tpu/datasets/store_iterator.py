@@ -118,7 +118,10 @@ class StoreDataSetIterator(PrefetchIterator):
         if not 0 <= shard_index < num_shards:
             raise ValueError(
                 f"shard_index {shard_index} not in [0, {num_shards})")
-        all_keys = sorted(keys) if keys is not None else store.list(prefix)
+        # '/'-terminated listing: a raw startswith would leak sibling
+        # prefixes ('iris/train_aug' under 'iris/train') into the stream
+        all_keys = sorted(keys) if keys is not None else \
+            store.list(prefix.rstrip("/") + "/")
         if not all_keys:
             raise ValueError(f"no batches under prefix {prefix!r}")
         mine = all_keys[shard_index::num_shards]

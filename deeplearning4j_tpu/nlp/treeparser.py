@@ -125,8 +125,18 @@ class TreeParser:
     @property
     def chunker(self):
         if self._chunker is None:
-            from deeplearning4j_tpu.nlp.chunker import default_chunker
-            self._chunker = default_chunker()
+            if self._tagger is None:
+                from deeplearning4j_tpu.nlp.chunker import default_chunker
+                self._chunker = default_chunker()
+            else:
+                # a custom tagger's tag distribution differs from the
+                # bundled one the default chunker was trained on — train
+                # a chunker on THIS tagger's output so the 't:'/'t2:'
+                # features match what parse() will feed it
+                from deeplearning4j_tpu.nlp.chunker import (
+                    ChunkPerceptron, annotated_corpus)
+                self._chunker = ChunkPerceptron().train(
+                    annotated_corpus(self._tagger))
         return self._chunker
 
     def _chunks(self, tagged) -> List[List[str]]:

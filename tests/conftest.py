@@ -67,16 +67,16 @@ def pytest_configure(config):
 
 def pytest_collection_modifyitems(config, items):
     fast = config.getoption("--fast")
-    # node ids named explicitly on the command line always run — a
-    # developer iterating on one slow test shouldn't need to drop --fast
-    explicit = {a.split("::")[0] for a in config.args if "::" in a}
+    # files/node-ids named explicitly on the command line always run — a
+    # developer iterating on one slow test (or file) shouldn't need to
+    # drop --fast; a bare path is as explicit as a ::node id
+    explicit = {os.path.abspath(a.split("::")[0]) for a in config.args}
     skip = pytest.mark.skip(reason="slow tier: skipped under --fast")
     for item in items:
         if item.fspath.basename in _SLOW_FILES:
             item.add_marker(pytest.mark.slow)
         if ("slow" in item.keywords and fast
-                and str(item.fspath) not in {os.path.abspath(e)
-                                             for e in explicit}):
+                and str(item.fspath) not in explicit):
             item.add_marker(skip)
 
 

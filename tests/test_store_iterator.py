@@ -79,6 +79,40 @@ def test_shards_are_disjoint_and_cover(tmp_path):
                              keys=["d/batch_00000.npz"])
 
 
+def test_sibling_prefix_does_not_leak(tmp_path):
+    """'iris/train' must not pick up 'iris/train_aug' keys (raw
+    startswith would interleave the two datasets)."""
+    store = LocalArtifactStore(str(tmp_path / "bucket"))
+    write_batches_to_store(store, "iris/train", _iris().batch_by(15))
+    write_batches_to_store(store, "iris/train_aug", _iris().batch_by(10))
+    it = StoreDataSetIterator(store, "iris/train")
+    assert len(it.keys) == 10
+    assert all(k.startswith("iris/train/") for k in it.keys)
+    it.close()
+
+
+class _CountingStore(LocalArtifactStore):
+    def __init__(self, root):
+        super().__init__(root)
+        self.gets = 0
+
+    def get(self, key):
+        self.gets += 1
+        return super().get(key)
+
+
+def test_close_does_not_fetch_remaining_shard(tmp_path):
+    """close() after a few batches must STOP the producer, not let it
+    page the whole remaining shard out of the store just to discard it."""
+    store = _CountingStore(str(tmp_path / "bucket"))
+    write_batches_to_store(store, "d", _iris().batch_by(5))   # 30 keys
+    it = StoreDataSetIterator(store, "d", depth=2)
+    it.next()
+    it.close()
+    # init fetch + 1 consumed + up to depth+2 in flight — nowhere near 30
+    assert store.gets <= 8, store.gets
+
+
 def test_ragged_last_batch_total_examples(tmp_path):
     store = LocalArtifactStore(str(tmp_path / "bucket"))
     write_batches_to_store(store, "d", _iris().batch_by(40))  # 40/40/40/30
@@ -100,9 +134,13 @@ def test_fetch_failure_raises_and_ends_epoch(tmp_path):
     it = StoreDataSetIterator(store, "d", depth=1)
     got = [it.next()]
     store.delete(keys[3])            # vanish a batch mid-epoch
-    with pytest.raises((RuntimeError, StopIteration)):
+    # MUST surface as RuntimeError: a StopIteration here would be the
+    # silent-truncation regression this test exists to catch (producer
+    # swallowing the error and ending the epoch short)
+    with pytest.raises(RuntimeError):
         for _ in range(10):
             got.append(it.next())
+    assert len(got) < 5              # the failure stopped the stream
     assert not it.has_next()         # epoch over, no hang
     it.reset()
     it.close()
