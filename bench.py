@@ -347,7 +347,11 @@ def bench_lenet(batch_size: int = 128, steps: int = 64, epochs: int = 64,
     hx = rng.rand(n_host, 784).astype(np.float32)
     hy = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n_host)]
     bpe = max(n_host // batch_size, 1)
-    ing_epochs = min(max(1, (steps * epochs) // bpe), 64)
+    # cap the ingest window: each batch is ~400 KB of fp32 riding the
+    # tunnel, so 8 epochs x 128 batches ~= 400 MB — enough steps (1024)
+    # to drown the two sync round-trips, small enough to fit the 600 s
+    # row timeout on a slow link
+    ing_epochs = min(max(1, (steps * epochs) // bpe), 8)
     inner = NativeBatchIterator(hx, hy, batch_size)
     inner.set_pre_processor(lambda ds: DataSet(
         ds.features.reshape(-1, 28, 28, 1), ds.labels))
