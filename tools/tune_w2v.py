@@ -1,0 +1,75 @@
+"""Word2Vec device-engine tuning sweep — run in a healthy TPU window.
+
+Sweeps the two free knobs of the ``pair_mode="device"`` engine (chunk
+batch size and kernel selection) on the bench corpus shape and prints
+one JSON line per point (cold-fit words/sec, kernel actually used).
+If a point clearly beats bench.py's defaults (batch_size=16384,
+kernel=auto), set those defaults and re-run
+``python tools/measure_tpu.py word2vec_device`` to re-bank.
+
+Usage:  python tools/tune_w2v.py [--quick]
+Exit 1 if the backend is not a TPU (the numbers would be meaningless).
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, ".jax_cache"))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, Word2VecConfig  # noqa
+
+
+def corpus(n_sentences: int, sent_len: int = 30, vocab: int = 2000):
+    rng = np.random.RandomState(0)
+    p = 1.0 / np.arange(1, vocab + 1) ** 1.05
+    p /= p.sum()
+    ids = rng.choice(vocab, p=p, size=(n_sentences, sent_len))
+    return [" ".join(f"w{i}" for i in row) for row in ids]
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        print(json.dumps({"abort": "cpu backend — tuning needs the TPU"}))
+        sys.exit(1)
+    quick = "--quick" in sys.argv
+    n_sent, epochs = (4000, 1) if quick else (16000, 2)
+    sents = corpus(n_sent)
+    total = n_sent * 30 * epochs
+    cache = None
+    best = None
+    for batch_size in (8192, 16384, 32768, 65536):
+        for kernel in ("auto", "xla"):
+            cfg = Word2VecConfig(vector_size=100, window=5, epochs=epochs,
+                                 negative=5, use_hs=True,
+                                 batch_size=batch_size,
+                                 pair_mode="device", kernel=kernel)
+            warm = Word2Vec(sents, cfg, cache=cache)
+            warm.fit()                       # compile + vocab
+            float(np.asarray(warm.syn0).ravel()[0])
+            cache = warm.cache
+            cold = Word2Vec(sents, cfg, cache=cache)
+            t0 = time.perf_counter()
+            cold.fit()
+            float(np.asarray(cold.syn0).ravel()[0])
+            wps = total / (time.perf_counter() - t0)
+            row = {"batch_size": batch_size, "kernel": kernel,
+                   "kernel_used": cold.kernel_used,
+                   "words_per_sec": round(wps, 1)}
+            print(json.dumps(row), flush=True)
+            if best is None or wps > best["words_per_sec"]:
+                best = row
+    print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
