@@ -83,6 +83,33 @@ def test_reconstruction_and_prefetch():
     assert sum(b.num_examples() for b in pf) == 8
 
 
+def test_device_staged_prefetch_over_native_batcher():
+    """The lenet bench's ingest composition: NativeBatchIterator ->
+    PrefetchIterator(device=...) stages batches onto the device from
+    the producer thread; epochs reset cleanly and mid-epoch reset does
+    NOT page the remaining stream (the producer stops promptly)."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.iterator import NativeBatchIterator
+
+    x = np.random.RandomState(0).rand(64, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 64)]
+    inner = NativeBatchIterator(x, y, batch_size=8)
+    it = PrefetchIterator(inner, depth=2, device=jax.devices()[0])
+    for _ in range(2):                       # two epochs through reset()
+        it.reset()
+        n = 0
+        while it.has_next():
+            b = it.next()
+            assert b.features.shape == (8, 6)
+            n += 8
+        assert n == 64
+    it.reset()                               # mid-stream reset: no hang
+    assert it.next().features.shape == (8, 6)
+    it.reset()
+    inner.close()
+
+
 def test_curves_fetcher():
     f = CurvesDataFetcher(n=16, dim=32)
     f.fetch(16)
