@@ -144,19 +144,18 @@ def _glove_update(state, rows: Array, cols: Array, x: Array, mask: Array,
     return (w, wt, b, bt, gw, gwt, gb, gbt), loss
 
 
-@partial(jax.jit, donate_argnums=(0,),
-         static_argnames=("x_max", "power", "n_chunks", "batch",
-                          "pallas_block", "pallas_interpret"))
-def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
+def _glove_epoch_body(state, rows: Array, cols: Array, x: Array,
                       mask: Array, key: Array, epoch: Array, alpha: Array,
-                      *, x_max: float, power: float, n_chunks: int,
-                      batch: int, pallas_block: int = 0,
+                      chunk0, *, x_max: float, power: float,
+                      n_chunks: int, batch: int, pallas_block: int = 0,
                       pallas_interpret: bool = False):
-    """One dispatch per EPOCH: on-device shuffle of the COO triples
-    (Glove.java's per-epoch example shuffle) + ``lax.scan`` over fixed
-    [batch] chunks.  The eager per-chunk loop paid one 15-20 ms tunnel
-    dispatch per 4k triples; the scan removes that entirely (same
-    restructure as word2vec's _scan_slab).  Returns (state, mean loss)."""
+    """Epoch core shared by the single-device jit and the dp shard_map:
+    on-device shuffle of the COO triples (Glove.java's per-epoch example
+    shuffle) + ``lax.scan`` over ``n_chunks`` fixed [batch] chunks
+    STARTING at chunk ``chunk0`` of the permuted order (a dp shard
+    passes its stripe offset; single-device passes 0).  Returns
+    (state, (weighted loss sum, count sum)) so callers — or a psum
+    across shards — can form the global mean."""
     perm = jax.random.permutation(jax.random.fold_in(key, epoch),
                                   rows.shape[0])
 
@@ -176,7 +175,8 @@ def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
 
         def body(st, i):
             wext, wtext, gext, gtext = st
-            idx = jax.lax.dynamic_slice(perm, (i * batch,), (batch,))
+            idx = jax.lax.dynamic_slice(perm, ((chunk0 + i) * batch,),
+                                        (batch,))
             m = mask[idx]
             accw, accwt, ls = fused_glove_chunk(
                 wext, wtext, rows[idx], cols[idx], x[idx], m,
@@ -199,7 +199,8 @@ def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
                  gext[:, :D], gtext[:, :D], gext[:, D], gtext[:, D])
     else:
         def body(st, i):
-            idx = jax.lax.dynamic_slice(perm, (i * batch,), (batch,))
+            idx = jax.lax.dynamic_slice(perm, ((chunk0 + i) * batch,),
+                                        (batch,))
             m = mask[idx]
             st, loss = _glove_update(st, rows[idx], cols[idx], x[idx],
                                      m, alpha, x_max, power)
@@ -207,10 +208,66 @@ def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
 
         state, (losses, cnts) = jax.lax.scan(body, state,
                                              jnp.arange(n_chunks))
-    # count-weighted mean: chunk counts vary under the shuffle (and
-    # whole chunks can be padding when n_chunks is bucketed up)
-    mean = jnp.sum(losses * cnts) / jnp.maximum(jnp.sum(cnts), 1.0)
-    return state, mean
+    # weighted sums: chunk counts vary under the shuffle (and whole
+    # chunks can be padding when n_chunks is bucketed up)
+    return state, (jnp.sum(losses * cnts), jnp.sum(cnts))
+
+
+@partial(jax.jit, donate_argnums=(0,),
+         static_argnames=("x_max", "power", "n_chunks", "batch",
+                          "pallas_block", "pallas_interpret"))
+def _glove_scan_epoch(state, rows: Array, cols: Array, x: Array,
+                      mask: Array, key: Array, epoch: Array, alpha: Array,
+                      *, x_max: float, power: float, n_chunks: int,
+                      batch: int, pallas_block: int = 0,
+                      pallas_interpret: bool = False):
+    """One dispatch per EPOCH (single-device path).  The eager per-chunk
+    loop paid one 15-20 ms tunnel dispatch per 4k triples; the scan
+    removes that entirely (same restructure as word2vec's _scan_slab).
+    Returns (state, mean loss)."""
+    state, (ls, cs) = _glove_epoch_body(
+        state, rows, cols, x, mask, key, epoch, alpha, jnp.int32(0),
+        x_max=x_max, power=power, n_chunks=n_chunks, batch=batch,
+        pallas_block=pallas_block, pallas_interpret=pallas_interpret)
+    return state, ls / jnp.maximum(cs, 1.0)
+
+
+def make_dp_glove_epoch(mesh, axis: str, n_shards: int, per: int, *,
+                        x_max: float, power: float, batch: int,
+                        pallas_block: int = 0,
+                        pallas_interpret: bool = False,
+                        average: bool = True):
+    """Data-parallel GloVe epoch over a mesh ``axis``: every shard
+    shuffles the SAME replicated COO triples (identical key -> identical
+    permutation), trains its contiguous stripe of ``per`` chunks on its
+    own table replica, and replicas are parameter-AVERAGED per epoch —
+    the same Spark each-iteration-averaging semantics as word2vec's
+    ``make_dp_stream_epoch`` (reference role: the spark glove job,
+    models/embeddings/glove/Glove.java distributed fit).  AdaGrad
+    accumulators average too (they are part of the replicated state).
+    Loss is the count-weighted GLOBAL mean via psum.
+
+    ``average=False`` skips the pmean — timing diagnostics only."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    rep = P()
+
+    def shard_fn(state, rows, cols, x, mask, key, epoch, alpha):
+        c0 = jax.lax.axis_index(axis) * per
+        state, (ls, cs) = _glove_epoch_body(
+            state, rows, cols, x, mask, key, epoch, alpha, c0,
+            x_max=x_max, power=power, n_chunks=per, batch=batch,
+            pallas_block=pallas_block, pallas_interpret=pallas_interpret)
+        ls = jax.lax.psum(ls, axis)
+        cs = jax.lax.psum(cs, axis)
+        if average:
+            state = tuple(jax.lax.pmean(t, axis) for t in state)
+        return state, ls / jnp.maximum(cs, 1.0)
+
+    f = shard_map(shard_fn, mesh=mesh, in_specs=(rep,) * 8,
+                  out_specs=(rep, rep), check_vma=False)
+    return jax.jit(f, donate_argnums=(0,))
 
 
 class Glove:
@@ -227,14 +284,19 @@ class Glove:
 
     def fit(self, initial_weights: Optional[Tuple] = None,
             cooccurrences: Optional[Tuple[np.ndarray, np.ndarray,
-                                          np.ndarray]] = None
-            ) -> WordVectors:
+                                          np.ndarray]] = None,
+            mesh=None, data_axis: str = "data") -> WordVectors:
         """Train; ``initial_weights`` (an 8-tuple of w/w~/b/b~ tables plus
         their AdaGrad accumulators, as produced in ``self.state``) warm-
         starts from a previous or globally-averaged state — the hook the
         distributed GloVe performer uses (GlovePerformer.java parity).
         ``cooccurrences`` = precomputed (rows, cols, counts) COO triples;
-        when given, the counting pass is skipped."""
+        when given, the counting pass is skipped.
+
+        With ``mesh`` (and >1 devices on ``data_axis``), each device
+        trains a stripe of the shuffled triples on its own table replica
+        and replicas are parameter-averaged per epoch
+        (``make_dp_glove_epoch`` — the spark glove job's role)."""
         cfg = self.config
         if self.cache is None:
             self.cache = build_vocab(self.sentences, self.tokenizer,
@@ -275,7 +337,12 @@ class Glove:
         # shard size.
         B = cfg.batch_size
         P = rows.size
+        n_shards = int(mesh.shape[data_axis]) if mesh is not None else 1
         NC = max(1, 1 << (-(-P // B) - 1).bit_length())
+        # a dp mesh needs a chunk count divisible by the shard count
+        # (word2vec.py's run_stream_training does the same): extra
+        # chunks are fully-masked padding the weighted loss ignores
+        NC = max(n_shards, -(-NC // n_shards) * n_shards)
         pad = NC * B - P
         if pad:
             rows = np.concatenate([rows, np.zeros(pad, np.int32)])
@@ -305,14 +372,32 @@ class Glove:
         self.kernel_used = kernel_name(pallas_block, pallas_interpret)
         key = jax.random.key(cfg.seed)
         alpha = jnp.float32(cfg.alpha)
-        for epoch in range(cfg.epochs):
-            state, loss = _glove_scan_epoch(
-                state, rows_d, cols_d, x_d, mask_d, key,
-                jnp.int32(epoch), alpha, x_max=cfg.x_max,
-                power=cfg.weight_power, n_chunks=NC, batch=B,
-                pallas_block=pallas_block,
-                pallas_interpret=pallas_interpret)
-            self.losses.append(float(loss))
+        if n_shards > 1:
+            mesh_key = (tuple(d.id for d in mesh.devices.flat),
+                        data_axis, n_shards, NC // n_shards, B)
+            self._dp_fns = getattr(self, "_dp_fns", {})
+            epoch_fn = self._dp_fns.get(mesh_key)
+            if epoch_fn is None:
+                epoch_fn = make_dp_glove_epoch(
+                    mesh, data_axis, n_shards, NC // n_shards,
+                    x_max=cfg.x_max, power=cfg.weight_power, batch=B,
+                    pallas_block=pallas_block,
+                    pallas_interpret=pallas_interpret)
+                self._dp_fns[mesh_key] = epoch_fn
+            for epoch in range(cfg.epochs):
+                state, loss = epoch_fn(state, rows_d, cols_d, x_d,
+                                       mask_d, key, jnp.int32(epoch),
+                                       alpha)
+                self.losses.append(float(loss))
+        else:
+            for epoch in range(cfg.epochs):
+                state, loss = _glove_scan_epoch(
+                    state, rows_d, cols_d, x_d, mask_d, key,
+                    jnp.int32(epoch), alpha, x_max=cfg.x_max,
+                    power=cfg.weight_power, n_chunks=NC, batch=B,
+                    pallas_block=pallas_block,
+                    pallas_interpret=pallas_interpret)
+                self.losses.append(float(loss))
         self.state = state
         w, wt = state[0], state[1]
         self._wv = WordVectors(self.cache, w + wt)

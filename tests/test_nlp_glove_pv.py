@@ -44,6 +44,26 @@ def test_glove_trains_and_loss_decreases():
     assert g.similarity("cat", "dog") > g.similarity("cat", "crowns")
 
 
+def test_glove_data_parallel_mesh_fit():
+    """fit(mesh=...): shards train stripes of the shuffled triples on
+    table replicas, parameter-averaged per epoch (the spark glove job's
+    role — the same dp semantics as word2vec's device-mode mesh fit).
+    Quality matches the single-device run's semantic structure."""
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=8))
+    # averaging across 8 replicas dilutes the effective step ~8x per
+    # epoch; more epochs compensate (same note as the w2v dp test)
+    cfg = GloveConfig(vector_size=32, window=3, epochs=40, batch_size=64,
+                      x_max=10.0, seed=5)
+    g = Glove(CORPUS, cfg)
+    wv = g.fit(mesh=mesh)
+    assert getattr(g, "_dp_fns", None)            # dp path ran
+    assert np.all(np.isfinite(np.asarray(wv.vectors)))
+    assert g.losses[-1] < g.losses[0]
+    assert g.similarity("cat", "dog") > g.similarity("cat", "crowns")
+
+
 def _pv_fixture(epochs=25):
     docs = ([("animals_%d" % i,
               "the cat and the dog chased the mouse on the mat")
