@@ -283,35 +283,57 @@ _PROBE_CACHE: dict = {}
 
 def probe_compile(block: int, use_hs: bool, negative: int,
                   vocab_size: int = 128, dim: int = 8,
-                  hs_depth: int = 4) -> bool:
+                  hs_depth: int = 4, timeout_s: float = 240.0) -> bool:
     """One real compile at the given statics AND the caller's actual
     table shapes — ``auto`` selection on hardware goes through here so a
     Mosaic rejection degrades to the XLA path instead of crashing fit()
     (explicit kernel='pallas' still surfaces the error).  Mosaic
     acceptance and VMEM fit depend on (vocab, dim, Huffman depth), not
     just the block statics, so the probe runs at the production shapes
-    and is cached per the full key."""
+    and is cached per the full key.
+
+    The compile runs in a daemon thread joined with ``timeout_s`` (the
+    same guard as pallas_glove.probe_compile, with the same caveat: a
+    timeout abandons the hung Mosaic compile thread alive, and it may
+    delay this process's next compile — but the fit proceeds on XLA
+    instead of hanging the whole bench window)."""
     key = (block, use_hs, negative, vocab_size, dim, hs_depth)
     if key in _PROBE_CACHE:
         return _PROBE_CACHE[key]
-    try:
-        V, D, L = vocab_size, dim, max(hs_depth, 1)
-        z = jnp.zeros
-        _out = fused_chunk_update(
-            z((V, D)), z((V, D)) if use_hs else z((1, D)),
-            z((V, D)) if negative else z((1, D)),
-            z((block,), jnp.int32), z((block,), jnp.int32),
-            z((block, L)), z((block, L), jnp.int32), z((block, L)),
-            z((block, max(negative, 1)), jnp.int32), jnp.ones((block,)),
-            jnp.float32(0.01), use_hs=use_hs, negative=negative,
-            block=block, interpret=False)
-        float(_out[0][0, 0])
-        ok = True
-    except Exception as e:                # Mosaic/compile-specific
+
+    result = {}
+
+    def _try():
+        try:
+            V, D, L = vocab_size, dim, max(hs_depth, 1)
+            z = jnp.zeros
+            _out = fused_chunk_update(
+                z((V, D)), z((V, D)) if use_hs else z((1, D)),
+                z((V, D)) if negative else z((1, D)),
+                z((block,), jnp.int32), z((block,), jnp.int32),
+                z((block, L)), z((block, L), jnp.int32), z((block, L)),
+                z((block, max(negative, 1)), jnp.int32),
+                jnp.ones((block,)), jnp.float32(0.01), use_hs=use_hs,
+                negative=negative, block=block, interpret=False)
+            float(_out[0][0, 0])
+            result["ok"] = True
+        except Exception as e:            # Mosaic/compile-specific
+            result["err"] = e
+            result["ok"] = False
+
+    import threading
+    t = threading.Thread(target=_try, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    ok = bool(result.get("ok"))
+    if not ok:
         import logging
+        why = ("compile timed out after %.0fs — the hung Mosaic compile "
+               "thread is abandoned alive and may delay this process's "
+               "next compile" % timeout_s
+               if t.is_alive() else result.get("err"))
         logging.getLogger(__name__).warning(
             "word2vec Pallas kernel unavailable on this backend (%s); "
-            "using the XLA path", e)
-        ok = False
+            "using the XLA path", why)
     _PROBE_CACHE[key] = ok
     return ok
