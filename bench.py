@@ -824,12 +824,19 @@ def _glove_mosaic_probe(vocab: int, dim: int, batch: int,
     if not block:
         return "auto", None       # VMEM reject: in-process path handles it
     repo = os.path.dirname(os.path.abspath(__file__))
-    cache = os.path.join(repo, ".jax_cache")
+    # cache dir AND min-compile threshold MUST match the parent process:
+    # the probe banks the compiled kernel the parent then reloads warm
+    cache = _bench_cache_dir()
+    try:
+        min_s = float(os.environ.get("DL4J_TPU_COMPILATION_CACHE_MIN_S",
+                                     "5.0"))
+    except ValueError:
+        min_s = 5.0
     code = (
         "import jax, sys\n"
         f"jax.config.update('jax_compilation_cache_dir', {cache!r})\n"
         "jax.config.update('jax_persistent_cache_min_compile_time_secs',"
-        " 5.0)\n"
+        f" {min_s!r})\n"
         "if jax.devices()[0].platform != 'tpu':\n"
         "    print('PROBE_SKIP'); sys.exit(0)\n"
         "from deeplearning4j_tpu.ops.pallas_glove import probe_compile\n"
@@ -1179,6 +1186,38 @@ def _promote_banked_headline(out: dict, which: str = "bert") -> None:
         "invocation's live run fell back to CPU; see cpu_fallback)")
 
 
+def _attach_compile_stats(res: dict) -> None:
+    """Per-row compile/cache evidence from the runtime compile engine
+    (runtime/compile_cache.py): trace counts per labeled step, engine
+    cache hits, and wall-ms spent in compiling calls.  Rows whose model
+    path doesn't route through the engine honestly report zeros — the
+    counters only credit engine-managed compiles, never guess."""
+    try:
+        from deeplearning4j_tpu.runtime.metrics import compile_metrics
+
+        res["compile_stats"] = compile_metrics.snapshot()
+    except Exception:
+        pass  # stats are evidence, never a reason to fail a bench
+
+
+def _bench_cache_dir() -> str:
+    """The persistent-cache dir every bench process (and the glove Mosaic
+    probe subprocess) must share: the env override when set — resolved
+    through the runtime's grammar so '1'/'0' sentinels can't leave the
+    probe and the parent on different dirs — else the repo-local
+    .jax_cache (benches always cache, even when the env disables the
+    library-side cache)."""
+    fallback = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".jax_cache")
+    try:
+        from deeplearning4j_tpu.runtime import resolve_cache_dir
+
+        return resolve_cache_dir(
+            os.environ.get("DL4J_TPU_COMPILATION_CACHE")) or fallback
+    except Exception:
+        return fallback
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache for the inner bench processes.
 
@@ -1187,14 +1226,18 @@ def _enable_compile_cache() -> None:
     after a successful bert run).  With the cache, a retry — or the
     driver's end-of-round run — reloads the serialized executable in
     seconds.  Harmless if the backend doesn't support serialization (jax
-    logs a warning and compiles normally)."""
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
+    logs a warning and compiles normally).  Unlike library use (opt-in
+    via env), benches ALWAYS cache — so this delegates to the runtime's
+    single implementation with the RESOLVED dir written back to the env
+    (overwriting sentinels/'off' values) so probe subprocesses inherit
+    the exact same directory."""
+    os.environ["DL4J_TPU_COMPILATION_CACHE"] = _bench_cache_dir()
+    os.environ.setdefault("DL4J_TPU_COMPILATION_CACHE_MIN_S", "5.0")
     try:
-        import jax
+        from deeplearning4j_tpu.runtime import (
+            setup_persistent_compilation_cache)
 
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        setup_persistent_compilation_cache()
     except Exception:
         pass  # never let cache plumbing break a bench
 
@@ -1211,7 +1254,10 @@ def main() -> None:
             ndev = int(args[args.index("--ndev") + 1]) \
                 if "--ndev" in args else 8
             _force_cpu(ndev)
-        print(json.dumps(_sanitize(INNER[name]())))
+        res = INNER[name]()
+        if isinstance(res, dict):
+            _attach_compile_stats(res)
+        print(json.dumps(_sanitize(res)))
         return
 
     which = args[0] if args else "all"

@@ -60,6 +60,7 @@ class LSTMSequenceClassifier(SequenceClassifier):
         from deeplearning4j_tpu.nn.conf import (LayerKind,
                                                 NeuralNetConfiguration)
         from deeplearning4j_tpu.nn.layers.lstm import LSTMLayer
+        from deeplearning4j_tpu.runtime import compile_cache
 
         conf = (NeuralNetConfiguration.builder()
                 .kind(LayerKind.LSTM).n_in(n_in).n_out(n_classes)
@@ -72,7 +73,6 @@ class LSTMSequenceClassifier(SequenceClassifier):
 
         layer, opt = self._layer, self._opt
 
-        @jax.jit
         def train_step(params, opt_state, xs, ys):
             def loss_fn(p):
                 return layer.sequence_loss(p, xs, ys)
@@ -80,10 +80,18 @@ class LSTMSequenceClassifier(SequenceClassifier):
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        self._train_step = train_step
-        self._predict = jax.jit(
+        # the step is fully determined by the hyperparameters, so share
+        # one compiled program across identically-shaped classifiers;
+        # params/opt-state donate (fit() copies on entry)
+        engine_key = ("lstm_seq_clf", n_in, n_classes, hidden,
+                      learning_rate)
+        self._train_step = compile_cache.cached_jit(
+            train_step, key=("train",) + engine_key,
+            label="api.lstm_train_step", donate_argnums=(0, 1))
+        self._predict = compile_cache.cached_jit(
             lambda p, xs: jax.nn.softmax(
-                layer.decode(p, layer.scan_sequence(p, xs)), axis=-1))
+                layer.decode(p, layer.scan_sequence(p, xs)), axis=-1),
+            key=("predict",) + engine_key, label="api.lstm_predict")
 
     def classifier(self):
         return self._layer
@@ -98,6 +106,10 @@ class LSTMSequenceClassifier(SequenceClassifier):
             epochs: int = 50) -> List[float]:
         xs = jnp.asarray(features, jnp.float32)
         ys = self._one_hot(labels)
+        # donation guard: the shared train step consumes its params/
+        # opt-state buffers; copy once so refs held before fit() survive
+        self.params = jax.tree.map(jnp.copy, self.params)
+        self._opt_state = jax.tree.map(jnp.copy, self._opt_state)
         losses = []
         for _ in range(epochs):
             self.params, self._opt_state, loss = self._train_step(

@@ -24,8 +24,8 @@ from __future__ import annotations
 
 import io
 import logging
-from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+import math
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,7 @@ from deeplearning4j_tpu.nn.params import pack_params, unpack_params
 from deeplearning4j_tpu.ops.updaters import apply_updates, dl4j_updater
 from deeplearning4j_tpu.optimize.solver import Objective, Solver
 from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.runtime import compile_cache
 
 log = logging.getLogger(__name__)
 
@@ -72,7 +73,10 @@ class MultiLayerNetwork:
                         for i, spec in conf.input_preprocessors.items()}
         self._out_pre = {i: make_preprocessor(spec)
                          for i, spec in conf.output_preprocessors.items()}
-        self._jit_cache: Dict[Any, Callable] = {}
+        # compiled-step bundles live in the MODULE-LEVEL engine
+        # (runtime/compile_cache.py) keyed on the canonical conf JSON —
+        # per-instance attrs here only memoize the engine lookup
+        self._bp_cache = None
 
     # -- wiring (init:325 parity) ------------------------------------------
     def _wire_layer_sizes(self) -> None:
@@ -174,7 +178,10 @@ class MultiLayerNetwork:
         Line-search algorithms (CG/LBFGS) run a full Solver per batch (they
         are full-batch methods; the reference does the same)."""
         from deeplearning4j_tpu.nn.conf.configuration import OptimizationAlgorithm
-        params = self._require_params()
+        # donation guard: the engine's gd_step donates params/ustate, so
+        # copy ONCE at the API boundary — caller-held references to the
+        # pre-fit params stay valid
+        params = jax.tree.map(jnp.copy, self._require_params())
         batches = [data] if isinstance(data, DataSet) else list(data)
         key = jax.random.key(seed)
         for i, layer in enumerate(self.layers):
@@ -189,37 +196,48 @@ class MultiLayerNetwork:
             if conf.optimization_algo in (
                     OptimizationAlgorithm.GRADIENT_DESCENT,
                     OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT):
-                updater = dl4j_updater(
-                    lr=conf.lr, momentum=conf.momentum,
-                    momentum_schedule=conf.momentum_after,
-                    use_adagrad=conf.use_adagrad, l2=conf.l2,
-                    use_regularization=conf.use_regularization,
-                    constrain_unit_norm=conf.constrain_gradient_to_unit_norm,
-                )
+                # the jitted per-layer step AND its updater live in the
+                # module-level engine keyed on (layer index, conf JSON):
+                # a fresh closure per pretrain() call would recompile
+                # every time (the fit_backprop lesson), and N identically
+                # configured replicas share ONE compile.  The ustate init
+                # must come from the same updater the cached step closes
+                # over.  Like _build_backprop_machinery, the builder
+                # closes over a DETACHED conf-rebuilt layer/updater — not
+                # this network's live objects — so the entry neither pins
+                # this network nor retraces against later conf mutations.
+                def _build_gd(_i=i):
+                    rep = MultiLayerNetwork(
+                        MultiLayerConfiguration.from_json(
+                            self._conf_signature()))
+                    rlayer = rep.layers[_i]
+                    rc = rep.conf.confs[_i]
+                    rupdater = dl4j_updater(
+                        lr=rc.lr, momentum=rc.momentum,
+                        momentum_schedule=rc.momentum_after,
+                        use_adagrad=rc.use_adagrad, l2=rc.l2,
+                        use_regularization=rc.use_regularization,
+                        constrain_unit_norm=rc.constrain_gradient_to_unit_norm,
+                    )
 
-                # cache the jitted per-layer step AND its updater on the
-                # network: a fresh closure per pretrain() call would
-                # recompile every time (the fit_backprop lesson), and the
-                # ustate init must come from the same updater the cached
-                # step closes over.  Like _bp_cache: mutating conf after
-                # the first fit requires a fresh network.
-                if not hasattr(self, "_pretrain_cache"):
-                    self._pretrain_cache = {}
-                if i not in self._pretrain_cache:
-                    @jax.jit
-                    def gd_step(p, ustate, inputs, k, it, _layer=layer,
-                                _updater=updater):
+                    def gd_step(p, ustate, inputs, k, it):
                         k = jax.random.fold_in(k, it)
-                        score, grads = _layer.pretrain_value_and_grad(
+                        score, grads = rlayer.pretrain_value_and_grad(
                             p, k, inputs)
                         # batch_size=1: objectives are batch MEANS (the
                         # ÷batch step exists for parity with summed
                         # reference grads)
-                        updates, ustate = _updater.update(
+                        updates, new_ustate = rupdater.update(
                             ustate, grads, p, it, 1)
-                        return apply_updates(p, updates), ustate, score
-                    self._pretrain_cache[i] = (gd_step, updater)
-                gd_step, updater = self._pretrain_cache[i]
+                        return apply_updates(p, updates), new_ustate, score
+                    # params + updater state update in place on device
+                    # (donated); pretrain() copies on entry
+                    return (compile_cache.cached_jit(
+                        gd_step, label=f"multilayer.pretrain_gd[{_i}]",
+                        donate_argnums=(0, 1)), rupdater)
+                gd_step, updater = compile_cache.get_or_build(
+                    ("multilayer_pretrain_gd", i, self._conf_signature()),
+                    _build_gd)
 
                 ustate = updater.init(params[i])
                 it = 0
@@ -313,24 +331,50 @@ class MultiLayerNetwork:
         self.params = params
 
     # -- backprop fine-tuning (doBackWard:941 ≡ jax.grad of loss) ----------
-    def _backprop_machinery(self):
-        """Build (train_step, updaters) ONCE per network and cache.
+    def _conf_signature(self) -> str:
+        """Canonical config signature for the compile engine: the sorted-
+        key conf JSON (wired sizes included).  Everything the jitted step
+        closes over — layers, preprocessors, updaters, BN indices — is
+        derived from exactly this."""
+        return self.conf.to_json()
 
-        The jitted step closes over conf/layers only, so rebuilding it on
-        every ``fit_backprop`` call would throw away the XLA compile
-        cache — on TPU that charged a full recompilation (tens of
-        seconds) to every fit invocation.  Mutating ``self.conf`` after
-        the first fit requires a fresh network (same contract as the
-        reference's init()-once lifecycle)."""
-        if getattr(self, "_bp_cache", None) is not None:
-            return self._bp_cache
+    def _backprop_machinery(self):
+        """(train_step, train_epochs, updaters) from the MODULE-LEVEL
+        compile engine, keyed on the canonical conf signature.
+
+        The jitted step closes over conf-derived state only, so N
+        identically-configured networks — e.g. the worker replicas
+        ``parallel/scaleout.py`` / ``parallel/data_parallel.py`` spawn
+        from one conf JSON — share ONE compiled step instead of paying N
+        XLA compiles (tens of seconds each on TPU).  Mutating
+        ``self.conf`` after the first fit requires a fresh network (same
+        contract as the reference's init()-once lifecycle; the engine
+        key would otherwise go stale).
+
+        Donation contract: ``train_step`` and ``train_epochs`` donate
+        params + updater state, so their HBM is reused in place — the
+        fit entry points copy caller params once at the API boundary."""
+        if self._bp_cache is None:
+            self._bp_cache = compile_cache.get_or_build(
+                ("multilayer_backprop", self._conf_signature()),
+                self._build_backprop_machinery)
+        return self._bp_cache
+
+    def _build_backprop_machinery(self):
+        # Close over a DETACHED replica rebuilt from the conf JSON
+        # (params=None), never over ``self``: the engine entry outlives
+        # this network, and a closure over ``self`` would pin the first
+        # network's whole object graph — trained params included — for
+        # process lifetime.
+        net = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(self._conf_signature()))
         updaters = [dl4j_updater(
             lr=c.lr, momentum=c.momentum, momentum_schedule=c.momentum_after,
             use_adagrad=c.use_adagrad, l2=c.l2,
             use_regularization=c.use_regularization,
             constrain_unit_norm=c.constrain_gradient_to_unit_norm,
-        ) for c in self.conf.confs]
-        bn_layers = [i for i, c in enumerate(self.conf.confs)
+        ) for c in net.conf.confs]
+        bn_layers = [i for i, c in enumerate(net.conf.confs)
                      if c.kind is LayerKind.BATCH_NORM]
 
         def step_body(params, ustate, x, y, key, iteration):
@@ -344,13 +388,13 @@ class MultiLayerNetwork:
                 # harvest the batch statistics BN's running-stat EMA needs
                 # (previously a second full feed_forward per step — ~2x
                 # forward cost on any BN net).
-                n = len(self.layers)
-                acts = self.feed_forward(p, x, key, train=True, upto=n - 1)
+                n = len(net.layers)
+                acts = net.feed_forward(p, x, key, train=True, upto=n - 1)
                 h = acts[-1]
                 last = n - 1
-                if last in self._in_pre:
-                    h = self._in_pre[last](h, key)
-                loss = self.output_layer.loss(p[-1], h, y)
+                if last in net._in_pre:
+                    h = net._in_pre[last](h, key)
+                loss = net.output_layer.loss(p[-1], h, y)
                 stats = {}
                 for i in bn_layers:
                     h_in = acts[i]
@@ -376,7 +420,12 @@ class MultiLayerNetwork:
                 new_params[i] = p
             return new_params, new_ustate, score
 
-        train_step = jax.jit(step_body)
+        # donate params + updater state: the update writes back into the
+        # same HBM instead of doubling traffic/peak memory per step.  The
+        # fit entry points copy caller arrays once, so only loop-internal
+        # buffers are ever consumed.
+        train_step = compile_cache.cached_jit(
+            step_body, label="multilayer.train_step", donate_argnums=(0, 1))
 
         def _epoch_scan(carry, xs, ys, key):
             """lax.scan the step over device-stacked batches [NB, B, ...]."""
@@ -388,7 +437,6 @@ class MultiLayerNetwork:
 
             return lax.scan(body, carry, (xs, ys))
 
-        @partial(jax.jit, static_argnums=(6,))
         def train_epochs(params, ustate, xs, ys, key, it0, num_epochs):
             """ONE dispatch for the whole fit: scan over epochs of the
             scanned step.  A python per-step loop costs one host->device
@@ -404,14 +452,19 @@ class MultiLayerNetwork:
                 epoch_body, (params, ustate, it0), None, length=num_epochs)
             return params, ustate, scores
 
-        self._bp_cache = (train_step, train_epochs, updaters)
-        return self._bp_cache
+        train_epochs = compile_cache.cached_jit(
+            train_epochs, label="multilayer.train_epochs",
+            static_argnums=(6,), donate_argnums=(0, 1))
+
+        return (train_step, train_epochs, updaters)
 
     def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
                      num_epochs: int = 1, seed: int = 2) -> None:
         """Full-network supervised minibatch training with ONE fused,
         jit-compiled train step (value+grad+GradientAdjustment+update),
-        compiled once per network and reused across fit calls.
+        compiled once per CONFIG — shared across fit calls AND across
+        identically-configured networks via the runtime compile engine —
+        with params/updater state donated back into the same HBM.
 
         Uniform-shape batch lists run as a scanned EPOCH — a single
         device dispatch per epoch, with listeners replayed from the
@@ -420,7 +473,11 @@ class MultiLayerNetwork:
 
         Each layer gets its OWN updater from its conf, so per-layer
         lr/momentum/l2 overrides (ConfOverride parity) take effect."""
-        params = self._require_params()
+        # donation guard: the engine steps donate params/ustate buffers;
+        # one copy at the API boundary keeps caller-held references to
+        # the pre-fit params valid (only loop-internal buffers, which no
+        # caller ever saw, get consumed in place)
+        params = jax.tree.map(jnp.copy, self._require_params())
         train_step, train_epochs, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         batches = [data] if isinstance(data, DataSet) else list(data)
@@ -430,7 +487,6 @@ class MultiLayerNetwork:
         # Sized from shape/dtype — np.asarray here would D2H-copy every
         # device-resident batch just to count bytes
         def _nbytes(a):
-            import math
             return math.prod(a.shape) * jnp.dtype(a.dtype).itemsize
         total_bytes = sum(_nbytes(b.features) + _nbytes(b.labels)
                           for b in batches)
@@ -493,7 +549,8 @@ class MultiLayerNetwork:
                 "conf wants pretrain/finetune (pretrain="
                 f"{self.conf.pretrain}, backprop={self.conf.backprop}) — "
                 "use fit() with materialized batches")
-        params = self._require_params()
+        # donation guard — see fit_backprop
+        params = jax.tree.map(jnp.copy, self._require_params())
         train_step, _, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         run_key = jax.random.key(seed)

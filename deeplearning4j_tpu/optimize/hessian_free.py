@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.runtime import compile_cache
 
 log = logging.getLogger(__name__)
 
@@ -99,11 +100,17 @@ class StochasticHessianFree:
         self.listeners = list(listeners)
         self.score_history: List[float] = []
 
-        self._value = jax.jit(objective.value)
-        self._value_and_grad = jax.jit(objective.value_and_grad)
+        # through the compile engine for the compile counters; no
+        # donation — params/iterates are re-read across the CG solve —
+        # and no cross-instance key (the objective closes over the data)
+        self._value = compile_cache.cached_jit(
+            objective.value, label="hf.value")
+        self._value_and_grad = compile_cache.cached_jit(
+            objective.value_and_grad, label="hf.value_and_grad")
         # λ enters as an argument so adaptation doesn't retrace
-        self._damped_mv = jax.jit(
-            lambda p, v, lam: _tadd(objective.gnvp(p, v), _tscale(v, lam)))
+        self._damped_mv = compile_cache.cached_jit(
+            lambda p, v, lam: _tadd(objective.gnvp(p, v), _tscale(v, lam)),
+            label="hf.damped_mv")
 
     # -- CG with iterate recording (conjGradient:87 parity) ----------------
     def _cg(self, params: Params, b: Params, x0: Params, lam: float

@@ -33,6 +33,7 @@ from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.optimize.terminations import (
     EpsTermination, InvalidScore, TerminationCondition, ZeroDirection,
 )
+from deeplearning4j_tpu.runtime import compile_cache
 
 log = logging.getLogger(__name__)
 
@@ -91,7 +92,6 @@ class GradientDescentOptimizer(BaseOptimizer):
             constrain_unit_norm=conf.constrain_gradient_to_unit_norm,
         )
 
-        @jax.jit
         def step(params, ustate, key, iteration):
             score, grads = objective.value_and_grad(params, key)
             updates, ustate = self.updater.update(
@@ -100,9 +100,16 @@ class GradientDescentOptimizer(BaseOptimizer):
             gnorm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree.leaves(grads)))
             return params, ustate, score, gnorm
 
-        self._step = step
+        # params/ustate update in place on device (donated); optimize()
+        # copies on entry so caller-held arrays survive.  No engine key:
+        # the objective closes over arbitrary user data, so cross-instance
+        # sharing would silently bake in the wrong closure.
+        self._step = compile_cache.cached_jit(
+            step, label="solver.gd_step", donate_argnums=(0, 1))
 
     def optimize(self, params: Params, key: Array) -> Params:
+        # donation guard: the first step donates its params/ustate args
+        params = jax.tree.map(jnp.copy, params)
         ustate = self.updater.init(params)
         old_score = float("inf")
         for i in range(self.conf.num_iterations):
@@ -130,7 +137,6 @@ class LineSearchGradientDescent(BaseOptimizer):
         def flat_value(flat, key):
             return objective.value(unpack_params(flat, template), key)
 
-        @jax.jit
         def step(flat, key):
             score, grads = objective.value_and_grad(
                 unpack_params(flat, template), key)
@@ -142,7 +148,10 @@ class LineSearchGradientDescent(BaseOptimizer):
                 initial_step=self.conf.lr)
             return flat + t * d, f_new, jnp.linalg.norm(g)
 
-        self._step = step
+        # flat is born fresh from pack_params (a new buffer) and threaded
+        # through the loop — donating it is always safe, no entry copy
+        self._step = compile_cache.cached_jit(
+            step, label="solver.linesearch_step", donate_argnums=(0,))
 
     def optimize(self, params: Params, key: Array) -> Params:
         template = params
@@ -180,7 +189,6 @@ class ConjugateGradientOptimizer(BaseOptimizer):
         def flat_value(flat, key):
             return objective.value(unpack_params(flat, template), key)
 
-        @jax.jit
         def step(flat, g_prev, d, key):
             f0, g = flat_vag(flat, key)
             # Polak-Ribiere beta with restart (max(0, .))
@@ -198,7 +206,10 @@ class ConjugateGradientOptimizer(BaseOptimizer):
                 initial_step=self.conf.lr)
             return flat + t * d_new, g, d_new, f_new, jnp.linalg.norm(g)
 
-        self._step = step
+        # flat/g_prev/d are all loop-threaded packed vectors born fresh
+        # in optimize() — donate the whole CG state
+        self._step = compile_cache.cached_jit(
+            step, label="solver.cg_step", donate_argnums=(0, 1, 2))
 
     def optimize(self, params: Params, key: Array) -> Params:
         template = params
@@ -271,7 +282,6 @@ class LBFGSOptimizer(BaseOptimizer):
 
             return jax.lax.fori_loop(0, m, fwd, r)
 
-        @jax.jit
         def step(flat, S, Y, rho, count, key):
             f0, g = flat_vag(flat, key)
             d = -two_loop(g, S, Y, rho, count)
@@ -296,7 +306,11 @@ class LBFGSOptimizer(BaseOptimizer):
                 sy > 1e-10, append, lambda a: a, (S, Y, rho, count))
             return flat_new, S, Y, rho, count, f_new, jnp.linalg.norm(g)
 
-        self._step = step
+        # the [m, n] history ring buffers are the big HBM tenants here —
+        # donating them (plus flat and rho, all loop-threaded and born
+        # fresh in optimize()) halves L-BFGS peak memory
+        self._step = compile_cache.cached_jit(
+            step, label="solver.lbfgs_step", donate_argnums=(0, 1, 2, 3))
 
     def optimize(self, params: Params, key: Array) -> Params:
         template = params

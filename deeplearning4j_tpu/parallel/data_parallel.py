@@ -33,6 +33,7 @@ from jax import shard_map
 from deeplearning4j_tpu.ops.updaters import Dl4jUpdater, apply_updates
 from deeplearning4j_tpu.parallel import collectives
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.runtime import compile_cache
 
 Array = jax.Array
 PyTree = Any
@@ -47,6 +48,7 @@ class DataParallelTrainer:
         self.loss_fn = loss_fn
         self.updater = updater
         self.mesh = mesh
+        self.donate = donate
 
         # All mesh axes except `data` are unused here; Replicate over them.
         param_spec = P()
@@ -71,7 +73,12 @@ class DataParallelTrainer:
             out_specs=(param_spec, param_spec, P()),
             check_vma=False,
         )
-        self._step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+        # through the compile engine for the compile counters; no
+        # cross-instance key (loss_fn is an arbitrary user closure).
+        # step() donates params/ustate raw; fit() copies on entry.
+        self._step = compile_cache.cached_jit(
+            sharded, label="parallel.dp_step",
+            donate_argnums=(0, 1) if donate else ())
 
     def init_state(self, params: PyTree) -> PyTree:
         return self.updater.init(params)
@@ -85,6 +92,11 @@ class DataParallelTrainer:
 
     def fit(self, params: PyTree, batches: Iterable[Tuple[Array, Array]],
             key: Array, listeners=()) -> PyTree:
+        # donation guard: the first step consumes its params/ustate args;
+        # copy once so the caller's arrays stay valid (pointless when the
+        # trainer was built non-donating, so skip the traffic then)
+        if self.donate:
+            params = jax.tree.map(jnp.copy, params)
         ustate = self.init_state(params)
         for it, (x, y) in enumerate(batches):
             key, sub = jax.random.split(key)
@@ -128,12 +140,15 @@ class ParameterAveragingTrainer:
             score = lax.pmean(scores[-1], DATA_AXIS)
             return jax.tree.map(lambda a: a[None], params), score
 
-        self._round = jax.jit(shard_map(
+        # the stacked [ndp, ...] replicas are the big HBM tenant here and
+        # are loop-threaded (born fresh from the broadcast in fit) —
+        # donate them so each round updates replicas in place
+        self._round = compile_cache.cached_jit(shard_map(
             round_fn, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
             out_specs=(P(DATA_AXIS), P()),
             check_vma=False,
-        ))
+        ), label="parallel.param_avg_round", donate_argnums=(0,))
 
         def avg(stacked):
             def inner(s):
@@ -143,7 +158,8 @@ class ParameterAveragingTrainer:
             return shard_map(inner, mesh=mesh, in_specs=(P(DATA_AXIS),),
                              out_specs=P(DATA_AXIS), check_vma=False)(stacked)
 
-        self._final_avg = jax.jit(avg)
+        self._final_avg = compile_cache.cached_jit(
+            avg, label="parallel.param_avg_final")
         self._ndp = mesh.shape[DATA_AXIS]
 
     def fit(self, params: PyTree, batches: Iterable[Tuple[Array, Array]],

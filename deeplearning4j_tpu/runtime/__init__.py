@@ -1,0 +1,77 @@
+"""Runtime services: metrics, checkpointing, console, compile engine.
+
+Importing this package wires the OPT-IN persistent XLA compilation cache:
+set ``DL4J_TPU_COMPILATION_CACHE`` to a directory (or to ``1`` for the
+default ``~/.cache/dl4j_tpu_xla``) and every process that trains through
+the engine serializes its compiled executables there — repeated worker
+processes (``parallel/scaleout.py`` spawns N replicas of the same conf)
+then skip XLA compiles entirely and reload in seconds.  This is the
+cross-PROCESS analog of the in-process cross-network cache in
+``runtime/compile_cache.py``.
+
+``DL4J_TPU_COMPILATION_CACHE_MIN_S`` (default 1.0) sets the minimum
+compile seconds below which executables are not worth persisting.
+"""
+
+from __future__ import annotations
+
+import os
+
+PERSISTENT_CACHE_ENV = "DL4J_TPU_COMPILATION_CACHE"
+PERSISTENT_CACHE_MIN_S_ENV = "DL4J_TPU_COMPILATION_CACHE_MIN_S"
+
+
+def resolve_cache_dir(value: "str | None") -> "str | None":
+    """Resolve the env-var grammar to a concrete dir (or None=disabled):
+    empty/'0'/'false'/'off' disable; '1'/'true'/'on' mean the default
+    ``~/.cache/dl4j_tpu_xla``; anything else is the dir itself.  Shared
+    with bench.py so the parent process and its probe subprocesses can
+    never resolve the same env to different directories."""
+    v = (value or "").strip()
+    if not v or v.lower() in ("0", "false", "off"):
+        return None
+    if v.lower() in ("1", "true", "on"):
+        return os.path.join(os.path.expanduser("~"), ".cache",
+                            "dl4j_tpu_xla")
+    return v
+
+
+def setup_persistent_compilation_cache() -> str | None:
+    """Point jax at an on-disk compilation cache when the env var opts in.
+
+    Returns the cache dir in use, or None when disabled.  Never raises:
+    cache plumbing must not be able to break training (an unsupported
+    backend just logs jax's own warning and compiles normally).
+    """
+    path = resolve_cache_dir(os.environ.get(PERSISTENT_CACHE_ENV))
+    if path is None:
+        return None
+    raw_min_s = os.environ.get(PERSISTENT_CACHE_MIN_S_ENV, "1.0")
+    try:
+        min_s = float(raw_min_s)
+    except ValueError:
+        # one bad tuning knob must not silently switch the whole opted-in
+        # cache off — warn and keep the default threshold
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not a float; using 1.0", PERSISTENT_CACHE_MIN_S_ENV,
+            raw_min_s)
+        min_s = 1.0
+    try:
+        # order matters: threshold BEFORE the cache dir — any failure then
+        # leaves the cache fully disabled (a dangling threshold with no
+        # dir is inert), never half-enabled behind a return value that
+        # reports it off
+        import jax
+
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_s)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        return None
+    return path
+
+
+#: resolved at import so any training entry point gets the cache for free
+PERSISTENT_CACHE_DIR = setup_persistent_compilation_cache()

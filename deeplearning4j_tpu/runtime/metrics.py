@@ -12,12 +12,84 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import jax
 
-from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+class CompileMetrics:
+    """Process-wide compile/cache counters for the runtime compile engine
+    (runtime/compile_cache.py).
+
+    - ``compile_count``: XLA traces actually performed — one per unique
+      (function, input shapes/dtypes) signature.  Two identically
+      configured networks sharing one engine entry trace ONCE.
+    - ``compile_ms``: wall-clock ms of engine calls that triggered a
+      trace (trace + XLA compile dominate; the dispatch riding along is
+      noise at compile timescales).
+    - ``engine_builds`` / ``engine_hits``: keyed engine lookups that
+      built a new compiled-step entry vs. reused an existing one.
+    - ``cached_dispatches``: engine calls served entirely from the
+      already-compiled executable (no trace).
+    - ``traces``: per-label trace counts, e.g.
+      ``{"multilayer.train_step": 1}``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.compile_count = 0
+            self.compile_ms = 0.0
+            self.engine_builds = 0
+            self.engine_hits = 0
+            self.cached_dispatches = 0
+            self.traces: Dict[str, int] = {}
+
+    def note_trace(self, label: str) -> None:
+        with self._lock:
+            self.compile_count += 1
+            self.traces[label] = self.traces.get(label, 0) + 1
+
+    def note_compile_ms(self, ms: float) -> None:
+        with self._lock:
+            self.compile_ms += ms
+
+    def note_engine(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.engine_hits += 1
+            else:
+                self.engine_builds += 1
+
+    def note_cached_dispatch(self) -> None:
+        with self._lock:
+            self.cached_dispatches += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compile_count": self.compile_count,
+                "compile_ms": round(self.compile_ms, 1),
+                "engine_builds": self.engine_builds,
+                "engine_hits": self.engine_hits,
+                "cached_dispatches": self.cached_dispatches,
+                "traces": dict(self.traces),
+            }
+
+
+#: process-wide singleton the compile engine reports into
+compile_metrics = CompileMetrics()
+
+# This import sits BELOW the compile counters on purpose: importing this
+# module can re-enter it through the
+# optimize/__init__ -> solver -> runtime.compile_cache cycle, and that
+# re-entry needs ``compile_metrics`` to already be bound.
+from deeplearning4j_tpu.optimize.listeners import IterationListener  # noqa: E402
 
 
 class ScalarsLogger:

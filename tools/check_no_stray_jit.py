@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Lint: hot-path code in ``nn/`` and ``optimize/`` must compile through
+the runtime engine (``runtime/compile_cache.cached_jit``), never raw
+``jax.jit`` — a stray jit bypasses the cross-network compile cache and
+the compile-count/cache-hit/compile-ms counters, silently re-charging
+every worker replica a full XLA compile.
+
+AST-based, so comments/docstrings mentioning jax.jit don't trip it.
+Flags:
+- ``jax.jit(...)`` / ``@jax.jit`` / ``partial(jax.jit, ...)`` attribute
+  references (any expression position);
+- ``from jax import jit`` / ``from jax import pjit`` imports (aliased or
+  not) that would let a later bare call hide from the attribute check.
+
+Runs standalone (exit 1 on findings) and as a tier-1 test via
+``tests/test_compile_engine.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import List
+
+#: package dirs whose every .py is a hot path routed through the engine
+SCOPES = ("deeplearning4j_tpu/nn", "deeplearning4j_tpu/optimize")
+
+#: jax callables that compile programs and must go through the engine
+_COMPILERS = {"jit", "pjit"}
+
+
+def find_stray_jits(repo_root: pathlib.Path) -> List[str]:
+    """Return ``path:line: finding`` strings for every bypass in SCOPES."""
+    findings: List[str] = []
+    for scope in SCOPES:
+        for path in sorted((repo_root / scope).rglob("*.py")):
+            rel = path.relative_to(repo_root)
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr in _COMPILERS
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "jax"):
+                    findings.append(
+                        f"{rel}:{node.lineno}: jax.{node.attr} bypasses "
+                        "runtime/compile_cache.cached_jit")
+                elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                    for alias in node.names:
+                        if alias.name in _COMPILERS:
+                            findings.append(
+                                f"{rel}:{node.lineno}: 'from jax import "
+                                f"{alias.name}' hides compiles from the "
+                                "engine")
+    return findings
+
+
+def main() -> int:
+    repo_root = pathlib.Path(__file__).resolve().parent.parent
+    findings = find_stray_jits(repo_root)
+    if findings:
+        print("stray jit calls bypassing the compile engine "
+              f"({len(findings)}):")
+        for f in findings:
+            print("  " + f)
+        return 1
+    print("ok: nn/ and optimize/ compile through the engine")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
