@@ -582,7 +582,7 @@ def bench_scaling(ndp: int = 8, steps: int = 20, warmup: int = 3,
     is smoke-measured when the environment supports it."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 
@@ -963,6 +963,78 @@ def bench_longctx32k():
     return bench_longctx(seq_len=32768)
 
 
+def bench_resilience(batch_size: int = 64, n_batches: int = 16,
+                     num_epochs: int = 8):
+    """Self-healing training row (runtime/resilience.py): the guarded
+    per-step path driven by ResilientFit over a batch set with a
+    NaN-poisoned batch injected per epoch.  Reports (1) steady-state
+    step rate THROUGH the in-step guard, (2) the healing evidence —
+    steps actually skipped, checkpoints written — and (3)
+    ``guard_compile_delta``: XLA compiles during the timed (poisoned)
+    window, which must be 0 — the skip path is the same program as the
+    healthy path, so a NaN batch costs a select, never a retrace."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                    resilience_metrics)
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+
+    platform, _, n_dev = _platform_info()
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(64).lr(0.05).momentum(0.5).use_adagrad(False)
+            .num_iterations(1).activation("tanh")
+            .list(3).hidden_layer_sizes(128, 64)
+            .override(2, kind=LayerKind.OUTPUT, n_out=10,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    rng = np.random.RandomState(0)
+    batches = []
+    for b in range(n_batches):
+        x = rng.randn(batch_size, 64).astype(np.float32)
+        if b == n_batches // 2:
+            x[0, 0] = np.nan          # the poisoned batch
+        y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch_size)]
+        batches.append(DataSet(jnp.asarray(x), jnp.asarray(y)))
+
+    net = MultiLayerNetwork(conf).init(seed=0)
+    # warmup: compile the guarded step outside the timed window
+    net.fit_backprop(batches[0], num_epochs=2)
+    before = compile_metrics.snapshot()["compile_count"]
+    resilience_metrics.reset()
+    with tempfile.TemporaryDirectory() as ckdir:
+        driver = ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=ckdir, checkpoint_every=n_batches,
+            patience=10 ** 6))   # skip-only row: rollback never triggers
+        t0 = time.perf_counter()
+        driver.fit(batches, num_epochs=num_epochs, seed=1)
+        jax.block_until_ready(jax.tree.leaves(net.params)[0])
+        wall = time.perf_counter() - t0
+    steps = n_batches * num_epochs
+    stats = resilience_metrics.snapshot()
+    return {
+        "metric": "resilient_fit_guarded_steps_per_sec",
+        "value": round(steps / wall, 1),
+        "unit": "steps/sec",
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"b{batch_size}_nb{n_batches}_e{num_epochs}_1nan",
+        "samples_per_sec": round(steps * batch_size / wall, 1),
+        "steps_skipped": stats.get("steps_skipped", 0),
+        "checkpoints_saved": stats.get("checkpoints_saved", 0),
+        "guard_compile_delta":
+            compile_metrics.snapshot()["compile_count"] - before,
+        "final_params_finite": bool(
+            np.isfinite(np.asarray(net.params_flat())).all()),
+    }
+
+
 INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
          "scaling": bench_scaling, "w2v_dp": bench_w2v_dp,
@@ -978,7 +1050,9 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "bert_b128": lambda: bench_bert(128, 128, 10),
          "bert_b256": lambda: bench_bert(256, 128, 10),
          "bert_T512b32": lambda: bench_bert(32, 512, 10),
-         "resnet_s2d": lambda: bench_resnet(stem_s2d=True)}
+         "resnet_s2d": lambda: bench_resnet(stem_s2d=True),
+         # self-healing row: guarded-step rate + skip/ckpt evidence
+         "resilience": bench_resilience}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
@@ -994,7 +1068,7 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             # fallback would just repeat the tiny-model bert row)
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
             "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
-            "resnet_s2d": (1800, 0)}
+            "resnet_s2d": (1800, 0), "resilience": (300, 240)}
 
 
 # -- perf-regression guard --------------------------------------------------
@@ -1198,6 +1272,15 @@ def _attach_compile_stats(res: dict) -> None:
         res["compile_stats"] = compile_metrics.snapshot()
     except Exception:
         pass  # stats are evidence, never a reason to fail a bench
+    try:
+        from deeplearning4j_tpu.runtime.metrics import resilience_metrics
+
+        # skip/rollback/reject counters from the self-healing layer
+        # (runtime/resilience.py) — all-zero on a healthy run, which is
+        # itself evidence the guards didn't fire
+        res["resilience_stats"] = resilience_metrics.snapshot()
+    except Exception:
+        pass
 
 
 def _bench_cache_dir() -> str:

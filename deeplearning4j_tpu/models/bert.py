@@ -336,7 +336,7 @@ def make_sp_train_step(cfg: TransformerConfig, mesh: Mesh,
     Returns ``(init_fn(key) -> TrainState, step_fn(state, batch) ->
     (state, loss))``, jitted with the dp/sp shardings baked in.
     """
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
     from deeplearning4j_tpu.parallel import ring_attention as ra
     from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS
 

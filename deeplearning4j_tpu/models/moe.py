@@ -191,7 +191,7 @@ def make_train_step(cfg: MoETransformerConfig, mesh: Mesh,
     (state, loss))`` jitted with shardings baked in (expert tables REMAIN
     sharded in the optimizer state — the ep memory win).
     """
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
 
     optimizer = optimizer or optax.adamw(1e-3, weight_decay=0.01)
     ep = mesh.shape.get(EXPERT_AXIS, 1)

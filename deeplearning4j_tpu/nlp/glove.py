@@ -248,7 +248,7 @@ def make_dp_glove_epoch(mesh, axis: str, n_shards: int, per: int, *,
     Loss is the count-weighted GLOBAL mean via psum.
 
     ``average=False`` skips the pmean — timing diagnostics only."""
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     rep = P()

@@ -416,7 +416,7 @@ def make_dp_stream_epoch(mesh, axis: str, n_shards: int, per: int, *,
     ``average=False`` skips the pmean (shard-local updates; replicas
     DIVERGE) — only for measuring the collective's share of epoch time
     (bench.py's w2v-dp row), never for training."""
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     rep = P()

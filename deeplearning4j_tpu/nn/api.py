@@ -74,11 +74,18 @@ class LSTMSequenceClassifier(SequenceClassifier):
         layer, opt = self._layer, self._opt
 
         def train_step(params, opt_state, xs, ys):
+            from deeplearning4j_tpu.runtime import resilience
+
             def loss_fn(p):
                 return layer.sequence_loss(p, xs, ys)
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            updates, new_state = opt.update(grads, opt_state, params)
+            # in-step anomaly guard (runtime/resilience.py): drop the
+            # whole update on non-finite loss/grads, flag the skip
+            new_params, new_state, skipped = resilience.guard_update(
+                params, opt_state, optax.apply_updates(params, updates),
+                new_state, (loss, grads))
+            return new_params, new_state, loss, skipped
 
         # the step is fully determined by the hyperparameters, so share
         # one compiled program across identically-shaped classifiers;
@@ -111,10 +118,15 @@ class LSTMSequenceClassifier(SequenceClassifier):
         self.params = jax.tree.map(jnp.copy, self.params)
         self._opt_state = jax.tree.map(jnp.copy, self._opt_state)
         losses = []
+        skips = []
         for _ in range(epochs):
-            self.params, self._opt_state, loss = self._train_step(
+            self.params, self._opt_state, loss, skipped = self._train_step(
                 self.params, self._opt_state, xs, ys)
+            skips.append(skipped)
             losses.append(float(loss))
+        from deeplearning4j_tpu.runtime import resilience
+
+        resilience.note_skips(skips, where="sequence-api")
         return losses
 
     def predict(self, examples: Array) -> Array:

@@ -44,7 +44,7 @@ from deeplearning4j_tpu.nn.params import pack_params, unpack_params
 from deeplearning4j_tpu.ops.updaters import apply_updates, dl4j_updater
 from deeplearning4j_tpu.optimize.solver import Objective, Solver
 from deeplearning4j_tpu.optimize.listeners import IterationListener
-from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime import compile_cache, resilience
 
 log = logging.getLogger(__name__)
 
@@ -229,7 +229,10 @@ class MultiLayerNetwork:
                         # reference grads)
                         updates, new_ustate = rupdater.update(
                             ustate, grads, p, it, 1)
-                        return apply_updates(p, updates), new_ustate, score
+                        new_p, new_ustate, skipped = resilience.guard_update(
+                            p, ustate, apply_updates(p, updates),
+                            new_ustate, (score, grads))
+                        return new_p, new_ustate, score, skipped
                     # params + updater state update in place on device
                     # (donated); pretrain() copies on entry
                     return (compile_cache.cached_jit(
@@ -245,15 +248,18 @@ class MultiLayerNetwork:
                 # would replay identical corruption/Gibbs noise in every
                 # layer of the stack
                 layer_key = jax.random.fold_in(key, i)
+                skips = []
                 for batch in batches:
                     inputs = layer_input(batch.features)
                     for _ in range(conf.num_iterations):
-                        params[i], ustate, score = gd_step(
+                        params[i], ustate, score, skipped = gd_step(
                             params[i], ustate, inputs, layer_key, it)
+                        skips.append(skipped)
                         if self.listeners:
                             for ls in self.listeners:
                                 ls.iteration_done(self, it, float(score))
                         it += 1
+                self._note_skips(skips)
             else:
                 for b, batch in enumerate(batches):
                     inputs = layer_input(batch.features)
@@ -418,7 +424,14 @@ class MultiLayerNetwork:
                 p["running_mean"] = 0.9 * p["running_mean"] + 0.1 * mean
                 p["running_var"] = 0.9 * p["running_var"] + 0.1 * var
                 new_params[i] = p
-            return new_params, new_ustate, score
+            # in-step anomaly guard: a non-finite loss or gradient drops
+            # the whole update (params AND updater state — a poisoned
+            # AdaGrad accumulator would corrupt every later step) and
+            # raises the skip flag.  Pure jnp.where select: same XLA
+            # program on the healthy path, no extra compiles.
+            new_params, new_ustate, skipped = resilience.guard_update(
+                params, ustate, new_params, new_ustate, (score, grads))
+            return new_params, new_ustate, score, skipped
 
         # donate params + updater state: the update writes back into the
         # same HBM instead of doubling traffic/peak memory per step.  The
@@ -432,8 +445,8 @@ class MultiLayerNetwork:
             def body(c, inp):
                 p, u, it = c
                 x, y = inp
-                p, u, score = step_body(p, u, x, y, key, it)
-                return (p, u, it + 1), score
+                p, u, score, skipped = step_body(p, u, x, y, key, it)
+                return (p, u, it + 1), (score, skipped)
 
             return lax.scan(body, carry, (xs, ys))
 
@@ -443,14 +456,15 @@ class MultiLayerNetwork:
             round-trip per step, and even a per-epoch loop pays one per
             epoch — under a tunneled TPU that latency (10 ms to 100s of
             ms, link-dependent) dwarfs small-model compute by orders of
-            magnitude.  Returns per-step scores [num_epochs, NB] so
-            listeners replay exactly."""
+            magnitude.  Returns per-step scores AND guard skip flags,
+            each [num_epochs, NB], so listeners replay exactly and the
+            host books skipped steps with one sync at the end."""
             def epoch_body(carry, _):
                 return _epoch_scan(carry, xs, ys, key)
 
-            (params, ustate, _), scores = lax.scan(
+            (params, ustate, _), (scores, skips) = lax.scan(
                 epoch_body, (params, ustate, it0), None, length=num_epochs)
-            return params, ustate, scores
+            return params, ustate, scores, skips
 
         train_epochs = compile_cache.cached_jit(
             train_epochs, label="multilayer.train_epochs",
@@ -498,33 +512,48 @@ class MultiLayerNetwork:
         if uniform:
             xs = jnp.stack([jnp.asarray(b.features) for b in batches])
             ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
-            params, ustate, scores = train_epochs(
+            params, ustate, scores, skips = train_epochs(
                 params, ustate, xs, ys, run_key, it, num_epochs)
+            self._note_skips(skips)
             if self.listeners:
                 for j, s in enumerate(np.asarray(scores).ravel()):
                     for ls in self.listeners:
                         ls.iteration_done(self, it + j, float(s))
             it += num_epochs * len(batches)
         else:
+            skips = []
             for epoch in range(num_epochs):
                 for batch in batches:
                     params, ustate, it = self._step_and_notify(
-                        train_step, params, ustate, batch, run_key, it)
+                        train_step, params, ustate, batch, run_key, it,
+                        skips)
+            self._note_skips(skips)
         self.params = params
 
     def _step_and_notify(self, train_step, params, ustate, batch,
-                         run_key, step):
+                         run_key, step, skips=None):
         """One train_step dispatch + listener replay — shared by the
         per-step fit_backprop branch and fit_iterator so the two
-        streaming paths can't drift."""
-        params, ustate, score = train_step(
+        streaming paths can't drift.  The guard's skip flag lands in
+        ``skips`` as a DEVICE scalar (summed once at fit end) so the hot
+        path never adds a host sync."""
+        params, ustate, score, skipped = train_step(
             params, ustate, batch.features, batch.labels, run_key, step)
+        if skips is not None:
+            skips.append(skipped)
         # float(score) synchronizes host<->device; only pay for it when
         # someone is listening
         if self.listeners:
             for ls in self.listeners:
                 ls.iteration_done(self, step, float(score))
         return params, ustate, step + 1
+
+    @staticmethod
+    def _note_skips(skips) -> None:
+        """Book guard-skipped steps — ONE device sync per fit (skips is
+        either the scanned [E, NB] flag array or a list of per-step
+        device scalars); shared impl in runtime/resilience.py."""
+        resilience.note_skips(skips, where="multilayer")
 
     def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2) -> None:
         """STREAMING supervised backprop straight from a
@@ -555,11 +584,14 @@ class MultiLayerNetwork:
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         run_key = jax.random.key(seed)
         step = 0
+        skips = []
         for _ in range(num_epochs):
             it.reset()
             while it.has_next():
                 params, ustate, step = self._step_and_notify(
-                    train_step, params, ustate, it.next(), run_key, step)
+                    train_step, params, ustate, it.next(), run_key, step,
+                    skips)
+        self._note_skips(skips)
         self.params = params
 
     # -- fit (fit:918 parity: pretrain -> finetune -> optional backprop) ---

@@ -448,7 +448,7 @@ def make_flash_attn(mesh):
     Falls back to plain XLA attention off-TPU, under sequence parallelism
     (ring attention owns that axis), or for unaligned shapes.
     """
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deeplearning4j_tpu.models import transformer as tfm

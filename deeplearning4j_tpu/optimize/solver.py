@@ -33,7 +33,7 @@ from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.optimize.terminations import (
     EpsTermination, InvalidScore, TerminationCondition, ZeroDirection,
 )
-from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime import compile_cache, resilience
 
 log = logging.getLogger(__name__)
 
@@ -77,6 +77,12 @@ class BaseOptimizer:
     def _should_stop(self, new: float, old: float, gnorm: float) -> bool:
         return any(t.terminate(new, old, gnorm) for t in self.terminations)
 
+    @staticmethod
+    def _note_skips(skips) -> None:
+        """Book guard-skipped solver steps (ONE sync at optimize() end,
+        never per iteration); shared impl in runtime/resilience.py."""
+        resilience.note_skips(skips, where="solver")
+
 
 class GradientDescentOptimizer(BaseOptimizer):
     """SGD with the reference's GradientAdjustment chain
@@ -94,11 +100,16 @@ class GradientDescentOptimizer(BaseOptimizer):
 
         def step(params, ustate, key, iteration):
             score, grads = objective.value_and_grad(params, key)
-            updates, ustate = self.updater.update(
+            updates, new_ustate = self.updater.update(
                 ustate, grads, params, iteration, objective.batch_size)
-            params = apply_updates(params, updates)
+            # in-step anomaly guard: a non-finite score/gradient drops
+            # the update (params AND optimizer state) and raises the
+            # skip flag — same XLA program on the healthy path
+            new_params, new_ustate, skipped = resilience.guard_update(
+                params, ustate, apply_updates(params, updates),
+                new_ustate, (score, grads))
             gnorm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree.leaves(grads)))
-            return params, ustate, score, gnorm
+            return new_params, new_ustate, score, gnorm, skipped
 
         # params/ustate update in place on device (donated); optimize()
         # copies on entry so caller-held arrays survive.  No engine key:
@@ -112,14 +123,18 @@ class GradientDescentOptimizer(BaseOptimizer):
         params = jax.tree.map(jnp.copy, params)
         ustate = self.updater.init(params)
         old_score = float("inf")
+        skips = []
         for i in range(self.conf.num_iterations):
             key, sub = jax.random.split(key)
-            params, ustate, score, gnorm = self._step(params, ustate, sub, i)
+            params, ustate, score, gnorm, skipped = self._step(
+                params, ustate, sub, i)
+            skips.append(skipped)
             score = float(score)
             self._notify(i, score)
             if self._should_stop(score, old_score, float(gnorm)):
                 break
             old_score = score
+        self._note_skips(skips)
         return params
 
 
@@ -146,7 +161,11 @@ class LineSearchGradientDescent(BaseOptimizer):
             t, f_new = backtrack_line_search(
                 lambda x: flat_value(x, key), flat, d, score, slope,
                 initial_step=self.conf.lr)
-            return flat + t * d, f_new, jnp.linalg.norm(g)
+            flat_new = flat + t * d
+            # guard: a non-finite step result keeps the incoming iterate
+            ok = resilience.tree_all_finite((f_new, flat_new))
+            return (jnp.where(ok, flat_new, flat), f_new,
+                    jnp.linalg.norm(g), (~ok).astype(jnp.int32))
 
         # flat is born fresh from pack_params (a new buffer) and threaded
         # through the loop — donating it is always safe, no entry copy
@@ -159,14 +178,17 @@ class LineSearchGradientDescent(BaseOptimizer):
             self._build(template)
         flat = pack_params(params)
         old_score = float("inf")
+        skips = []
         for i in range(self.conf.num_iterations):
             key, sub = jax.random.split(key)
-            flat, score, gnorm = self._step(flat, sub)
+            flat, score, gnorm, skipped = self._step(flat, sub)
+            skips.append(skipped)
             score = float(score)
             self._notify(i, score)
             if self._should_stop(score, old_score, float(gnorm)):
                 break
             old_score = score
+        self._note_skips(skips)
         return unpack_params(flat, template)
 
 
@@ -204,7 +226,15 @@ class ConjugateGradientOptimizer(BaseOptimizer):
             t, f_new = backtrack_line_search(
                 lambda x: flat_value(x, key), flat, d_new, f0, slope,
                 initial_step=self.conf.lr)
-            return flat + t * d_new, g, d_new, f_new, jnp.linalg.norm(g)
+            flat_new = flat + t * d_new
+            # guard: drop the whole CG state transition on non-finites —
+            # a NaN gradient would otherwise poison beta/d for every
+            # later iteration even after the loss recovers
+            ok = resilience.tree_all_finite((f_new, flat_new, g))
+            return (jnp.where(ok, flat_new, flat),
+                    jnp.where(ok, g, g_prev),
+                    jnp.where(ok, d_new, d), f_new, jnp.linalg.norm(g),
+                    (~ok).astype(jnp.int32))
 
         # flat/g_prev/d are all loop-threaded packed vectors born fresh
         # in optimize() — donate the whole CG state
@@ -219,14 +249,17 @@ class ConjugateGradientOptimizer(BaseOptimizer):
         g = jnp.zeros_like(flat)
         d = jnp.zeros_like(flat)
         old_score = float("inf")
+        skips = []
         for i in range(self.conf.num_iterations):
             key, sub = jax.random.split(key)
-            flat, g, d, score, gnorm = self._step(flat, g, d, sub)
+            flat, g, d, score, gnorm, skipped = self._step(flat, g, d, sub)
+            skips.append(skipped)
             score = float(score)
             self._notify(i, score)
             if self._should_stop(score, old_score, float(gnorm)):
                 break
             old_score = score
+        self._note_skips(skips)
         return unpack_params(flat, template)
 
 
@@ -302,9 +335,16 @@ class LBFGSOptimizer(BaseOptimizer):
                 Y = jnp.roll(Y, -1, axis=0).at[m - 1].set(y)
                 rho = jnp.roll(rho, -1).at[m - 1].set(1.0 / (sy + 1e-30))
                 return S, Y, rho, jnp.minimum(count + 1, m)
+            # guard BEFORE the ring-buffer append: a non-finite step keeps
+            # the incoming iterate and history untouched (the sy>1e-10
+            # cond already refuses NaN curvature pairs, but flat/f would
+            # still be poisoned without this)
+            ok = resilience.tree_all_finite((f_new, flat_new, g_new))
+            do_append = jnp.logical_and(sy > 1e-10, ok)
             S, Y, rho, count = jax.lax.cond(
-                sy > 1e-10, append, lambda a: a, (S, Y, rho, count))
-            return flat_new, S, Y, rho, count, f_new, jnp.linalg.norm(g)
+                do_append, append, lambda a: a, (S, Y, rho, count))
+            return (jnp.where(ok, flat_new, flat), S, Y, rho, count,
+                    f_new, jnp.linalg.norm(g), (~ok).astype(jnp.int32))
 
         # the [m, n] history ring buffers are the big HBM tenants here —
         # donating them (plus flat and rho, all loop-threaded and born
@@ -323,15 +363,18 @@ class LBFGSOptimizer(BaseOptimizer):
         rho = jnp.zeros((self.m,), jnp.float32)
         count = jnp.int32(0)
         old_score = float("inf")
+        skips = []
         for i in range(self.conf.num_iterations):
             key, sub = jax.random.split(key)
-            flat, S, Y, rho, count, score, gnorm = self._step(
+            flat, S, Y, rho, count, score, gnorm, skipped = self._step(
                 flat, S, Y, rho, count, sub)
+            skips.append(skipped)
             score = float(score)
             self._notify(i, score)
             if self._should_stop(score, old_score, float(gnorm)):
                 break
             old_score = score
+        self._note_skips(skips)
         return unpack_params(flat, template)
 
 

@@ -13,8 +13,9 @@ tests and soak runs rather than only when something really breaks.
 - crashes (raise) with probability ``p_fail``;
 - stalls of ``stall_s`` seconds with probability ``p_stall`` (exercises
   the heartbeat/stale-reaper path when stalls exceed the reaper window);
-- result corruption hooks (``corrupt`` callable) for aggregator
-  hardening tests.
+- result corruption (the ``corrupt`` callable rewrites ``job.result``)
+  with probability ``p_corrupt`` — the end-to-end exercise for the
+  hardened aggregator's non-finite rejection path.
 
 Failures are drawn from a counter-based hash of (seed, worker calls), so
 a given seed produces the same fault schedule every run — flaky-test
@@ -49,11 +50,13 @@ class ChaosPerformer(so.WorkerPerformer):
 
     def __init__(self, inner: so.WorkerPerformer, *, p_fail: float = 0.0,
                  p_stall: float = 0.0, stall_s: float = 0.0,
+                 p_corrupt: float = 0.0,
                  corrupt: Optional[Callable] = None, seed: int = 0):
         self.inner = inner
         self.p_fail = p_fail
         self.p_stall = p_stall
         self.stall_s = stall_s
+        self.p_corrupt = p_corrupt
         self.corrupt = corrupt
         self.seed = seed
         self._calls = 0
@@ -77,8 +80,11 @@ class ChaosPerformer(so.WorkerPerformer):
             self.injected["stall"] += 1
             time.sleep(self.stall_s)
         self.inner.perform(job)
+        # p_corrupt gates the hook like the other faults (was a
+        # hardcoded 0.5 — corruption fired on half of all calls the
+        # moment a hook was supplied, with no way to tune the rate)
         if self.corrupt is not None \
-                and _hash01(self.seed + 2, n) < 0.5:
+                and _hash01(self.seed + 2, n) < self.p_corrupt:
             self.injected["corrupt"] += 1
             job.result = self.corrupt(job.result)
 
@@ -88,20 +94,29 @@ class ChaosPerformer(so.WorkerPerformer):
 
 def chaos_factory(inner_factory: Callable[[], so.WorkerPerformer], *,
                   p_fail: float = 0.0, p_stall: float = 0.0,
-                  stall_s: float = 0.0, seed: int = 0
+                  stall_s: float = 0.0, p_corrupt: float = 0.0,
+                  corrupt: Optional[Callable] = None, seed: int = 0
                   ) -> Callable[[], so.WorkerPerformer]:
     """Performer factory wrapper for ``DistributedRunner``: each worker
     gets its own ChaosPerformer with a distinct derived seed, so faults
-    are spread across workers but stay reproducible."""
+    are spread across workers but stay reproducible.  The returned
+    factory records every performer it makes on ``factory.instances`` so
+    soak tests can sum the per-worker ``injected`` counters afterwards."""
     counter = {"n": 0}
     lock = threading.Lock()
+    instances = []
 
     def make() -> ChaosPerformer:
         with lock:
             counter["n"] += 1
             worker_seed = seed + 1000 * counter["n"]
-        return ChaosPerformer(inner_factory(), p_fail=p_fail,
+        perf = ChaosPerformer(inner_factory(), p_fail=p_fail,
                               p_stall=p_stall, stall_s=stall_s,
+                              p_corrupt=p_corrupt, corrupt=corrupt,
                               seed=worker_seed)
+        with lock:
+            instances.append(perf)
+        return perf
 
+    make.instances = instances
     return make

@@ -184,6 +184,12 @@ class StateTracker:
         with self._lock:
             return bool(self._pending) or bool(self._jobs)
 
+    def pending_counts(self) -> tuple:
+        """(queued, in_flight) job counts — debuggability for timeout
+        and stall reporting (the master pump's error message)."""
+        with self._lock:
+            return len(self._pending), len(self._jobs)
+
     # -- current global state (the "parameter server" role) ----------------
     def set_current(self, value: Any) -> None:
         with self._lock:

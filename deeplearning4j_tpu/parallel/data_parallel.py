@@ -28,16 +28,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.compat import shard_map
 
 from deeplearning4j_tpu.ops.updaters import Dl4jUpdater, apply_updates
 from deeplearning4j_tpu.parallel import collectives
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
-from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime import compile_cache, resilience
 
 Array = jax.Array
 PyTree = Any
 LossFn = Callable[[PyTree, Array, Array, Array], Array]
+
+
+def _note_skips(skips) -> None:
+    """Book guard-skipped DP steps — one device sync per fit; shared
+    impl in runtime/resilience.py."""
+    resilience.note_skips(skips, where="data-parallel")
 
 
 class DataParallelTrainer:
@@ -63,14 +69,22 @@ class DataParallelTrainer:
                 params, x, y, shard_key)
             grads = collectives.grad_share(grads, DATA_AXIS)
             score = lax.pmean(score, DATA_AXIS)
-            updates, ustate = self.updater.update(ustate, grads, params, it, 1)
-            return apply_updates(params, updates), ustate, score
+            updates, new_ustate = self.updater.update(
+                ustate, grads, params, it, 1)
+            # in-step anomaly guard AFTER the collective: one shard's
+            # non-finite gradient poisons every replica's pmean, so the
+            # guard sees the shared grads/score and all replicas skip
+            # identically (no divergence).  Same XLA program either way.
+            new_params, new_ustate, skipped = resilience.guard_update(
+                params, ustate, apply_updates(params, updates),
+                new_ustate, (score, grads))
+            return new_params, new_ustate, score, skipped
 
         sharded = shard_map(
             step, mesh=mesh,
             in_specs=(param_spec, param_spec, batch_spec, batch_spec,
                       P(), P()),
-            out_specs=(param_spec, param_spec, P()),
+            out_specs=(param_spec, param_spec, P(), P()),
             check_vma=False,
         )
         # through the compile engine for the compile counters; no
@@ -98,11 +112,15 @@ class DataParallelTrainer:
         if self.donate:
             params = jax.tree.map(jnp.copy, params)
         ustate = self.init_state(params)
+        skips = []
         for it, (x, y) in enumerate(batches):
             key, sub = jax.random.split(key)
-            params, ustate, score = self.step(params, ustate, x, y, sub, it)
+            params, ustate, score, skipped = self.step(
+                params, ustate, x, y, sub, it)
+            skips.append(skipped)
             for ls in listeners:
                 ls.iteration_done(self, it, float(score))
+        _note_skips(skips)
         return params
 
 
@@ -130,15 +148,22 @@ class ParameterAveragingTrainer:
                 p, u = carry
                 k = jax.random.fold_in(shard_key, i)
                 score, grads = jax.value_and_grad(self.loss_fn)(p, x, y, k)
-                upd, u = self.updater.update(u, grads, p, it0 + i, 1)
-                return (apply_updates(p, upd), u), score
+                upd, new_u = self.updater.update(u, grads, p, it0 + i, 1)
+                # per-replica guard: this shard's bad batch skips ONLY
+                # its local update; the round's param_average then mixes
+                # the healthy replicas back in (self-healing averaging)
+                new_p, new_u, skipped = resilience.guard_update(
+                    p, u, apply_updates(p, upd), new_u, (score, grads))
+                return (new_p, new_u), (score, skipped)
 
-            (params, _), scores = lax.scan(
+            (params, _), (scores, skipped) = lax.scan(
                 local_step, (params, ustate), jnp.arange(self.local_steps))
             if self.average_each_round:
                 params = collectives.param_average(params, DATA_AXIS)
             score = lax.pmean(scores[-1], DATA_AXIS)
-            return jax.tree.map(lambda a: a[None], params), score
+            n_skipped = lax.psum(jnp.sum(skipped), DATA_AXIS)
+            return (jax.tree.map(lambda a: a[None], params), score,
+                    n_skipped)
 
         # the stacked [ndp, ...] replicas are the big HBM tenant here and
         # are loop-threaded (born fresh from the broadcast in fit) —
@@ -146,7 +171,7 @@ class ParameterAveragingTrainer:
         self._round = compile_cache.cached_jit(shard_map(
             round_fn, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(P(DATA_AXIS), P()),
+            out_specs=(P(DATA_AXIS), P(), P()),
             check_vma=False,
         ), label="parallel.param_avg_round", donate_argnums=(0,))
 
@@ -171,11 +196,15 @@ class ParameterAveragingTrainer:
             lambda a: jnp.broadcast_to(a[None], (self._ndp,) + a.shape),
             params)
         it = 0
+        skips = []
         for rnd, (x, y) in enumerate(batches):
             key, sub = jax.random.split(key)
-            stacked, score = self._round(stacked, x, y, sub, jnp.asarray(it))
+            stacked, score, n_skipped = self._round(
+                stacked, x, y, sub, jnp.asarray(it))
+            skips.append(n_skipped)
             it += self.local_steps
             for ls in listeners:
                 ls.iteration_done(self, rnd, float(score))
+        _note_skips(skips)
         stacked = self._final_avg(stacked)
         return jax.tree.map(lambda a: a[0], stacked)

@@ -87,7 +87,10 @@ class WorkerPerformer:
 
 class JobAggregator:
     """accumulate/aggregate (JobAggregator.java:30); ``reset`` starts a
-    fresh round for synchronous routers."""
+    fresh round for synchronous routers.  ``bind_tracker`` lets the
+    master pump hand the aggregator its StateTracker so rejections and
+    other aggregation events can land in the run's counters — a no-op
+    for aggregators that don't care."""
 
     def accumulate(self, job: Job) -> None:
         raise NotImplementedError
@@ -98,30 +101,68 @@ class JobAggregator:
     def reset(self) -> None:
         pass
 
+    def bind_tracker(self, tracker: StateTracker) -> None:
+        pass
+
 
 class WorkAccumulator(JobAggregator):
-    """Running average of numeric results (WorkAccumulator.java:29)."""
+    """Running average of numeric results (WorkAccumulator.java:29),
+    hardened: a posted result containing non-finite values — or one so
+    corrupt it cannot even be flattened — is REJECTED instead of averaged
+    (one NaN worker would otherwise poison the whole round's aggregate
+    and, through ``set_current``, every replica).  Rejections increment
+    the bound tracker's ``updates_rejected`` counter, the process-wide
+    ``resilience_metrics``, and ``self.rejected``."""
 
-    def __init__(self):
+    def __init__(self, tracker: Optional[StateTracker] = None):
         self._avg = None
         self._n = 0
+        self.tracker = tracker
+        #: how many posted results this accumulator refused
+        self.rejected = 0
+
+    def bind_tracker(self, tracker: StateTracker) -> None:
+        self.tracker = tracker
 
     def reset(self) -> None:
         self._avg = None
         self._n = 0
 
+    def _reject(self, job: Job, why: str) -> None:
+        from deeplearning4j_tpu.runtime.metrics import resilience_metrics
+
+        self.rejected += 1
+        resilience_metrics.note("updates_rejected")
+        if self.tracker is not None:
+            self.tracker.increment("updates_rejected")
+        log.warning("rejecting %s result from worker %r; excluded from "
+                    "the round average", why, job.worker_id)
+
     def accumulate(self, job: Job) -> None:
         import jax
 
+        from deeplearning4j_tpu.runtime.resilience import result_all_finite
+
         if job.result is None:
             return
-        self._n += 1
+        if not result_all_finite(job.result):
+            self._reject(job, "non-finite/corrupt")
+            return
         if self._avg is None:
+            self._n += 1
             self._avg = job.result
-        else:
-            n = self._n
-            self._avg = jax.tree.map(
+            return
+        try:
+            n = self._n + 1
+            avg = jax.tree.map(
                 lambda a, r: a + (r - a) / n, self._avg, job.result)
+        except Exception:  # noqa: BLE001
+            # a result whose SHAPE doesn't match the round (truncated
+            # payload, wrong pytree) is corruption too: reject it rather
+            # than crash the master pump mid-round
+            self._reject(job, "structurally-mismatched")
+            return
+        self._n, self._avg = n, avg
 
     def aggregate(self) -> Any:
         return self._avg
@@ -248,10 +289,20 @@ def master_pump(tracker: StateTracker, jobs: JobIterator,
     dynamically.  Synchronous routers REPLACE the current value with each
     round's aggregate (IterativeReduce); async routers fold updates in as
     they arrive (HogWild).
+
+    On timeout, completed-but-unpublished updates are drained and
+    published FIRST — hours of finished worker results must not be
+    discarded because the last job wedged — and the error carries the
+    queued/in-flight/worker counts for debuggability.
     """
     deadline = time.time() + timeout_s
     sync = router.synchronous_rounds
     round_jobs: List[Job] = []
+    # hand the aggregator the tracker so rejections land in the run's
+    # counters; duck-typed aggregators without the hook are fine
+    bind = getattr(aggregator, "bind_tracker", None)
+    if callable(bind):
+        bind(tracker)
 
     def publish(jobs_done: List[Job]) -> None:
         if not jobs_done:
@@ -289,7 +340,18 @@ def master_pump(tracker: StateTracker, jobs: JobIterator,
             break
         time.sleep(poll)
     else:
-        raise TimeoutError("distributed run did not finish")
+        # drain-and-publish completed updates BEFORE raising: partial
+        # progress stays in tracker.get_current() for the caller's
+        # post-mortem/checkpoint instead of being discarded
+        round_jobs.extend(tracker.drain_updates())
+        publish(round_jobs)
+        queued, in_flight = tracker.pending_counts()
+        raise TimeoutError(
+            f"distributed run did not finish within {timeout_s}s: "
+            f"{queued} queued + {in_flight} in-flight job(s), "
+            f"{len(tracker.workers())} live worker(s); "
+            f"{len(round_jobs)} completed update(s) were published — "
+            "partial aggregate preserved in tracker.get_current()")
     round_jobs.extend(tracker.drain_updates())
     publish(round_jobs)
     return tracker.get_current()

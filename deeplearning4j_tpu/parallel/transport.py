@@ -64,6 +64,7 @@ _TRACKER_METHODS = frozenset({
     "add_worker", "heartbeat", "heartbeats", "workers",
     "remove_stale_workers", "worker_enabled", "enable_worker",
     "add_job", "job_for", "clear_job", "requeue", "has_pending",
+    "pending_counts",
     "set_current", "get_current", "needs_replicate", "done_replicating",
     "add_update", "complete_job", "updates", "drain_updates",
     "increment", "count", "set_done", "is_done",
@@ -285,37 +286,73 @@ def _fix_child_platform() -> None:
         jax.config.update("jax_platforms", want)
 
 
+def _join_tracker(connection_string: str, worker_id: str,
+                  authkey: Optional[bytes], retries: int,
+                  backoff_s: float):
+    """Open both tracker connections and register, retrying with
+    exponential backoff.  A worker racing the master's listener bring-up
+    (or a transient network blip on a real cluster) must not be lost for
+    the whole run over one refused connect — the reference worker simply
+    dies there and YARN restarts it; retrying in-process is cheaper.
+    Returns (tracker, beat_tracker) or None when the budget is spent
+    (master genuinely gone — exit cleanly, the reaper handles the rest).
+    """
+    from deeplearning4j_tpu.runtime.metrics import resilience_metrics
+
+    for attempt in range(retries + 1):
+        tracker = None
+        try:
+            tracker = RemoteStateTracker(connection_string, authkey=authkey)
+            tracker.add_worker(worker_id)
+            # The heartbeat gets its OWN connection: the main loop's
+            # socket is held for a full RPC round-trip, so a large
+            # add_update (MLN params) would otherwise block heartbeats
+            # past the stale threshold and get a healthy worker reaped
+            # mid-report.
+            beat_tracker = RemoteStateTracker(connection_string,
+                                              authkey=authkey)
+            return tracker, beat_tracker
+        except (EOFError, ConnectionError, OSError) as exc:
+            if tracker is not None:
+                tracker.close()
+            if attempt >= retries:
+                log.warning("worker %s could not join %s after %d "
+                            "attempt(s) (%s); exiting", worker_id,
+                            connection_string, attempt + 1, exc)
+                return None
+            delay = backoff_s * (2 ** attempt)
+            resilience_metrics.note("worker_join_retries")
+            log.warning("worker %s join attempt %d/%d to %s failed "
+                        "(%s); retrying in %.2fs", worker_id, attempt + 1,
+                        retries + 1, connection_string, exc, delay)
+            time.sleep(delay)
+    return None
+
+
 def worker_main(connection_string: str, performer_spec: PerformerSpec,
                 worker_id: Optional[str] = None,
                 poll_interval_s: float = 0.01,
                 heartbeat_interval_s: Optional[float] = None,
-                authkey: Optional[bytes] = None) -> None:
+                authkey: Optional[bytes] = None,
+                join_retries: int = 4,
+                join_backoff_s: float = 0.25) -> None:
     """Run one worker process against a remote tracker until the master
     sets the done flag.  The loop is the reference's
     WorkerActor.checkJobAvailable:287 — poll ``job_for``, replicate
     current params if flagged, perform, ``add_update`` — plus the YARN
     worker's dedicated heartbeat thread so a long ``perform`` doesn't
     look stale, while a killed process stops heartbeating and gets its
-    job requeued by the master's reaper."""
+    job requeued by the master's reaper.  Joining retries with
+    exponential backoff (``join_retries`` × ``join_backoff_s``-doubling)
+    so a worker racing the master's bring-up isn't lost for the run."""
     _fix_child_platform()
     worker_id = worker_id or f"worker-{os.getpid()}"
     performer = resolve_performer_factory(performer_spec)()
-    try:
-        # BOTH connections and the registration RPC are join-time: any of
-        # them can lose the race against a finishing master — a late
-        # joiner must exit cleanly, not die with a traceback
-        tracker = RemoteStateTracker(connection_string, authkey=authkey)
-        tracker.add_worker(worker_id)
-        # The heartbeat gets its OWN connection: the main loop's socket
-        # is held for a full RPC round-trip, so a large add_update (MLN
-        # params) would otherwise block heartbeats past the stale
-        # threshold and get a healthy worker reaped mid-report.
-        beat_tracker = RemoteStateTracker(connection_string,
-                                          authkey=authkey)
-    except (EOFError, ConnectionError, OSError) as exc:
-        log.warning("worker %s could not join %s (%s); exiting",
-                    worker_id, connection_string, exc)
+    joined = _join_tracker(connection_string, worker_id, authkey,
+                           join_retries, join_backoff_s)
+    if joined is None:
         return
+    tracker, beat_tracker = joined
 
     if heartbeat_interval_s is None:
         heartbeat_interval_s = 0.25

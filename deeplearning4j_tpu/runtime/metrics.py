@@ -85,6 +85,51 @@ class CompileMetrics:
 #: process-wide singleton the compile engine reports into
 compile_metrics = CompileMetrics()
 
+
+class ResilienceMetrics:
+    """Process-wide counters for the self-healing layer
+    (runtime/resilience.py) — every fault the stack absorbed instead of
+    dying:
+
+    - ``steps_skipped``: train/solver steps whose update was dropped by
+      the in-step non-finite guard;
+    - ``spikes_detected`` / ``rollbacks`` / ``retry_budget_exceeded``:
+      loss-spike detector hits, checkpoint rollbacks performed, and runs
+      that exhausted the retry budget;
+    - ``checkpoints_saved``: auto-checkpoints written by ResilientFit;
+    - ``updates_rejected``: non-finite/corrupt worker results refused by
+      the hardened scaleout aggregator;
+    - ``worker_join_retries``: worker-join RPC attempts that had to back
+      off and retry.
+
+    Keys are open-ended (``note`` accepts any name) so new guard sites
+    don't need a schema change; ``snapshot`` returns a plain dict for
+    bench rows and soak assertions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters = {}
+
+    def note(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return self._counters.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+
+#: process-wide singleton every guard/rollback/rejection reports into
+resilience_metrics = ResilienceMetrics()
+
 # This import sits BELOW the compile counters on purpose: importing this
 # module can re-enter it through the
 # optimize/__init__ -> solver -> runtime.compile_cache cycle, and that

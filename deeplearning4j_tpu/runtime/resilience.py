@@ -1,0 +1,412 @@
+"""Self-healing training: in-step anomaly guards + checkpoint-rollback.
+
+The reference's fault story stops at the control plane — heartbeat
+reaping and job requeue (MasterActor.java:139-169, SURVEY.md §5.3).
+Nothing protects the *numerics* of a long run, which is where production
+TPU jobs actually die: one bad batch produces a non-finite gradient, the
+update writes NaN into every parameter, and hours of progress are gone
+before a human looks at the loss curve.  Large-scale systems treat
+detect-skip-rollback as a first-class training feature (TensorFlow's
+fault-tolerant loop, arXiv:1605.08695; the preemption-heavy TPU operating
+regime of arXiv:2605.25645); this module is that layer for the TPU port.
+
+Three levels of defense, cheapest first:
+
+1. **In-step guards** (device, zero extra dispatches): the donated
+   train/solver steps call :func:`tree_all_finite` on (loss, grads) and
+   :func:`where_ok`-select between the candidate update and the incoming
+   state — a skipped step is a no-op that returns a ``skipped`` flag
+   instead of silently propagating NaNs.  The select compiles into the
+   SAME XLA program as the step (no ``lax.cond`` branch explosion, no
+   extra compile on the steady-state path), and the guards run inside
+   steps already routed through ``runtime/compile_cache.cached_jit`` so
+   the stray-jit lint stays green and donation safety is untouched.
+2. **Host-side rollback** (:class:`ResilientFit`): periodic
+   auto-checkpoints of (params, updater state, step) through
+   ``runtime/checkpoint.CheckpointManager``, a windowed
+   :class:`LossSpikeDetector`, and on sustained anomaly a rollback to the
+   last-good checkpoint with the run key re-folded — the retry sees a
+   different batch order/noise stream — under a bounded retry budget
+   with exponential backoff.
+3. **Aggregation hardening** (host): :func:`result_all_finite` lets
+   ``parallel/scaleout.WorkAccumulator`` reject non-finite/corrupt worker
+   results instead of averaging them into the global params.
+
+Every skip/rollback/reject increments ``runtime.metrics
+.resilience_metrics`` so soak runs and ``bench.py`` rows carry the
+fault-handling evidence.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import statistics
+import time
+from typing import Any, Deque, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime.checkpoint import CheckpointManager
+from deeplearning4j_tpu.runtime.metrics import resilience_metrics
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# In-graph guards (used INSIDE jitted steps — pure jnp, no dispatches)
+# ---------------------------------------------------------------------------
+
+def tree_all_finite(tree: PyTree) -> jax.Array:
+    """Scalar bool: every inexact (float/complex) leaf is all-finite.
+
+    Integer/bool leaves are skipped — they cannot hold NaN/Inf and
+    ``isfinite`` on them is wasted work.  Safe under jit; the reduction
+    fuses into the surrounding step program."""
+    checks = [jnp.all(jnp.isfinite(leaf)) for leaf in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)]
+    if not checks:
+        return jnp.bool_(True)
+    ok = checks[0]
+    for c in checks[1:]:
+        ok = jnp.logical_and(ok, c)
+    return ok
+
+
+def where_ok(ok: jax.Array, new: PyTree, old: PyTree) -> PyTree:
+    """Select ``new`` where ``ok`` (scalar bool) else ``old``, leafwise.
+
+    This is the skip primitive: both trees are already materialized
+    inside the step, so the select is a cheap elementwise op in the same
+    program — unlike ``lax.cond``, it cannot introduce a second traced
+    branch, and it composes with buffer donation (XLA still aliases the
+    donated input into whichever value wins)."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def guard_update(params: PyTree, ustate: PyTree, new_params: PyTree,
+                 new_ustate: PyTree, *guard_values: PyTree):
+    """The full in-step guard: check ``guard_values`` (typically
+    ``(score, grads)``) for non-finites; on failure keep the incoming
+    params/updater-state.  Returns ``(params, ustate, skipped)`` where
+    ``skipped`` is an int32 scalar (1 = update dropped) so callers can
+    sum skip counts on device without a host sync per step."""
+    ok = tree_all_finite(guard_values)
+    return (where_ok(ok, new_params, params),
+            where_ok(ok, new_ustate, ustate),
+            (~ok).astype(jnp.int32))
+
+
+def note_skips(skips, where: str = "train") -> int:
+    """Book guard-skipped steps into ``resilience_metrics`` with ONE
+    device sync for a whole fit/optimize call.  ``skips`` is either a
+    list of per-step device scalars (streaming loops) or a flag array
+    (scan outputs); returns the count.  The single shared implementation
+    for every guarded loop — multilayer, solver, data-parallel, api."""
+    if skips is None:
+        return 0
+    if isinstance(skips, (list, tuple)):
+        if not skips:
+            return 0
+        skips = jnp.stack(list(skips))
+    n = int(jnp.sum(skips))
+    if n:
+        resilience_metrics.note("steps_skipped", n)
+        log.warning("non-finite loss/gradient: %d %s step update(s) "
+                    "skipped by the in-step guard", n, where)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Host-side checks (aggregation hardening, checkpoint validation)
+# ---------------------------------------------------------------------------
+
+def result_all_finite(result: PyTree) -> bool:
+    """Host-side: a worker-posted result is a NUMERIC pytree whose every
+    float leaf is finite.  Non-numeric leaves (strings, objects — a
+    wrong-typed or truncated payload) count as corrupt, as does anything
+    that fails to flatten or materialize: the caller averages results,
+    so its only safe move is rejection either way.  Checking the type
+    here (not just finiteness) matters for the FIRST result of a round —
+    there is no previous aggregate to structurally mismatch against, so
+    an unchecked corrupt first result would become the baseline that
+    rejects every later healthy one."""
+    try:
+        for leaf in jax.tree.leaves(result):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind not in "bifcu":
+                return False
+            if arr.dtype.kind in "fc" and not np.isfinite(arr).all():
+                return False
+        return True
+    except Exception:  # noqa: BLE001 — corrupt payloads throw anything
+        return False
+
+
+def compiled_all_finite(tree: PyTree) -> bool:
+    """Device-side all-finite reduction for HOST callers (e.g. validating
+    restored checkpoints without pulling every leaf to host).  Compiled
+    through the engine — instrument-only, no cross-instance key (the
+    input structure varies per caller)."""
+    fn = compile_cache.get_or_build(
+        ("resilience_all_finite",),
+        lambda: compile_cache.cached_jit(
+            tree_all_finite, label="resilience.all_finite"))
+    return bool(fn(tree))
+
+
+# ---------------------------------------------------------------------------
+# Loss-spike detection (host)
+# ---------------------------------------------------------------------------
+
+class LossSpikeDetector:
+    """Windowed anomaly detector over the per-step loss stream.
+
+    A step is *anomalous* when its loss is non-finite, or exceeds
+    ``factor ×`` the median of the last ``window`` healthy losses (median,
+    not mean — one spike must not drag the baseline up after itself).
+    ``observe`` returns True only after ``patience`` CONSECUTIVE
+    anomalies: transient bad batches are already neutralized by the
+    in-step guard, so rollback is reserved for sustained divergence.
+    The baseline needs ``min_history`` healthy samples before spikes can
+    fire at all (early-training loss is legitimately wild)."""
+
+    def __init__(self, window: int = 20, factor: float = 3.0,
+                 patience: int = 5, min_history: int = 5):
+        self.window = window
+        self.factor = factor
+        self.patience = patience
+        self.min_history = min_history
+        self._healthy: Deque[float] = collections.deque(maxlen=window)
+        self._streak = 0
+
+    def observe(self, loss: float) -> bool:
+        """Feed one step's loss; True == sustained anomaly (roll back)."""
+        anomalous = not np.isfinite(loss)
+        if (not anomalous and self._healthy
+                and len(self._healthy) >= self.min_history):
+            baseline = statistics.median(self._healthy)
+            # guard the degenerate all-zero baseline (|b| small): any
+            # loss is "a spike" relative to 0 — require an absolute
+            # floor so converged-to-zero runs don't false-positive
+            anomalous = loss > max(abs(baseline) * self.factor, 1e-12) \
+                and abs(baseline) > 0
+        if anomalous:
+            self._streak += 1
+            resilience_metrics.note("spikes_detected")
+        else:
+            self._streak = 0
+            self._healthy.append(loss)
+        return self._streak >= self.patience
+
+    def reset(self) -> None:
+        """Forget the streak AND the baseline — after a rollback the run
+        replays from an older loss regime; judging it against the
+        diverged window would re-trigger immediately."""
+        self._healthy.clear()
+        self._streak = 0
+
+
+# ---------------------------------------------------------------------------
+# ResilientFit — checkpoint-rollback training driver
+# ---------------------------------------------------------------------------
+
+class RetryBudgetExceeded(RuntimeError):
+    """Raised when sustained anomalies outlive the rollback budget —
+    the run is genuinely diverging (or its data is poisoned) and needs a
+    human, not another retry."""
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Knobs for :class:`ResilientFit` (README: "Self-healing training").
+
+    ``checkpoint_every`` is in steps; ``max_rollbacks`` bounds the retry
+    budget per fit call; ``backoff_s`` doubles per rollback.  ``resume``
+    continues from the newest checkpoint in ``checkpoint_dir`` (the
+    preemption-restart path); ``max_steps`` bounds how many steps THIS
+    invocation runs before checkpointing and returning (bounded-slice
+    training for preemptible capacity).  ``shuffle`` derives a
+    deterministic per-epoch batch order from the run key — which the
+    rollback path re-folds, so a retry sees different batch order."""
+
+    checkpoint_dir: str
+    checkpoint_every: int = 50
+    max_to_keep: int = 3
+    spike_window: int = 20
+    spike_factor: float = 3.0
+    patience: int = 5
+    min_history: int = 5
+    max_rollbacks: int = 3
+    backoff_s: float = 0.0
+    resume: bool = False
+    max_steps: Optional[int] = None
+    shuffle: bool = True
+
+
+class ResilientFit:
+    """Self-healing supervised training over a ``MultiLayerNetwork``-style
+    model: the streaming per-step loop of ``fit_backprop`` plus
+    auto-checkpointing, loss-spike detection, and rollback-with-refold.
+
+    The driver consumes the model's ENGINE step (``_backprop_machinery``)
+    directly, so the in-step guard, donation contract, and cross-network
+    compile sharing all apply unchanged; what it adds is host policy.
+    Checkpoints carry ``(params, updater state)`` plus step/rollback
+    counters in the sidecar meta, so a killed run resumes exactly —
+    tested to be step-for-step equivalent to an uninterrupted run.
+
+    ``detector`` is injectable for tests/soak harnesses; the default is
+    a :class:`LossSpikeDetector` built from the config."""
+
+    def __init__(self, net, config: ResilienceConfig,
+                 detector: Optional[LossSpikeDetector] = None):
+        self.net = net
+        self.config = config
+        self.manager = CheckpointManager(config.checkpoint_dir,
+                                         max_to_keep=config.max_to_keep)
+        self.detector = detector or LossSpikeDetector(
+            window=config.spike_window, factor=config.spike_factor,
+            patience=config.patience, min_history=config.min_history)
+        #: filled by fit(): total steps run, rollbacks performed
+        self.steps_run = 0
+        self.rollbacks = 0
+
+    @staticmethod
+    def _check_restored(params: PyTree, at_step) -> None:
+        """A rollback target or resume point must itself be healthy:
+        restoring a NaN-poisoned checkpoint would put the run in a state
+        no amount of retrying can heal (device-side check — one scalar
+        sync instead of pulling every restored leaf to host)."""
+        if not compiled_all_finite(params):
+            raise RuntimeError(
+                f"checkpoint at step {at_step} contains non-finite "
+                "params — refusing to restore a poisoned state")
+
+    # -- deterministic schedule -------------------------------------------
+    def _epoch_order(self, run_key, seed: int, rollbacks: int, epoch: int,
+                     n_batches: int) -> List[int]:
+        """Batch visit order for one epoch — a pure function of
+        (seed, rollbacks, epoch) so resume replays it exactly, while a
+        rollback (which bumps ``rollbacks``) reshuffles the retry.
+        Memoized per (seed, rollbacks, epoch): the driver asks once per
+        STEP, and a device permutation dispatch per step would be pure
+        waste.  ``seed`` must key the memo too — a second fit() on the
+        same driver with a different seed must not replay the old order."""
+        if not self.config.shuffle or n_batches <= 1:
+            return list(range(n_batches))
+        memo_key = (seed, rollbacks, epoch, n_batches)
+        if getattr(self, "_order_memo_key", None) != memo_key:
+            k = jax.random.fold_in(
+                jax.random.fold_in(run_key, 7 + rollbacks), epoch)
+            self._order_memo_key = memo_key
+            self._order_memo = [int(i)
+                                for i in jax.random.permutation(k, n_batches)]
+        return self._order_memo
+
+    # -- driver ------------------------------------------------------------
+    def fit(self, data, num_epochs: int = 1, seed: int = 2):
+        """Train to completion (or ``max_steps``), healing as it goes.
+        Returns the network with trained params set."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        cfg = self.config
+        net = self.net
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        n_batches = len(batches)
+        total_steps = num_epochs * n_batches
+
+        # donation guard: the engine step consumes its params/ustate
+        # buffers; copy once at this API boundary (same contract as
+        # fit_backprop)
+        params = jax.tree.map(jnp.copy, net._require_params())
+        train_step, _, updaters = net._backprop_machinery()
+        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        run_key = jax.random.key(seed)
+
+        step = 0
+        rollbacks = 0
+        if cfg.resume:
+            latest = self.manager.latest_step()
+            if latest is not None:
+                (params, ustate), meta = self.manager.restore(
+                    like=(params, ustate))
+                self._check_restored(params, latest)
+                step = int(meta["step"])
+                rollbacks = int(meta.get("rollbacks", 0))
+                log.info("resumed from checkpoint at step %d "
+                         "(rollbacks=%d)", step, rollbacks)
+
+        def save(at_step: int) -> None:
+            self.manager.save(at_step, (params, ustate),
+                              meta={"rollbacks": rollbacks})
+            resilience_metrics.note("checkpoints_saved")
+
+        if self.manager.latest_step() is None:
+            save(step)  # rollback target exists before the first cadence
+
+        last_good = self.manager.latest_step()
+        skips: List[jax.Array] = []
+        steps_this_call = 0
+
+        while step < total_steps:
+            if cfg.max_steps is not None \
+                    and steps_this_call >= cfg.max_steps:
+                save(step)   # bounded slice: persist exactly where we stop
+                break
+            epoch, pos = divmod(step, n_batches)
+            order = self._epoch_order(run_key, seed, rollbacks, epoch,
+                                      n_batches)
+            batch = batches[order[pos]]
+            # re-folded key: rollback bumps `rollbacks`, giving the retry
+            # a fresh noise stream on top of the reshuffled batch order
+            eff_key = jax.random.fold_in(run_key, rollbacks)
+            params, ustate, score, skipped = train_step(
+                params, ustate, batch.features, batch.labels, eff_key, step)
+            skips.append(skipped)
+            loss = float(score)
+            steps_this_call += 1
+            if net.listeners:
+                for ls in net.listeners:
+                    ls.iteration_done(net, step, loss)
+            if self.detector.observe(loss):
+                if rollbacks >= cfg.max_rollbacks:
+                    resilience_metrics.note("retry_budget_exceeded")
+                    raise RetryBudgetExceeded(
+                        f"loss anomaly survived {cfg.max_rollbacks} "
+                        f"rollbacks (last-good step {last_good}); "
+                        "refusing to burn more compute")
+                rollbacks += 1
+                resilience_metrics.note("rollbacks")
+                delay = cfg.backoff_s * (2 ** (rollbacks - 1))
+                log.warning(
+                    "sustained loss anomaly at step %d; rolling back to "
+                    "step %s (rollback %d/%d, backoff %.2fs)", step,
+                    last_good, rollbacks, cfg.max_rollbacks, delay)
+                if delay > 0:
+                    time.sleep(delay)
+                (params, ustate), meta = self.manager.restore(
+                    step=last_good,
+                    like=(jax.tree.map(jnp.copy, net._require_params()),
+                          [u.init(p) for u, p in
+                           zip(updaters, net._require_params())]))
+                self._check_restored(params, last_good)
+                step = int(last_good)
+                self.detector.reset()
+                continue
+            step += 1
+            if step % cfg.checkpoint_every == 0 and step < total_steps:
+                save(step)
+                last_good = step
+
+        note_skips(skips, where="resilient-fit")
+        self.steps_run = steps_this_call
+        self.rollbacks = rollbacks
+        net.params = params
+        return net

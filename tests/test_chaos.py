@@ -1,12 +1,18 @@
 """Fault injection (parallel/chaos.py): the recovery machinery exercised
-ON PURPOSE — crashes requeue, sums still complete, schedules replay."""
+ON PURPOSE — crashes requeue, sums still complete, schedules replay, and
+corrupt results bounce off the hardened aggregator instead of poisoning
+the round average."""
 
+import numpy as np
 import pytest
+
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.parallel import scaleout as so
 from deeplearning4j_tpu.parallel.chaos import (ChaosPerformer, InjectedFault,
                                                chaos_factory)
-from deeplearning4j_tpu.parallel.coordinator import Job
+from deeplearning4j_tpu.parallel.coordinator import Job, StateTracker
+from deeplearning4j_tpu.runtime.metrics import resilience_metrics
 
 
 class SumPerformer(so.WorkerPerformer):
@@ -73,3 +79,165 @@ def test_chaos_stall_fires():
     p.perform(job)
     assert job.result == 3
     assert p.injected["stall"] == 1
+
+
+# -- p_corrupt (satellite: was a hardcoded 0.5 gate) ------------------------
+
+def test_corrupt_hook_defaults_off():
+    """Supplying a corrupt hook must NOT fire it by default — the old
+    hardcoded <0.5 gate corrupted half of all calls the moment a hook
+    existed."""
+    p = ChaosPerformer(SumPerformer(), corrupt=lambda r: float("nan"),
+                       seed=2)
+    for i in range(20):
+        job = Job(work=[i])
+        p.perform(job)
+        assert job.result == i
+    assert p.injected["corrupt"] == 0
+
+
+def test_p_corrupt_gates_the_hook():
+    p = ChaosPerformer(SumPerformer(), p_corrupt=1.0,
+                       corrupt=lambda r: float("nan"), seed=2)
+    job = Job(work=[1, 2])
+    p.perform(job)
+    assert np.isnan(job.result)
+    assert p.injected["corrupt"] == 1
+
+
+# -- hardened aggregation ---------------------------------------------------
+
+class ArrayPerformer(so.WorkerPerformer):
+    """Result = a param-pytree (mean of the shard), like the real MLN
+    performers ship."""
+
+    def perform(self, job):
+        job.result = {"w": jnp.asarray(job.work, jnp.float32).mean()
+                      * jnp.ones(3)}
+
+
+def _nan_corrupt(result):
+    import jax
+
+    return jax.tree.map(lambda a: a * np.nan, result)
+
+
+def test_accumulator_rejects_nonfinite_and_counts():
+    resilience_metrics.reset()
+    tracker = StateTracker()
+    acc = so.WorkAccumulator()
+    acc.bind_tracker(tracker)
+    good = Job(work=None, worker_id="w0")
+    good.result = {"w": jnp.ones(3)}
+    bad = Job(work=None, worker_id="w1")
+    bad.result = {"w": jnp.array([1.0, np.nan, 2.0])}
+    acc.accumulate(good)
+    acc.accumulate(bad)
+    agg = acc.aggregate()
+    assert np.isfinite(np.asarray(agg["w"])).all()
+    np.testing.assert_array_equal(np.asarray(agg["w"]), 1.0)
+    assert acc.rejected == 1
+    assert tracker.count("updates_rejected") == 1
+    assert resilience_metrics.count("updates_rejected") == 1
+
+
+def test_accumulator_rejects_structural_mismatch():
+    acc = so.WorkAccumulator()
+    a = Job(work=None)
+    a.result = {"w": jnp.ones(3)}
+    b = Job(work=None)
+    b.result = "not a param tree at all"
+    acc.accumulate(a)
+    acc.accumulate(b)
+    np.testing.assert_array_equal(np.asarray(acc.aggregate()["w"]), 1.0)
+    assert acc.rejected == 1
+
+
+def test_accumulator_rejects_corrupt_first_result():
+    """Ordering must not matter: a corrupt FIRST result (non-numeric
+    payload before any aggregate exists to mismatch against) is rejected
+    too, so it can never become the baseline that rejects every later
+    healthy result."""
+    acc = so.WorkAccumulator()
+    bad = Job(work=None, worker_id="w0")
+    bad.result = "not a param tree at all"
+    good = Job(work=None, worker_id="w1")
+    good.result = {"w": jnp.ones(3)}
+    acc.accumulate(bad)
+    acc.accumulate(good)
+    assert acc.rejected == 1
+    np.testing.assert_array_equal(np.asarray(acc.aggregate()["w"]), 1.0)
+
+
+def test_corrupt_worker_result_rejected_end_to_end():
+    """Acceptance criterion: ChaosPerformer's corrupt hook NaNs worker
+    results mid-run; the hardened WorkAccumulator keeps the aggregate
+    finite and counts every rejection — no NaN poisoning of the round
+    average."""
+    resilience_metrics.reset()
+    shards = [[float(i), float(i + 1)] for i in range(0, 16, 2)]
+    factory = chaos_factory(ArrayPerformer, p_corrupt=0.5,
+                            corrupt=_nan_corrupt, seed=11)
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(shards), factory,
+        so.WorkAccumulator(), n_workers=2,
+        router_cls=so.HogWildWorkRouter)
+    agg = runner.run(timeout_s=60.0)
+    n_corrupt = sum(p.injected["corrupt"] for p in factory.instances)
+    assert n_corrupt >= 1, "chaos schedule never corrupted — tune seed"
+    assert agg is not None
+    assert np.isfinite(np.asarray(agg["w"])).all()
+    assert runner.tracker.count("updates_rejected") == n_corrupt
+    assert resilience_metrics.count("updates_rejected") >= n_corrupt
+
+
+# -- master_pump timeout (satellite: drain-and-publish first) ---------------
+
+def test_master_pump_timeout_publishes_partial_and_reports_counts():
+    """A wedged run must not discard completed updates: on timeout the
+    pump publishes what finished and the error message carries the
+    queued/in-flight/worker counts."""
+    tracker = StateTracker()
+    tracker.add_worker("w0")
+    # one completed update already posted, one job permanently stuck
+    done = Job(work=[1, 2], worker_id="w0")
+    done.result = 3
+    tracker.add_update("w0", done)
+    stuck = so.CollectionJobIterator([[9, 9]])
+    agg = SumAggregator()
+    router = so.IterativeReduceWorkRouter(tracker)
+    with pytest.raises(TimeoutError) as exc:
+        so.master_pump(tracker, stuck, agg, router,
+                       n_slots=lambda: 1, poll=0.01, timeout_s=0.3)
+    msg = str(exc.value)
+    assert "queued" in msg and "in-flight" in msg and "worker" in msg
+    # the completed update WAS published before raising
+    assert tracker.get_current() == 3
+
+
+# -- chaos soak (satellite): all faults at once, run still completes --------
+
+@pytest.mark.slow
+def test_chaos_soak_all_faults_completes_finite():
+    """Crash + stall + corrupt enabled simultaneously at high rates:
+    the run completes, the aggregate params are finite, and every fault
+    class actually fired (nonzero injected counters)."""
+    resilience_metrics.reset()
+    shards = [[float(i), float(i + 1), float(i + 2)]
+              for i in range(0, 60, 3)]
+    factory = chaos_factory(
+        ArrayPerformer, p_fail=0.2, p_stall=0.2, stall_s=0.02,
+        p_corrupt=0.3, corrupt=_nan_corrupt, seed=5)
+    runner = so.DistributedRunner(
+        so.CollectionJobIterator(shards), factory,
+        so.WorkAccumulator(), n_workers=3,
+        router_cls=so.HogWildWorkRouter, max_job_retries=100)
+    agg = runner.run(timeout_s=120.0)
+    injected = {k: sum(p.injected[k] for p in factory.instances)
+                for k in ("fail", "stall", "corrupt")}
+    assert all(v > 0 for v in injected.values()), injected
+    assert agg is not None
+    assert np.isfinite(np.asarray(agg["w"])).all()
+    assert runner.tracker.count("updates_rejected") == injected["corrupt"]
+    assert runner.tracker.count("jobs_failed") == injected["fail"]
+    assert runner.tracker.count("jobs_dropped") == 0

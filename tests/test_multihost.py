@@ -77,7 +77,7 @@ _RING_WORKER = textwrap.dedent("""
     sys.path.insert(0, {repo!r})
     import numpy as np
     import jax.numpy as jnp
-    from jax import shard_map
+    from deeplearning4j_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     from deeplearning4j_tpu.parallel.mesh import (
         MeshSpec, SEQ_AXIS, initialize_distributed, make_mesh)

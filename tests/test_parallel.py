@@ -84,7 +84,7 @@ def test_gradient_sharing_equals_single_device_math(devices):
     p_ref = jax.tree.map(lambda p, u: p - u, params, updates)
 
     ustate = trainer.init_state(params)
-    p_dist, _, score_dist = trainer.step(params, ustate, x, y, key, 0)
+    p_dist, _, score_dist, _ = trainer.step(params, ustate, x, y, key, 0)
 
     np.testing.assert_allclose(np.asarray(p_dist["W"]), np.asarray(p_ref["W"]),
                                rtol=1e-5, atol=1e-6)

@@ -1,0 +1,363 @@
+"""Self-healing training (runtime/resilience.py + the in-step guards
+wired through nn/multilayer.py and optimize/solver.py).
+
+Covers the acceptance criteria:
+- an injected non-finite gradient SKIPS that step's update (params stay
+  finite, training completes, ``steps_skipped`` counts it) and adds NO
+  extra XLA compiles on the steady-state path;
+- ResilientFit rolls back to the last-good checkpoint on sustained loss
+  anomaly, re-folds the RNG key, and enforces the retry budget;
+- resume-equivalence: kill mid-run, resume from the last checkpoint,
+  and the final (params, updater state, step) match an uninterrupted
+  run exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    LayerKind, NeuralNetConfiguration, OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.solver import Objective, Solver
+from deeplearning4j_tpu.runtime import compile_cache, resilience
+from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                resilience_metrics)
+from deeplearning4j_tpu.runtime.resilience import (
+    LossSpikeDetector, ResilienceConfig, ResilientFit, RetryBudgetExceeded,
+)
+
+
+def _fresh():
+    compile_cache.clear()
+    compile_metrics.reset()
+    resilience_metrics.reset()
+
+
+def _mlp_conf(lr=0.1):
+    return (NeuralNetConfiguration.builder()
+            .n_in(4).lr(lr).momentum(0.5).use_adagrad(False)
+            .num_iterations(5).activation("tanh")
+            .list(3).hidden_layer_sizes(8, 6)
+            .override(2, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent",
+                      dropout=0.0)
+            .pretrain(False).backward(True).build())
+
+
+def _batches(n_batches=4, n=16, poison=()):
+    rng = np.random.RandomState(0)
+    out = []
+    for b in range(n_batches):
+        x = rng.randn(n, 4).astype(np.float32)
+        if b in poison:
+            x[0, 0] = np.nan
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+        out.append(DataSet(jnp.asarray(x), jnp.asarray(y)))
+    return out
+
+
+# -- in-graph guard primitives ----------------------------------------------
+
+def test_tree_all_finite_flags_nan_inf_and_skips_int_leaves():
+    ok = resilience.tree_all_finite(
+        {"a": jnp.ones((3,)), "b": jnp.arange(4)})
+    assert bool(ok)
+    assert not bool(resilience.tree_all_finite(
+        {"a": jnp.array([1.0, np.nan])}))
+    assert not bool(resilience.tree_all_finite((jnp.float32(np.inf),)))
+    # int-only trees are vacuously finite
+    assert bool(resilience.tree_all_finite({"i": jnp.arange(3)}))
+
+
+def test_guard_update_selects_old_state_and_flags_skip():
+    p, u = {"w": jnp.ones(2)}, {"m": jnp.zeros(2)}
+    new_p, new_u = {"w": jnp.full(2, 9.0)}, {"m": jnp.full(2, 5.0)}
+    out_p, out_u, skipped = resilience.guard_update(
+        p, u, new_p, new_u, (jnp.float32(np.nan),))
+    assert int(skipped) == 1
+    np.testing.assert_array_equal(np.asarray(out_p["w"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(out_u["m"]), 0.0)
+    out_p, _, skipped = resilience.guard_update(
+        p, u, new_p, new_u, (jnp.float32(1.0),))
+    assert int(skipped) == 0
+    np.testing.assert_array_equal(np.asarray(out_p["w"]), 9.0)
+
+
+# -- acceptance: NaN batch skips, params stay finite, no extra compiles -----
+
+def test_nan_batch_skipped_per_step_path_no_extra_compiles():
+    """The headline criterion: a non-finite gradient at a chosen step
+    completes training, bumps steps_skipped, leaves every param finite,
+    and the guard adds no XLA compiles once the step is warm."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=1)
+    net.fit_backprop(_batches(1)[0], num_epochs=2)       # warmup/compile
+    warm = compile_metrics.snapshot()["compile_count"]
+    assert resilience_metrics.count("steps_skipped") == 0
+
+    before = np.asarray(net.params_flat()).copy()
+    poisoned = _batches(1, poison={0})[0]
+    net.fit_backprop(poisoned, num_epochs=3)             # 3 steps, all NaN
+    after = np.asarray(net.params_flat())
+
+    assert resilience_metrics.count("steps_skipped") == 3
+    assert np.isfinite(after).all()
+    # every update was skipped: params are EXACTLY the pre-fit ones
+    np.testing.assert_array_equal(before, after)
+    # same XLA program for skip and healthy paths — no new compiles
+    assert compile_metrics.snapshot()["compile_count"] == warm
+
+
+def test_nan_batch_skipped_scanned_epoch_path():
+    """The uniform-batch scan (train_epochs) carries the skip flags out
+    of the scan: one poisoned batch out of four -> exactly num_epochs
+    skips, everything else trains."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=2)
+    before = np.asarray(net.params_flat()).copy()
+    net.fit_backprop(_batches(4, poison={2}), num_epochs=2)
+    after = np.asarray(net.params_flat())
+    assert resilience_metrics.count("steps_skipped") == 2
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)   # healthy steps still applied
+
+
+def test_solver_gd_guard_keeps_params_finite():
+    """A Solver objective that always produces NaN grads: the guard
+    drops every update, so optimize() returns the (finite) initial
+    params instead of NaN-poisoned ones."""
+    _fresh()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).momentum(0.0).use_adagrad(False).num_iterations(4)
+            .optimization_algo(
+                OptimizationAlgorithm.GRADIENT_DESCENT).build())
+    params = {"w": jnp.ones((6,)) * 3.0}
+    obj = Objective(
+        value_and_grad=lambda p, k: (jnp.sum(p["w"]) * jnp.nan,
+                                     {"w": p["w"] * jnp.nan}),
+        value=lambda p, k: jnp.sum(p["w"]) * jnp.nan)
+    out = Solver(conf, obj).optimize(params, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(out["w"]), 3.0)
+    assert resilience_metrics.count("steps_skipped") >= 1
+
+
+# -- loss-spike detector ----------------------------------------------------
+
+def test_spike_detector_needs_sustained_anomaly():
+    det = LossSpikeDetector(window=8, factor=3.0, patience=3,
+                            min_history=3)
+    for _ in range(5):
+        assert not det.observe(1.0)
+    assert not det.observe(10.0)       # spike 1
+    assert not det.observe(np.nan)     # spike 2 (non-finite)
+    assert det.observe(50.0)           # spike 3 == patience -> fire
+    det.reset()
+    assert not det.observe(50.0)       # baseline forgotten after reset
+
+
+def test_spike_detector_empty_window_does_not_crash():
+    """min_history=0 with a finite first loss: no baseline yet means no
+    spike judgment — never a statistics error on the empty window."""
+    det = LossSpikeDetector(window=4, factor=3.0, patience=1,
+                            min_history=0)
+    assert not det.observe(1.0)
+    assert det.observe(np.nan)          # non-finite still fires
+
+
+def test_spike_detector_transients_do_not_fire():
+    det = LossSpikeDetector(window=8, factor=3.0, patience=2,
+                            min_history=3)
+    fired = False
+    for i in range(30):
+        loss = 20.0 if i % 5 == 4 else 1.0   # isolated spikes
+        fired = fired or det.observe(loss)
+    assert not fired
+
+
+# -- ResilientFit: rollback, retry budget, resume ---------------------------
+
+class _FireOnce(LossSpikeDetector):
+    """Stub detector: report one sustained anomaly at a chosen step."""
+
+    def __init__(self, at_step):
+        super().__init__()
+        self.at = at_step
+        self.calls = 0
+        self.fired = False
+
+    def observe(self, loss):
+        self.calls += 1
+        if not self.fired and self.calls == self.at:
+            self.fired = True
+            return True
+        return False
+
+
+def test_resilient_fit_rolls_back_and_completes(tmp_path):
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=3)
+    det = _FireOnce(at_step=7)
+    driver = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), checkpoint_every=3,
+        max_rollbacks=2), detector=det)
+    driver.fit(_batches(4), num_epochs=3, seed=5)
+    assert det.fired
+    assert driver.rollbacks == 1
+    assert resilience_metrics.count("rollbacks") == 1
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+    # checkpoints were written on the cadence
+    assert driver.manager.latest_step() is not None
+
+
+def test_resilient_fit_retry_budget_exhausts(tmp_path):
+    """Persistently-poisoned data: every retry replays the NaN batch,
+    the detector keeps firing, and after max_rollbacks the driver
+    raises instead of burning compute forever."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=4)
+    driver = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), checkpoint_every=100,
+        patience=1, min_history=0, max_rollbacks=2))
+    with pytest.raises(RetryBudgetExceeded):
+        driver.fit(_batches(4, poison={0, 1, 2, 3}), num_epochs=2, seed=6)
+    assert resilience_metrics.count("rollbacks") == 2
+    assert resilience_metrics.count("retry_budget_exceeded") == 1
+
+
+def test_resume_equivalence_params_ustate_and_step(tmp_path):
+    """Satellite criterion: fit N steps with auto-checkpointing, kill
+    mid-run (bounded slice), resume from the last checkpoint — final
+    params match an uninterrupted run bit-for-bit, and the step counter
+    continued where it stopped."""
+    _fresh()
+    batches = _batches(4)
+
+    def run(ckdir, max_steps=None, resume=False, seed=0):
+        net = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+        driver = ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=str(ckdir), checkpoint_every=3,
+            max_steps=max_steps, resume=resume))
+        driver.fit(batches, num_epochs=3, seed=7)   # 12 steps total
+        return net
+
+    net_a = run(tmp_path / "uninterrupted")
+
+    killed_dir = tmp_path / "killed"
+    run(killed_dir, max_steps=7)                    # "kill" after step 7
+    net_b = run(killed_dir, resume=True)            # resume to completion
+
+    np.testing.assert_array_equal(np.asarray(net_a.params_flat()),
+                                  np.asarray(net_b.params_flat()))
+
+
+def test_resume_restores_optimizer_state_exactly(tmp_path):
+    """The checkpoint carries updater state too: resuming replays the
+    remaining steps with EXACT momentum — momentum 0.5 makes any
+    reset-to-zero optimizer state diverge immediately, so bit-equality
+    of the final params proves the state survived the roundtrip."""
+    _fresh()
+    batches = _batches(3)
+
+    def run(ckdir, max_steps=None, resume=False):
+        net = MultiLayerNetwork(_mlp_conf(lr=0.2)).init(seed=11)
+        ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=str(ckdir), checkpoint_every=2,
+            max_steps=max_steps, resume=resume)).fit(
+                batches, num_epochs=4, seed=8)      # 12 steps
+        return net
+
+    full = run(tmp_path / "full")
+    part_dir = tmp_path / "part"
+    run(part_dir, max_steps=5)
+    resumed = run(part_dir, resume=True)
+    np.testing.assert_array_equal(np.asarray(full.params_flat()),
+                                  np.asarray(resumed.params_flat()))
+
+
+def test_resume_refuses_poisoned_checkpoint(tmp_path):
+    """A checkpoint holding non-finite params is a state no retry can
+    heal — restore (resume or rollback) must refuse it loudly rather
+    than continue training from NaNs."""
+    from deeplearning4j_tpu.runtime.checkpoint import CheckpointManager
+
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=13)
+    params = jax.tree.map(jnp.copy, net._require_params())
+    _, _, updaters = net._backprop_machinery()
+    ustate = [u.init(p) for u, p in zip(updaters, params)]
+    poisoned = jax.tree.map(lambda a: a * jnp.nan, params)
+    CheckpointManager(str(tmp_path)).save(4, (poisoned, ustate),
+                                          meta={"rollbacks": 0})
+    driver = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), resume=True))
+    with pytest.raises(RuntimeError, match="non-finite"):
+        driver.fit(_batches(4), num_epochs=2, seed=3)
+
+
+def test_second_fit_with_new_seed_reshuffles(tmp_path):
+    """The epoch-order memo must key on the seed: two fits on one driver
+    with different seeds see different batch orders."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=14)
+    driver = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), checkpoint_every=100))
+    def expected(seed):
+        k = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), 7),
+                               0)
+        return [int(i) for i in jax.random.permutation(k, 8)]
+
+    o1 = driver._epoch_order(jax.random.key(21), 21, 0, 0, 8)
+    assert o1 == expected(21)
+    # second fit, new seed: the memo from seed 21 must not leak
+    o2 = driver._epoch_order(jax.random.key(22), 22, 0, 0, 8)
+    assert o2 == expected(22)
+
+
+def test_resilient_fit_counts_skips(tmp_path):
+    """ResilientFit books guard skips like fit_backprop does — one
+    poisoned batch per epoch, patience high enough that no rollback
+    triggers, and the run still completes finite."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=12)
+    driver = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), checkpoint_every=100,
+        patience=10 ** 6))
+    driver.fit(_batches(4, poison={1}), num_epochs=2, seed=9)
+    assert resilience_metrics.count("steps_skipped") == 2
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+
+
+# -- host-side result validation -------------------------------------------
+
+def test_result_all_finite():
+    assert resilience.result_all_finite({"w": np.ones(3)})
+    assert not resilience.result_all_finite({"w": np.array([1.0, np.inf])})
+    assert not resilience.result_all_finite(
+        [np.ones(2), {"b": np.float32(np.nan)}])
+    # ints/bools can't be non-finite
+    assert resilience.result_all_finite({"n": np.arange(5)})
+    # non-numeric leaves are corruption (wrong-typed payload)
+    assert not resilience.result_all_finite("not a param tree at all")
+    assert not resilience.result_all_finite({"w": np.array(["a", "b"])})
+
+    class Evil:
+        """Flattening this (via np.asarray in the leaf check) raises."""
+
+        def __array__(self):
+            raise RuntimeError("corrupt payload")
+
+    assert not resilience.result_all_finite(Evil())
+
+
+def test_compiled_all_finite_routes_through_engine():
+    _fresh()
+    assert resilience.compiled_all_finite({"a": jnp.ones(4)})
+    assert not resilience.compiled_all_finite(
+        {"a": jnp.array([1.0, np.nan])})
+    snap = compile_metrics.snapshot()
+    assert "resilience.all_finite" in snap["traces"]

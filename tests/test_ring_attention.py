@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.compat import shard_map
 
 from deeplearning4j_tpu.models.transformer import attention
 from deeplearning4j_tpu.parallel.mesh import (MeshSpec, SEQ_AXIS, make_mesh)

@@ -257,3 +257,72 @@ def test_worker_joins_mid_run_and_shares_work(tmp_path):
     # the gated second half ran on the late joiner exclusively (the
     # original worker was disabled at gate-open)
     assert result.get("late-joiner", set()) >= {x * x for x in second}
+
+
+# -- worker-join retry with exponential backoff -----------------------------
+
+def test_worker_main_join_retry_gives_up_cleanly():
+    """No server at all: worker_main must exhaust its (tiny) retry
+    budget and RETURN — never raise — so a supervisor can restart it."""
+    import time
+
+    from deeplearning4j_tpu.runtime.metrics import resilience_metrics
+
+    resilience_metrics.reset()
+    t0 = time.perf_counter()
+    tp.worker_main("127.0.0.1:1", "transport_workloads:SquarePerformer",
+                   worker_id="orphan", join_retries=2,
+                   join_backoff_s=0.01)
+    assert time.perf_counter() - t0 < 30
+    assert resilience_metrics.count("worker_join_retries") == 2
+
+
+def test_worker_main_join_retry_wins_race_against_late_server():
+    """The master's listener comes up AFTER the worker's first connect
+    attempt: the backoff retry joins successfully and the worker drains
+    a job — the lost-to-one-refused-connect worker is recovered."""
+    import socket
+    import threading
+    import time
+
+    # reserve a port, then release it so the worker's first attempts fail
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    conn = f"127.0.0.1:{port}"
+    authkey = b"retry-test"
+
+    server_box = {}
+
+    def bring_up_late():
+        time.sleep(0.3)
+        server = tp.StateTrackerServer(host="127.0.0.1", port=port,
+                                       authkey=authkey).start()
+        server.tracker.add_job(Job(work=5.0))
+        server_box["server"] = server
+
+    t = threading.Thread(target=bring_up_late, daemon=True)
+    t.start()
+    worker = threading.Thread(
+        target=tp.worker_main,
+        args=(conn, "transport_workloads:SquarePerformer"),
+        kwargs={"worker_id": "retrier", "join_retries": 8,
+                "join_backoff_s": 0.1, "authkey": authkey},
+        daemon=True)
+    worker.start()
+    t.join(timeout=10)
+    server = server_box["server"]
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            updates = server.tracker.updates()
+            if updates:
+                break
+            time.sleep(0.02)
+        assert server.tracker.workers() == ["retrier"]
+        assert [u.result for u in server.tracker.updates()] == [25.0]
+    finally:
+        server.tracker.set_done()
+        worker.join(timeout=10)
+        server.shutdown()

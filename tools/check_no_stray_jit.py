@@ -24,7 +24,15 @@ import sys
 from typing import List
 
 #: package dirs whose every .py is a hot path routed through the engine
-SCOPES = ("deeplearning4j_tpu/nn", "deeplearning4j_tpu/optimize")
+#: (runtime/ added with the resilience layer: guard code that compiled
+#: outside the engine would silently re-charge every worker a compile
+#: AND hide the guard's compile count from the no-extra-compiles
+#: acceptance check)
+SCOPES = ("deeplearning4j_tpu/nn", "deeplearning4j_tpu/optimize",
+          "deeplearning4j_tpu/runtime")
+
+#: the one legitimate jax.jit call site: the engine implementation itself
+_EXEMPT = {"deeplearning4j_tpu/runtime/compile_cache.py"}
 
 #: jax callables that compile programs and must go through the engine
 _COMPILERS = {"jit", "pjit"}
@@ -36,6 +44,8 @@ def find_stray_jits(repo_root: pathlib.Path) -> List[str]:
     for scope in SCOPES:
         for path in sorted((repo_root / scope).rglob("*.py")):
             rel = path.relative_to(repo_root)
+            if str(rel).replace("\\", "/") in _EXEMPT:
+                continue
             tree = ast.parse(path.read_text(), filename=str(path))
             for node in ast.walk(tree):
                 if (isinstance(node, ast.Attribute)
@@ -64,7 +74,7 @@ def main() -> int:
         for f in findings:
             print("  " + f)
         return 1
-    print("ok: nn/ and optimize/ compile through the engine")
+    print("ok: nn/, optimize/, and runtime/ compile through the engine")
     return 0
 
 
