@@ -1035,6 +1035,113 @@ def bench_resilience(batch_size: int = 64, n_batches: int = 16,
     }
 
 
+def bench_serving(n_requests: int = 400, n_clients: int = 8,
+                  max_batch: int = 64):
+    """Inference serving row (serving/engine.py + serving/batcher.py):
+    a mixed-size request stream against the SAME network three ways —
+    (1) eager per-call baseline (the reference's op-by-op ``output``
+    path: raw feed_forward, one host sync per request), (2) the jitted
+    bucketed engine called directly, (3) the engine behind the
+    DynamicBatcher under ``n_clients`` concurrent client threads.
+    Reports rows/sec for each, p50/p99 request latency under concurrent
+    load, padding waste, and the acceptance evidence:
+    ``compile_delta`` — engine compiles during the measured traffic
+    after ``warmup()`` — which must be 0."""
+    import threading
+
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                    serving_metrics)
+    from deeplearning4j_tpu.serving import DynamicBatcher
+
+    platform, kind, n_dev = _platform_info()
+    if platform == "cpu":
+        n_requests = min(n_requests, 200)
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(128).lr(0.05).momentum(0.0).use_adagrad(False)
+            .num_iterations(1).activation("tanh")
+            .list(3).hidden_layer_sizes(256, 128)
+            .override(2, kind=LayerKind.OUTPUT, n_out=10,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    net = MultiLayerNetwork(conf).init(seed=0)
+    params = net.params
+
+    rng = np.random.RandomState(0)
+    sizes = rng.randint(1, max_batch + 1, size=n_requests)
+    reqs = [rng.randn(int(n), 128).astype(np.float32) for n in sizes]
+    total_rows = int(sizes.sum())
+
+    # -- eager per-call baseline (the pre-engine output() path) ------------
+    sample = reqs[:max(n_requests // 8, 16)]
+    t0 = time.perf_counter()
+    for r in sample:
+        _value_sync(net.feed_forward(params, r)[-1])
+    eager_s = time.perf_counter() - t0
+    eager_rps = sum(r.shape[0] for r in sample) / eager_s
+
+    # -- engine, direct ----------------------------------------------------
+    from deeplearning4j_tpu.serving.engine import default_buckets
+
+    eng = net.serving_engine(buckets=default_buckets(max_batch))
+    warm = eng.warmup(input_shape=(128,))
+    serving_metrics.reset()
+    before = compile_metrics.snapshot()["compile_count"]
+    t0 = time.perf_counter()
+    for r in reqs:
+        eng.infer(r, sync=True)
+    direct_s = time.perf_counter() - t0
+    direct_rps = total_rows / direct_s
+
+    # -- engine behind the DynamicBatcher, concurrent clients --------------
+    serving_metrics.reset()
+    per_client = [reqs[i::n_clients] for i in range(n_clients)]
+
+    def client(mine):
+        for r in mine:
+            bat.infer(r, timeout=120)
+
+    with DynamicBatcher(eng, max_batch_size=max_batch,
+                        max_delay_ms=2.0) as bat:
+        threads = [threading.Thread(target=client, args=(m,))
+                   for m in per_client]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batched_s = time.perf_counter() - t0
+    batched_rps = total_rows / batched_s
+    snap = serving_metrics.snapshot()
+    compile_delta = compile_metrics.snapshot()["compile_count"] - before
+
+    return {
+        "metric": "serving_engine_rows_per_sec_mixed_size_stream",
+        "value": round(max(direct_rps, batched_rps), 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(max(direct_rps, batched_rps) / eager_rps, 2),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"r{n_requests}_c{n_clients}_mb{max_batch}",
+        "eager_rows_per_sec": round(eager_rps, 1),
+        "engine_rows_per_sec": round(direct_rps, 1),
+        "batched_rows_per_sec": round(batched_rps, 1),
+        "throughput_vs_eager": round(direct_rps / eager_rps, 2),
+        "latency_p50_ms": snap["latency_p50_ms"],
+        "latency_p99_ms": snap["latency_p99_ms"],
+        "padding_waste_ratio": snap["padding_waste_ratio"],
+        "batches_formed": snap["batches_formed"],
+        "max_queue_depth": snap["max_queue_depth"],
+        "warmup": warm,
+        # acceptance: a sustained mixed-size stream after warmup() must
+        # cause ZERO new XLA compilations through the engine
+        "compile_delta": compile_delta,
+    }
+
+
 INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
          "scaling": bench_scaling, "w2v_dp": bench_w2v_dp,
@@ -1052,7 +1159,10 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "bert_T512b32": lambda: bench_bert(32, 512, 10),
          "resnet_s2d": lambda: bench_resnet(stem_s2d=True),
          # self-healing row: guarded-step rate + skip/ckpt evidence
-         "resilience": bench_resilience}
+         "resilience": bench_resilience,
+         # inference serving row: eager-vs-engine throughput, p50/p99
+         # under concurrent load, steady-state compile_delta == 0
+         "serving": bench_serving}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
@@ -1068,7 +1178,8 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             # fallback would just repeat the tiny-model bert row)
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
             "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
-            "resnet_s2d": (1800, 0), "resilience": (300, 240)}
+            "resnet_s2d": (1800, 0), "resilience": (300, 240),
+            "serving": (420, 300)}
 
 
 # -- perf-regression guard --------------------------------------------------
@@ -1195,6 +1306,57 @@ def run_config(name: str, tpu_ok: bool):
             "vs_baseline": None, **errors}
 
 
+#: a sweep bank (measure_tpu.bank_row) holds the state flock for well
+#: under a second; a lock file untouched for this long means its writer
+#: died mid-bank (or the file is a committed fossil) — break it rather
+#: than wait on a holder that will never release
+SWEEP_LOCK_STALE_S = 900.0
+
+
+def _read_sweep_state(path: str):
+    """Read TPU_SWEEP_STATE.json under its sidecar flock, breaking the
+    lock if it has gone stale.
+
+    Returns (state dict | None, stale_lock_broken).  The read itself is
+    safe even unlocked (bank_row replaces atomically), so a lock that
+    stays contended past the bounded wait degrades to a plain read —
+    this must never hang or fail a bench run."""
+    lock_path = path + ".lock"
+    stale_broken = False
+    try:
+        age = time.time() - os.path.getmtime(lock_path)
+        if age > SWEEP_LOCK_STALE_S:
+            os.unlink(lock_path)
+            stale_broken = True
+    except OSError:
+        pass  # no lock file (or raced away) — nothing to break
+    state = None
+    try:
+        import fcntl
+        # "r", never "a+"/"w": a READER must not create the sidecar —
+        # a reader-created lock would itself look stale 900 s later and
+        # pollute every future run with spurious break reports
+        with open(lock_path, "r") as lk:
+            for _ in range(20):          # bounded: ~2 s worst case
+                try:
+                    fcntl.flock(lk, fcntl.LOCK_SH | fcntl.LOCK_NB)
+                    break
+                except (BlockingIOError, OSError):
+                    time.sleep(0.1)
+            with open(path) as f:
+                state = json.load(f)
+    except (OSError, json.JSONDecodeError, ImportError):
+        # no lock file (nothing to coordinate with), or a contended/
+        # broken lock: plain read — bank_row replaces atomically, so an
+        # unlocked read still never sees a torn file
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            state = None
+    return state, stale_broken
+
+
 def _attach_sweep_evidence(out: dict) -> None:
     """Attach TPU rows banked by tools/measure_tpu.py to the output.
 
@@ -1206,11 +1368,14 @@ def _attach_sweep_evidence(out: dict) -> None:
     (mid-round, builder-run) rather than measured by this invocation."""
     here = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(here, "TPU_SWEEP_STATE.json")
+    state, stale_broken = _read_sweep_state(path)
+    if stale_broken:
+        out["sweep_stale_lock_broken"] = True
+    if state is None:
+        return
     try:
-        with open(path) as f:
-            state = json.load(f)
         mtime = os.path.getmtime(path)
-    except (OSError, json.JSONDecodeError):
+    except OSError:
         return
     rows = {k: v for k, v in state.items()
             if isinstance(v, dict) and v.get("platform") == "tpu"}
@@ -1363,8 +1528,8 @@ def main() -> None:
     headline = run_config("bert", tpu_ok)
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
-    names = ["lenet", "resnet", "longctx", "word2vec", "glove", "scaling",
-             "w2v_dp"]
+    names = ["serving", "lenet", "resnet", "longctx", "word2vec", "glove",
+             "scaling", "w2v_dp"]
     if tpu_ok:
         # tpu-only capability point LAST: if the suite budget runs out it
         # is the row sacrificed, never the production throughput metrics

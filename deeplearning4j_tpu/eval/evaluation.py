@@ -17,7 +17,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.serving.engine import (default_buckets, pad_rows,
+                                               pick_bucket)
+
 Array = jax.Array
+
+#: eval-batch bucket ladder: counts for any N are served by at most
+#: log2(max)+1 compiled programs per class count (larger sets chunk)
+EVAL_MAX_BUCKET = 8192
+_EVAL_BUCKETS = default_buckets(EVAL_MAX_BUCKET)
 
 
 class ConfusionMatrix:
@@ -49,9 +58,38 @@ class ConfusionMatrix:
         return f"ConfusionMatrix({self.num_classes} classes, n={self.total()})"
 
 
-@jax.jit
-def _confusion_counts(labels_1hot: Array, preds_1hot: Array) -> Array:
-    return labels_1hot.astype(jnp.float32).T @ preds_1hot.astype(jnp.float32)
+# ONE jitted on-device call for the whole accumulation — one-hot of the
+# argmax'ed guesses fused into the count matmul.  Routed through the
+# runtime compile engine (shared + counted) and shape-bucketed by the
+# caller: padded label rows are all-zero one-hots, so they contribute
+# nothing to any count regardless of what the padded guess rows argmax
+# to — the padded counts are exactly the unpadded counts.
+def _counts_kernel(labels_1hot: Array, guesses: Array) -> Array:
+    preds_1hot = jax.nn.one_hot(jnp.argmax(guesses, -1),
+                                labels_1hot.shape[-1])
+    return labels_1hot.astype(jnp.float32).T @ preds_1hot
+
+
+_confusion_counts = compile_cache.cached_jit(
+    _counts_kernel, key="eval.confusion_counts",
+    label="eval.confusion_counts")
+
+
+def _bucketed_counts(labels_1hot: np.ndarray,
+                     guesses: np.ndarray) -> np.ndarray:
+    """Pad the eval batch up the bucket ladder and accumulate counts
+    chunk by chunk — a fresh eval-set size never costs a new compile
+    once its bucket is traced."""
+    n, c = labels_1hot.shape
+    total = np.zeros((c, c), dtype=np.int64)
+    cap = _EVAL_BUCKETS[-1]
+    for i in range(0, max(n, 1), cap):
+        lab = labels_1hot[i:i + cap]
+        gs = guesses[i:i + cap]
+        b = pick_bucket(lab.shape[0], _EVAL_BUCKETS)
+        counts = _confusion_counts(pad_rows(lab, b), pad_rows(gs, b))
+        total += np.asarray(counts).astype(np.int64)
+    return total
 
 
 class Evaluation:
@@ -68,14 +106,28 @@ class Evaluation:
     # -- accumulation (eval:46 parity) -------------------------------------
     def eval(self, real_outcomes: Array, guesses: Array) -> None:
         """real_outcomes: one-hot [N, C] (or int labels [N]);
-        guesses: probabilities/one-hot [N, C]."""
-        real = jnp.asarray(real_outcomes)
-        guess = jnp.asarray(guesses)
+        guesses: probabilities/one-hot [N, C].
+
+        The whole batch accumulates in ONE jitted on-device call
+        (bucket-padded so repeated evals of varying sizes stay
+        compile-free); normalization to one-hot happens host-side where
+        it cannot cost a device compile per shape."""
+        real = np.asarray(real_outcomes)
+        guess = np.asarray(guesses)
         if real.ndim == 1:
-            real = jax.nn.one_hot(real.astype(jnp.int32), guess.shape[-1])
+            # one_hot semantics, host-side: out-of-range labels (e.g. a
+            # -1 ignore/padding label) become all-zero rows that count
+            # toward nothing — np.eye fancy-indexing would silently wrap
+            # negatives to class C-1 and crash on labels >= C
+            idx = real.astype(np.int64)
+            c = guess.shape[-1]
+            onehot = np.zeros((idx.shape[0], c), np.float32)
+            valid = (idx >= 0) & (idx < c)
+            onehot[np.nonzero(valid)[0], idx[valid]] = 1.0
+            real = onehot
         cm = self._ensure(real.shape[-1])
-        pred_1hot = jax.nn.one_hot(jnp.argmax(guess, -1), real.shape[-1])
-        cm.add_matrix(np.asarray(_confusion_counts(real, pred_1hot)))
+        cm.add_matrix(_bucketed_counts(real.astype(np.float32),
+                                       guess.astype(np.float32)))
 
     # -- per-class counters ------------------------------------------------
     def true_positives(self, i: int) -> int:

@@ -415,3 +415,20 @@ def synthetic_batch(key: Array, cfg: TransformerConfig, batch_size: int,
                  attention_mask=jnp.ones((batch_size, seq_len), jnp.float32),
                  type_ids=jnp.zeros((batch_size, seq_len), jnp.int32),
                  labels=labels, mlm_mask=mlm)
+
+
+def make_serving_apply(cfg: TransformerConfig):
+    """(apply_fn, cache_key) for serving/engine.InferenceEngine: token
+    ids [B, T] -> MLM logits [B, T, vocab] (full attention mask, single
+    segment — the plain fill-mask serving shape).  The cache_key ties
+    the engine entry to the exact config so replicas share one compile."""
+    def apply_fn(params, token_ids):
+        B, T = token_ids.shape
+        batch = Batch(token_ids=token_ids.astype(jnp.int32),
+                      attention_mask=jnp.ones((B, T), jnp.float32),
+                      type_ids=jnp.zeros((B, T), jnp.int32),
+                      labels=jnp.zeros((B, T), jnp.int32),
+                      mlm_mask=jnp.ones((B, T), jnp.float32))
+        return mlm_logits(cfg, params, forward_hidden(cfg, params, batch))
+
+    return apply_fn, ("bert_serving", repr(cfg))

@@ -240,3 +240,14 @@ def forward_logits(cfg: TransformerConfig, params: PyTree,
     """Dense (non-cached) forward for parity checks: [B, T] -> [B, T, V]."""
     hidden = tfm.encode(cfg, params, token_ids)
     return lm_logits(cfg, params, hidden)
+
+
+def make_serving_apply(cfg: TransformerConfig):
+    """(apply_fn, cache_key) for serving/engine.InferenceEngine: token
+    ids [B, T] -> next-token logits [B, T, vocab] via the dense forward
+    (scoring/classification serving; incremental generation keeps its
+    own KV-cache path in ``generate``)."""
+    def apply_fn(params, token_ids):
+        return forward_logits(cfg, params, token_ids.astype(jnp.int32))
+
+    return apply_fn, ("gpt_serving", repr(cfg))

@@ -53,3 +53,13 @@ def lenet(n_classes: int = 10, seed: int = 123,
                                        compute_dtype=compute_dtype))
     net.init(seed)
     return net
+
+
+def lenet_serving(net: MultiLayerNetwork, buckets=None,
+                  max_batch_size: int = 256):
+    """Warmed-up serving engine for a (trained) LeNet: pre-traces every
+    bucket on the MNIST input shape so the first real request is already
+    compile-free."""
+    eng = net.serving_engine(buckets=buckets, max_batch_size=max_batch_size)
+    eng.warmup(input_shape=(28, 28, 1))
+    return eng

@@ -343,3 +343,17 @@ def synthetic_batch(key: Array, cfg: ResNetConfig, batch: int,
 
 def param_count(params: PyTree) -> int:
     return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def make_serving_apply(cfg: ResNetConfig):
+    """(apply_fn, cache_key) for serving/engine.InferenceEngine: images
+    [B, H, W, 3] -> logits [B, n_classes], inference-mode BN (frozen
+    running stats — row-independent, so bucket padding is exact).  The
+    engine's ``params`` is the pair ``(params, batch_stats)`` so a
+    checkpoint swap replaces both together."""
+    def apply_fn(params_and_stats, x):
+        params, stats = params_and_stats
+        logits, _ = forward(cfg, params, stats, x, train=False)
+        return logits
+
+    return apply_fn, ("resnet_serving", repr(cfg))

@@ -77,6 +77,8 @@ class MultiLayerNetwork:
         # (runtime/compile_cache.py) keyed on the canonical conf JSON —
         # per-instance attrs here only memoize the engine lookup
         self._bp_cache = None
+        self._serving_cache = None
+        self._serving_engine_memo = None
 
     # -- wiring (init:325 parity) ------------------------------------------
     def _wire_layer_sizes(self) -> None:
@@ -156,16 +158,88 @@ class MultiLayerNetwork:
         return self.output_layer.loss(params[-1], h, labels)
 
     # -- inference (output:1147 / predict:1057 / score:1213) ---------------
+    # The reference serves these eagerly, op by op.  Here they route
+    # through the serving engine (serving/engine.py): ONE jitted forward
+    # per bucket in the ladder, shared across identically-configured
+    # networks via the runtime compile engine.  feed_forward stays the
+    # raw eager path (training internals + the bucketing-correctness
+    # reference in tests).
+
+    def _serving_machinery(self):
+        """(forward, scorer) jitted through the MODULE-LEVEL compile
+        engine, keyed on the canonical conf signature — same sharing
+        and detached-replica rules as ``_backprop_machinery``."""
+        if self._serving_cache is None:
+            self._serving_cache = compile_cache.get_or_build(
+                ("multilayer_serving", self._conf_signature()),
+                self._build_serving_machinery)
+        return self._serving_cache
+
+    def _build_serving_machinery(self):
+        # detached conf-rebuilt replica: the engine entry must neither
+        # pin this network nor retrace against later conf mutations
+        net = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(self._conf_signature()))
+
+        def forward(p, x):
+            return net.feed_forward(p, x)[-1]
+
+        def scorer(p, x, y):
+            return net.loss(p, x, y)
+
+        # the padded input buffer is engine-owned and fresh per dispatch
+        # — donating it reuses its HBM in place; params serve every
+        # request and are NOT donated
+        return (compile_cache.cached_jit(
+                    forward, label="serving.forward", donate_argnums=(1,)),
+                compile_cache.cached_jit(
+                    scorer, label="serving.score"))
+
+    def serving_engine(self, buckets: Optional[Sequence[int]] = None,
+                       max_batch_size: Optional[int] = None):
+        """The bucketed inference engine serving THIS network's live
+        params.  Default-configured engines are memoized per instance;
+        pass ``buckets``/``max_batch_size`` for a custom ladder (e.g.
+        before ``warmup()`` in a serving process)."""
+        from deeplearning4j_tpu.serving.engine import (DEFAULT_MAX_BATCH,
+                                                       InferenceEngine)
+        custom = buckets is not None or max_batch_size is not None
+        if not custom and self._serving_engine_memo is not None:
+            return self._serving_engine_memo
+        forward, _ = self._serving_machinery()
+        eng = InferenceEngine(
+            forward, params=self._require_params,
+            buckets=buckets,
+            max_batch_size=max_batch_size or DEFAULT_MAX_BATCH)
+        if not custom:
+            self._serving_engine_memo = eng
+        return eng
+
     def output(self, x: Array, params: Optional[Params] = None) -> Array:
-        params = params if params is not None else self._require_params()
-        return self.feed_forward(params, x)[-1]
+        if not hasattr(x, "ndim"):
+            x = jnp.asarray(x)
+        if x.ndim == 1:
+            # single unbatched example: no batch dim to bucket — raw
+            # eager forward keeps the reference's permissive signature
+            p = params if params is not None else self._require_params()
+            return self.feed_forward(p, x)[-1]
+        return self.serving_engine().infer(x, params=params)
 
     def predict(self, x: Array) -> Array:
         return jnp.argmax(self.output(x), axis=-1)
 
     def score(self, data: DataSet, params: Optional[Params] = None) -> float:
+        """Mean loss on ``data`` through ONE jitted program.
+
+        Compile contract: unlike ``output`` (bucket-padded — padding a
+        MEAN loss would change its value), the scorer specializes per
+        (features, labels) shape signature: first call per shape traces,
+        repeats are compile-free.  Score fixed-shape eval sets on hot
+        paths; a stream of ragged sizes belongs on ``output`` +
+        ``Evaluation`` (both bucketed)."""
         params = params if params is not None else self._require_params()
-        return float(self.loss(params, data.features, data.labels))
+        _, scorer = self._serving_machinery()
+        return float(scorer(params, data.features, data.labels))
 
     # -- pretrain (pretrain:144 parity) ------------------------------------
     def pretrain(self, data: Union[DataSet, Sequence[DataSet]],

@@ -130,6 +130,118 @@ class ResilienceMetrics:
 #: process-wide singleton every guard/rollback/rejection reports into
 resilience_metrics = ResilienceMetrics()
 
+
+class ServingMetrics:
+    """Process-wide counters for the inference serving engine
+    (serving/engine.py + serving/batcher.py):
+
+    - ``requests`` / ``rows``: client requests accepted and the example
+      rows they carried;
+    - ``dispatches`` / ``rows_padded``: bucketed device dispatches and
+      the TOTAL padded rows they ran (real + padding) — the
+      padding-waste ratio in ``snapshot`` is ``1 - rows/rows_padded``;
+    - ``batches_formed`` / ``requests_coalesced``: micro-batches the
+      DynamicBatcher flushed and the requests they merged;
+    - ``queue_depth`` / ``max_queue_depth``: live and high-water
+      batcher queue occupancy;
+    - request latency reservoir (bounded) -> ``latency_p50_ms`` /
+      ``latency_p99_ms`` in ``snapshot``;
+    - ``mark_compiles()`` banks the engine compile count so
+      ``snapshot()['compile_delta_since_mark']`` gives the steady-state
+      compile delta the acceptance criterion asserts to be zero after
+      ``warmup()``.
+    """
+
+    #: latency reservoir bound — serving runs forever; percentiles come
+    #: from the most recent window, not an unbounded list
+    MAX_LATENCIES = 8192
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.requests = 0
+            self.rows = 0
+            self.dispatches = 0
+            self.rows_padded = 0
+            self.batches_formed = 0
+            self.requests_coalesced = 0
+            self.queue_depth = 0
+            self.max_queue_depth = 0
+            self._latencies_ms: List[float] = []
+            self._compile_mark: Optional[int] = None
+
+    def note_request(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+
+    def note_dispatch(self, bucket_rows: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.rows_padded += bucket_rows
+
+    def note_batch(self, n_requests: int) -> None:
+        with self._lock:
+            self.batches_formed += 1
+            self.requests_coalesced += n_requests
+
+    def note_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+            self.max_queue_depth = max(self.max_queue_depth, depth)
+
+    def note_latency_ms(self, ms: float) -> None:
+        with self._lock:
+            self._latencies_ms.append(ms)
+            if len(self._latencies_ms) > self.MAX_LATENCIES:
+                del self._latencies_ms[:len(self._latencies_ms) // 2]
+
+    def mark_compiles(self) -> None:
+        """Bank the current engine compile count (call right after
+        ``warmup()``); later snapshots report the delta."""
+        with self._lock:
+            self._compile_mark = compile_metrics.snapshot()["compile_count"]
+
+    @staticmethod
+    def _pct(sorted_ms: List[float], q: float) -> Optional[float]:
+        if not sorted_ms:
+            return None
+        idx = min(int(q * len(sorted_ms)), len(sorted_ms) - 1)
+        return round(sorted_ms[idx], 3)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            waste = (1.0 - self.rows / self.rows_padded) \
+                if self.rows_padded else 0.0
+            out = {
+                "requests": self.requests,
+                "rows": self.rows,
+                "dispatches": self.dispatches,
+                "rows_padded": self.rows_padded,
+                "padding_waste_ratio": round(max(waste, 0.0), 4),
+                "batches_formed": self.batches_formed,
+                "requests_coalesced": self.requests_coalesced,
+                "queue_depth": self.queue_depth,
+                "max_queue_depth": self.max_queue_depth,
+                "latency_p50_ms": self._pct(lat, 0.50),
+                "latency_p99_ms": self._pct(lat, 0.99),
+                "latency_samples": len(lat),
+                "compile_mark": self._compile_mark,
+            }
+        if out["compile_mark"] is not None:
+            out["compile_delta_since_mark"] = (
+                compile_metrics.snapshot()["compile_count"]
+                - out["compile_mark"])
+        return out
+
+
+#: process-wide singleton the serving engine + batcher report into
+serving_metrics = ServingMetrics()
+
 # This import sits BELOW the compile counters on purpose: importing this
 # module can re-enter it through the
 # optimize/__init__ -> solver -> runtime.compile_cache cycle, and that

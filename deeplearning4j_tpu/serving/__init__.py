@@ -1,0 +1,20 @@
+"""TPU-native inference serving: jitted bucketed forward + dynamic
+micro-batching.
+
+- ``InferenceEngine`` (engine.py): donated, jitted forward through the
+  runtime compile engine, shape-bucketed so the compile count is bounded
+  by the bucket ladder, with AOT ``warmup()``.
+- ``DynamicBatcher`` (batcher.py): background coalescing of concurrent
+  requests into micro-batches under a max_batch_size / max_delay_ms
+  policy.
+
+``MultiLayerNetwork.output/predict/score`` and ``Evaluation.eval`` route
+through this layer; the per-model adapters live next to each model
+(``models/*.make_serving_apply``).  Metrics:
+``runtime.metrics.serving_metrics``.
+"""
+
+from deeplearning4j_tpu.serving.batcher import DynamicBatcher  # noqa: F401
+from deeplearning4j_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine, default_buckets, pad_rows, pick_bucket,
+)

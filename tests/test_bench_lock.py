@@ -1,0 +1,59 @@
+"""bench.py sweep-state lock handling: stale sidecar locks are detected
+and broken instead of hanging/failing the bench run (and the lock files
+are gitignored, not committed artifacts)."""
+
+import importlib.util
+import json
+import os
+import pathlib
+import time
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", REPO_ROOT / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stale_lock_is_broken_and_state_still_read(tmp_path):
+    bench = _bench()
+    state_path = tmp_path / "TPU_SWEEP_STATE.json"
+    lock_path = tmp_path / "TPU_SWEEP_STATE.json.lock"
+    state_path.write_text(json.dumps({"row": {"platform": "tpu",
+                                              "value": 1.0}}))
+    lock_path.write_text("")
+    stale = time.time() - bench.SWEEP_LOCK_STALE_S - 60
+    os.utime(lock_path, (stale, stale))
+
+    state, broken = bench._read_sweep_state(str(state_path))
+    assert broken is True
+    assert state == {"row": {"platform": "tpu", "value": 1.0}}
+
+
+def test_fresh_lock_is_left_alone(tmp_path):
+    bench = _bench()
+    state_path = tmp_path / "s.json"
+    lock_path = tmp_path / "s.json.lock"
+    state_path.write_text(json.dumps({"a": 1}))
+    lock_path.write_text("")
+
+    state, broken = bench._read_sweep_state(str(state_path))
+    assert broken is False
+    assert state == {"a": 1}
+    assert lock_path.exists()
+
+
+def test_missing_state_is_not_an_error(tmp_path):
+    bench = _bench()
+    state, broken = bench._read_sweep_state(str(tmp_path / "nope.json"))
+    assert state is None and broken is False
+
+
+def test_lock_files_are_gitignored_not_tracked():
+    gitignore = (REPO_ROOT / ".gitignore").read_text().splitlines()
+    assert "TPU_SWEEP_STATE.json.lock" in gitignore
+    assert "tools/tpu_sweep.lock" in gitignore

@@ -1,0 +1,463 @@
+"""Inference serving engine tests (serving/engine.py, serving/batcher.py,
+plus the rewired MultiLayerNetwork.output/predict/score and the bucketed
+Evaluation pipeline).
+
+Covers the acceptance criteria:
+- bucketing correctness: padded-batch outputs BIT-identical to unpadded
+  eager outputs across the bucket ladder;
+- warmup compile count == number of buckets, then a sustained mixed-size
+  request stream causes ZERO new engine compiles;
+- DynamicBatcher under concurrency: N threads submitting odd-sized
+  requests all get correct, correctly-ordered results; the max_delay
+  flush fires for a lone request.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                serving_metrics)
+from deeplearning4j_tpu.serving import (DynamicBatcher, InferenceEngine,
+                                        default_buckets, pick_bucket)
+
+
+def _fresh():
+    compile_cache.clear()
+    compile_metrics.reset()
+    serving_metrics.reset()
+
+
+def _mlp_conf(n_in=6, n_out=4, compute_dtype="float32"):
+    # float32 compute by default: the bit-identical-to-EAGER assertions
+    # below need it (under the bfloat16 default, XLA's jitted fusion
+    # legitimately rounds differently from the op-by-op eager chain;
+    # the bucketing property itself is dtype-independent — see
+    # test_bf16_padding_is_exact_within_the_compiled_program)
+    return (NeuralNetConfiguration.builder()
+            .n_in(n_in).lr(0.1).momentum(0.5).use_adagrad(False)
+            .num_iterations(1).activation("tanh")
+            .compute_dtype(compute_dtype)
+            .list(3).hidden_layer_sizes(12, 8)
+            .override(2, kind=LayerKind.OUTPUT, n_out=n_out,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+
+
+def _serving_traces(label="serving.forward"):
+    return compile_metrics.snapshot()["traces"].get(label, 0)
+
+
+# -- ladder helpers ---------------------------------------------------------
+
+def test_default_buckets_and_pick():
+    assert default_buckets(8) == (1, 2, 4, 8)
+    assert default_buckets(5) == (1, 2, 4, 8)
+    assert pick_bucket(1, (2, 4)) == 2
+    assert pick_bucket(3, (2, 4)) == 4
+    with pytest.raises(ValueError):
+        pick_bucket(5, (2, 4))
+
+
+# -- bucketing correctness (satellite) --------------------------------------
+
+def test_padded_outputs_bit_identical_to_eager_across_ladder():
+    """For every size across the ladder (and between bucket edges), the
+    engine's pad->forward->slice result equals the raw eager
+    feed_forward on the unpadded batch EXACTLY — per-example row
+    independence means padding can't perturb real rows."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=1)
+    eng = net.serving_engine(buckets=(2, 4, 8, 16))
+    rng = np.random.RandomState(0)
+    for n in (1, 2, 3, 4, 5, 7, 8, 9, 13, 16):
+        x = rng.randn(n, 6).astype(np.float32)
+        got = np.asarray(eng.infer(x))
+        ref = np.asarray(net.feed_forward(net.params, x)[-1])
+        assert got.shape == ref.shape == (n, 4)
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_bf16_padding_is_exact_within_the_compiled_program():
+    """The bucketing property under the DEFAULT (bfloat16) compute
+    dtype, stated platform-robustly: a prefix batch padded up to bucket
+    B runs the SAME compiled program as a full bucket-B batch, so its
+    rows must be BIT-identical to the corresponding rows of the full
+    batch.  (Against the op-by-op EAGER chain, reduced-precision jitted
+    fusion may legitimately differ at rounding level — that comparison
+    is only made under float32, above.)"""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf(compute_dtype="bfloat16")).init(seed=20)
+    eng = net.serving_engine(buckets=(8,))
+    x = np.random.RandomState(14).randn(8, 6).astype(np.float32)
+    full = np.asarray(eng.infer(x))
+    for n in (1, 3, 5, 7):
+        got = np.asarray(eng.infer(x[:n]))   # pads back up to bucket 8
+        np.testing.assert_array_equal(got, full[:n])
+
+
+def test_chunking_above_the_ladder_is_exact():
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=2)
+    eng = net.serving_engine(buckets=(2, 4))
+    x = np.random.RandomState(1).randn(11, 6).astype(np.float32)
+    got = np.asarray(eng.infer(x))        # 11 -> 4 + 4 + 3(pad to 4)
+    ref = np.asarray(net.feed_forward(net.params, x)[-1])
+    np.testing.assert_array_equal(got, ref)
+
+
+# -- warmup / steady-state compile delta (satellite + acceptance) -----------
+
+def test_warmup_compiles_once_per_bucket_then_stream_is_compile_free():
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=3)
+    eng = net.serving_engine(buckets=(1, 2, 4, 8, 16, 32))
+    warm = eng.warmup(input_shape=(6,))
+    assert warm["buckets"] == 6
+    assert warm["compiles"] == 6, warm          # one trace per bucket
+    assert _serving_traces() == 6
+
+    # sustained mixed-size stream: every size <= 32 lands in a warmed
+    # bucket; larger requests chunk by the largest bucket — zero new
+    # compiles through the engine
+    serving_metrics.mark_compiles()
+    rng = np.random.RandomState(7)
+    for n in rng.randint(1, 80, size=60):
+        eng.infer(rng.randn(int(n), 6).astype(np.float32))
+    assert _serving_traces() == 6
+    snap = serving_metrics.snapshot()
+    assert snap["compile_delta_since_mark"] == 0, snap
+    assert snap["padding_waste_ratio"] < 1.0
+    assert snap["latency_p50_ms"] is not None
+    assert snap["latency_p99_ms"] >= snap["latency_p50_ms"]
+
+
+def test_identical_networks_share_one_serving_compile():
+    """Same cross-network contract as the training engine: a second
+    identically-configured network's engine reuses the jitted forward —
+    its warmup performs zero new traces."""
+    _fresh()
+    net1 = MultiLayerNetwork(_mlp_conf()).init(seed=4)
+    net2 = MultiLayerNetwork(_mlp_conf()).init(seed=5)
+    eng1 = net1.serving_engine(buckets=(2, 4))
+    eng2 = net2.serving_engine(buckets=(2, 4))
+    assert eng1.warmup(input_shape=(6,))["compiles"] == 2
+    assert eng2.warmup(input_shape=(6,))["compiles"] == 0
+    assert _serving_traces() == 2
+    # ...while each serves its OWN params
+    x = np.ones((3, 6), np.float32)
+    assert not np.array_equal(np.asarray(eng1.infer(x)),
+                              np.asarray(eng2.infer(x)))
+
+
+def test_infer_never_donates_caller_buffers():
+    """infer() normalizes to host numpy and pads into an engine-owned
+    buffer, so a caller-held device array stays readable afterwards even
+    though the jitted forward donates its input argument."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=6)
+    eng = net.serving_engine(buckets=(4,))
+    x_dev = jnp.asarray(np.random.RandomState(2).randn(4, 6)
+                        .astype(np.float32))
+    eng.infer(x_dev)
+    eng.infer(x_dev)                      # exact-bucket size twice
+    np.asarray(x_dev)                     # raises if donated
+
+
+# -- rewired MultiLayerNetwork entry points ---------------------------------
+
+def test_output_predict_score_route_through_serving_engine():
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=7)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(5, 6).astype(np.float32))
+    out = net.output(x)
+    assert out.shape == (5, 4)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(net.feed_forward(net.params, x)[-1]))
+    assert net.predict(x).shape == (5,)
+    ds = DataSet(x, jnp.asarray(np.eye(4, dtype=np.float32)[
+        rng.randint(0, 4, 5)]))
+    s = net.score(ds)
+    assert np.isfinite(s) and s > 0
+    traces = compile_metrics.snapshot()["traces"]
+    assert traces.get("serving.forward", 0) >= 1, traces
+    assert traces.get("serving.score", 0) == 1, traces
+    # repeated same-shape score calls reuse the one compile
+    net.score(ds)
+    assert compile_metrics.snapshot()["traces"]["serving.score"] == 1
+
+
+def test_output_single_unbatched_example_still_works():
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=8)
+    out = net.output(jnp.ones((6,), jnp.float32))
+    assert out.shape == (4,)
+    # a plain python list is still a single example, not a scalar batch
+    out_list = net.output([1.0] * 6)
+    np.testing.assert_allclose(np.asarray(out_list), np.asarray(out),
+                               rtol=1e-6)
+
+
+def test_trained_params_are_what_gets_served():
+    """The engine serves the LIVE params: after a fit, output() reflects
+    the trained network, not the engine-construction-time snapshot."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+    x = jnp.asarray(np.random.RandomState(4).randn(4, 6)
+                    .astype(np.float32))
+    before = np.asarray(net.output(x))
+    y = jnp.asarray(np.eye(4, dtype=np.float32)[
+        np.random.RandomState(5).randint(0, 4, 4)])
+    net.fit_backprop(DataSet(x, y), num_epochs=5)
+    after = np.asarray(net.output(x))
+    assert not np.allclose(before, after)
+
+
+# -- DynamicBatcher (satellite) ---------------------------------------------
+
+def test_batcher_concurrent_clients_get_correct_ordered_results():
+    """N threads submit odd-sized requests; each gets back exactly its
+    own rows, in its own order — and the batcher actually coalesced
+    (fewer device batches than client requests)."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=10)
+    eng = net.serving_engine(buckets=(2, 4, 8, 16, 32, 64))
+    eng.warmup(input_shape=(6,))
+    serving_metrics.reset()
+
+    def ref(x):
+        return np.asarray(net.feed_forward(net.params, x)[-1])
+
+    failures = []
+
+    def client(tid, bat):
+        r = np.random.RandomState(100 + tid)
+        for i in range(12):
+            n = int(r.randint(1, 8)) * 2 - 1          # odd sizes 1..13
+            x = r.randn(n, 6).astype(np.float32)
+            got = bat.infer(x, timeout=60)
+            if got.shape != (n, 4) or not np.array_equal(got, ref(x)):
+                failures.append((tid, i))
+
+    with DynamicBatcher(eng, max_batch_size=48, max_delay_ms=5.0) as bat:
+        threads = [threading.Thread(target=client, args=(t, bat))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert failures == []
+    snap = serving_metrics.snapshot()
+    assert snap["requests"] == 6 * 12
+    # coalescing actually happened: strictly fewer device batches than
+    # client requests (6 threads inside a 5 ms window; an every-request-
+    # its-own-batch regression would make these equal)
+    assert snap["batches_formed"] < snap["requests"]
+    assert snap["requests_coalesced"] == snap["requests"]
+    assert snap["latency_p99_ms"] is not None
+
+
+def test_batcher_lone_request_max_delay_flush():
+    """A single request with no companions must not wait for
+    max_batch_size — the max_delay timer flushes it."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=11)
+    eng = net.serving_engine(buckets=(2, 4))
+    eng.warmup(input_shape=(6,))
+    with DynamicBatcher(eng, max_batch_size=1024,
+                        max_delay_ms=20.0) as bat:
+        t0 = time.perf_counter()
+        out = bat.infer(np.ones((3, 6), np.float32), timeout=30)
+        wall = time.perf_counter() - t0
+    assert out.shape == (3, 4)
+    assert wall < 10.0                    # flushed by timer, not batch cap
+    snap = serving_metrics.snapshot()
+    assert snap["batches_formed"] == 1
+    assert snap["requests_coalesced"] == 1
+
+
+def test_batcher_single_example_api_and_close_rejects_new():
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=12)
+    eng = net.serving_engine(buckets=(2, 4))
+    bat = DynamicBatcher(eng, max_batch_size=8, max_delay_ms=1.0)
+    one = bat.infer_one(np.ones((6,), np.float32), timeout=30)
+    assert one.shape == (4,)
+    bat.close()
+    with pytest.raises(RuntimeError):
+        bat.submit(np.ones((2, 6), np.float32))
+
+
+def test_batcher_propagates_engine_errors_to_futures():
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=13)
+    eng = net.serving_engine(buckets=(2, 4))
+    with DynamicBatcher(eng, max_batch_size=8, max_delay_ms=1.0) as bat:
+        fut = bat.submit(np.ones((2, 3), np.float32))   # wrong n_in
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+
+
+def test_batcher_malformed_request_does_not_poison_cohort():
+    """A mismatched-shape request must fail ALONE; valid requests in
+    flight still resolve correctly.  With a warmed engine the reject
+    happens at submit time (against engine.input_spec), before the bad
+    request can even join a coalescing window."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=15)
+    eng = net.serving_engine(buckets=(2, 4, 8))
+    eng.warmup(input_shape=(6,))
+    good = np.random.RandomState(11).randn(2, 6).astype(np.float32)
+    with DynamicBatcher(eng, max_batch_size=64,
+                        max_delay_ms=200.0) as bat:
+        f_good = bat.submit(good)
+        with pytest.raises(ValueError):
+            bat.submit(np.ones((2, 3), np.float32))       # wrong n_in
+        got = f_good.result(timeout=30)
+    np.testing.assert_array_equal(
+        got, np.asarray(net.feed_forward(net.params, good)[-1]))
+
+
+def test_batcher_unwarmed_window_splits_on_shape_mismatch():
+    """Before any successful dispatch (no input_spec yet), a window
+    containing mixed trailing shapes is split: requests disagreeing with
+    the window head fail individually, the rest dispatch."""
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=16)
+    eng = net.serving_engine(buckets=(2, 4, 8))       # NOT warmed
+    good = np.random.RandomState(17).randn(2, 6).astype(np.float32)
+    with DynamicBatcher(eng, max_batch_size=64,
+                        max_delay_ms=200.0) as bat:
+        f_good = bat.submit(good)                     # head of the window
+        f_bad = bat.submit(np.ones((2, 3), np.float32))
+        with pytest.raises(ValueError):
+            f_bad.result(timeout=30)
+        got = f_good.result(timeout=30)
+    np.testing.assert_array_equal(
+        got, np.asarray(net.feed_forward(net.params, good)[-1]))
+
+
+def test_batcher_handles_pytree_model_outputs():
+    """Models whose apply returns a pytree (e.g. (logits, aux)) slice
+    per-request leaf-wise through the batcher, same as direct infer."""
+    _fresh()
+
+    def apply_fn(params, x):
+        h = jnp.tanh(x @ params["w"])
+        return {"logits": h, "norm": jnp.sum(h * h, axis=-1)}
+
+    params = {"w": jnp.asarray(np.random.RandomState(12)
+                               .randn(6, 4).astype(np.float32))}
+    eng = InferenceEngine(apply_fn, params=params, buckets=(2, 4, 8),
+                          label="serving.pytree")
+    x = np.random.RandomState(13).randn(3, 6).astype(np.float32)
+    direct = eng.infer(x)
+    assert direct["logits"].shape == (3, 4)
+    assert direct["norm"].shape == (3,)
+    with DynamicBatcher(eng, max_batch_size=8, max_delay_ms=1.0) as bat:
+        got = bat.infer(x, timeout=30)
+    np.testing.assert_array_equal(got["logits"], np.asarray(direct["logits"]))
+    np.testing.assert_array_equal(got["norm"], np.asarray(direct["norm"]))
+
+
+# -- Evaluation: one jitted bucketed accumulation (satellite) ---------------
+
+def test_evaluation_counts_match_per_example_reference():
+    _fresh()
+    rng = np.random.RandomState(6)
+    ev = Evaluation()
+    ref_cm = np.zeros((5, 5), np.int64)
+    for n in (3, 17, 64, 9, 100):         # mixed eval-batch sizes
+        labels = rng.randint(0, 5, n)
+        guesses = rng.rand(n, 5).astype(np.float32)
+        ev.eval(labels, guesses)          # int-label form
+        for l, p in zip(labels, np.argmax(guesses, -1)):
+            ref_cm[l, p] += 1
+    np.testing.assert_array_equal(ev.confusion.counts, ref_cm)
+    assert ev.confusion.total() == 193
+    # one-hot form agrees too
+    ev2 = Evaluation(num_classes=5)
+    labels = rng.randint(0, 5, 21)
+    guesses = rng.rand(21, 5).astype(np.float32)
+    ev2.eval(np.eye(5, dtype=np.float32)[labels], guesses)
+    ref2 = np.zeros((5, 5), np.int64)
+    for l, p in zip(labels, np.argmax(guesses, -1)):
+        ref2[l, p] += 1
+    np.testing.assert_array_equal(ev2.confusion.counts, ref2)
+
+
+def test_evaluation_mixed_sizes_reuse_bucket_compiles():
+    """Eval batches of many sizes share the per-bucket programs: sizes
+    landing in an already-traced bucket add ZERO engine compiles."""
+    _fresh()
+    rng = np.random.RandomState(8)
+    ev = Evaluation(num_classes=3)
+
+    def one(n):
+        ev.eval(rng.randint(0, 3, n), rng.rand(n, 3).astype(np.float32))
+
+    # establish the bucket-8 program (this may be the tracing call, or a
+    # cache hit if an earlier test in the process already evaluated this
+    # shape — either way the STREAM below must add nothing)
+    one(5)
+    before = _serving_traces("eval.confusion_counts")
+    for n in (6, 7, 8, 5, 6):             # all land in bucket 8
+        one(n)
+    assert _serving_traces("eval.confusion_counts") == before
+
+
+def test_evaluation_out_of_range_labels_are_ignored():
+    """one_hot semantics preserved: a -1 ignore/padding label (or an
+    off-the-end label) contributes NOTHING — it must neither wrap to
+    class C-1 nor crash."""
+    _fresh()
+    ev = Evaluation(num_classes=3)
+    labels = np.array([0, -1, 2, 3, 1])
+    guesses = np.eye(3, dtype=np.float32)[[0, 2, 2, 0, 1]]
+    ev.eval(labels, guesses)
+    assert ev.confusion.total() == 3          # -1 and 3 dropped
+    assert ev.accuracy() == 1.0
+
+
+def test_network_evaluate_end_to_end():
+    _fresh()
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=14)
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.randn(40, 6).astype(np.float32))
+    y = jnp.asarray(np.eye(4, dtype=np.float32)[rng.randint(0, 4, 40)])
+    ev = net.evaluate(DataSet(x, y))
+    assert ev.confusion.total() == 40
+    assert 0.0 <= ev.accuracy() <= 1.0
+
+
+# -- model adapters ---------------------------------------------------------
+
+def test_gpt_adapter_bucketed_inference_is_exact():
+    from deeplearning4j_tpu.models import gpt
+
+    _fresh()
+    cfg = gpt.gpt_tiny(vocab_size=64, max_len=16)
+    params = gpt.init_params(jax.random.key(0), cfg)
+    apply_fn, key = gpt.make_serving_apply(cfg)
+    eng = InferenceEngine(apply_fn, params=params, buckets=(2, 4),
+                          cache_key=key, label="serving.gpt")
+    tok = np.random.RandomState(10).randint(0, 64, size=(3, 8))
+    got = np.asarray(eng.infer(tok.astype(np.int32)))
+    ref = np.asarray(apply_fn(params, jnp.asarray(tok, jnp.int32)))
+    assert got.shape == (3, 8, 64)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    # second engine over the same config shares the compile via cache_key
+    eng2 = InferenceEngine(apply_fn, params=params, buckets=(2, 4),
+                           cache_key=key, label="serving.gpt")
+    t = _serving_traces("serving.gpt")
+    eng2.infer(tok.astype(np.int32))
+    assert _serving_traces("serving.gpt") == t

@@ -27,9 +27,12 @@ from typing import List
 #: (runtime/ added with the resilience layer: guard code that compiled
 #: outside the engine would silently re-charge every worker a compile
 #: AND hide the guard's compile count from the no-extra-compiles
-#: acceptance check)
+#: acceptance check; serving/ + eval/ added with the inference engine:
+#: a stray jit there would hide serving-path compiles from the
+#: steady-state compile_delta == 0 acceptance assertion)
 SCOPES = ("deeplearning4j_tpu/nn", "deeplearning4j_tpu/optimize",
-          "deeplearning4j_tpu/runtime")
+          "deeplearning4j_tpu/runtime", "deeplearning4j_tpu/serving",
+          "deeplearning4j_tpu/eval")
 
 #: the one legitimate jax.jit call site: the engine implementation itself
 _EXEMPT = {"deeplearning4j_tpu/runtime/compile_cache.py"}
@@ -74,7 +77,8 @@ def main() -> int:
         for f in findings:
             print("  " + f)
         return 1
-    print("ok: nn/, optimize/, and runtime/ compile through the engine")
+    print("ok: nn/, optimize/, runtime/, serving/, and eval/ compile "
+          "through the engine")
     return 0
 
 
