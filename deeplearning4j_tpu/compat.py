@@ -17,6 +17,9 @@ the shim translates for whichever jax is installed.
 
 from __future__ import annotations
 
+# jaxlint: disable-file=raw-shard-map — this module IS the designated
+# shim every other shard_map import is required to route through
+
 from typing import Any, Callable
 
 try:                                      # jax >= 0.6: public API

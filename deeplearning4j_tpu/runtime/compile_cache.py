@@ -32,8 +32,10 @@ no doubled peak memory).  The RAW cached callables therefore invalidate
 those argument buffers — the PYTHON API boundary (``fit_backprop``,
 ``Solver.optimize``, ...) is responsible for the copy-on-entry guard
 (one ``jnp.copy`` of caller-held arrays per call) so user code never
-sees a deleted buffer.  ``tools/check_no_stray_jit.py`` lints ``nn/``
-and ``optimize/`` so future hot-path code goes through this engine.
+sees a deleted buffer.  ``tools/jaxlint`` (the ``stray-jit`` rule;
+``tools/check_no_stray_jit.py`` shims into it) lints the hot-path
+packages so future code goes through this engine, and its
+``use-after-donate`` rule catches scope-local reads of donated buffers.
 
 The persistent ON-DISK compilation cache (skipping XLA compiles across
 processes) is wired separately in ``runtime/__init__.py`` — opt-in via
@@ -78,7 +80,9 @@ def _instrument(fn: Callable, label: str, **jit_kwargs) -> Callable:
         compile_metrics.note_trace(label)
         return fn(*args, **kwargs)
 
-    jitted = jax.jit(traced, **jit_kwargs)
+    # the engine implementation is the one legitimate jax.jit site;
+    # everything else routes through it
+    jitted = jax.jit(traced, **jit_kwargs)  # jaxlint: disable=stray-jit — the engine itself
 
     @functools.wraps(fn)
     def call(*args, **kwargs):

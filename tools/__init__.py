@@ -1,0 +1,3 @@
+# Makes `tools` importable so `python -m tools.jaxlint` and
+# `from tools.jaxlint import ...` resolve from the repo root without
+# relying on namespace-package semantics.

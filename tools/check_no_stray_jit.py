@@ -5,67 +5,50 @@ the runtime engine (``runtime/compile_cache.cached_jit``), never raw
 the compile-count/cache-hit/compile-ms counters, silently re-charging
 every worker replica a full XLA compile.
 
-AST-based, so comments/docstrings mentioning jax.jit don't trip it.
-Flags:
-- ``jax.jit(...)`` / ``@jax.jit`` / ``partial(jax.jit, ...)`` attribute
-  references (any expression position);
-- ``from jax import jit`` / ``from jax import pjit`` imports (aliased or
-  not) that would let a later bare call hide from the attribute check.
-
-Runs standalone (exit 1 on findings) and as a tier-1 test via
-``tests/test_compile_engine.py``.
+This is now a thin shim over ``tools/jaxlint`` (the AST analysis
+framework this check grew into): the ``stray-jit`` rule there is the
+same check, plus inline ``# jaxlint: disable=stray-jit`` suppressions
+instead of a hardcoded exemption list.  CLI and exit codes are
+unchanged — ``python tools/check_no_stray_jit.py`` still exits 1 on
+findings — and the tier-1 run via ``tests/test_compile_engine.py``
+still calls ``find_stray_jits``.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 from typing import List
 
-#: package dirs whose every .py is a hot path routed through the engine
-#: (runtime/ added with the resilience layer: guard code that compiled
-#: outside the engine would silently re-charge every worker a compile
-#: AND hide the guard's compile count from the no-extra-compiles
-#: acceptance check; serving/ + eval/ added with the inference engine:
-#: a stray jit there would hide serving-path compiles from the
-#: steady-state compile_delta == 0 acceptance assertion)
-SCOPES = ("deeplearning4j_tpu/nn", "deeplearning4j_tpu/optimize",
-          "deeplearning4j_tpu/runtime", "deeplearning4j_tpu/serving",
-          "deeplearning4j_tpu/eval")
 
-#: the one legitimate jax.jit call site: the engine implementation itself
-_EXEMPT = {"deeplearning4j_tpu/runtime/compile_cache.py"}
-
-#: jax callables that compile programs and must go through the engine
-_COMPILERS = {"jit", "pjit"}
+def _ensure_importable() -> None:
+    """Make ``tools.jaxlint`` importable when this file is run as a
+    script (sys.path[0] is tools/, not the repo root) or loaded from a
+    file spec."""
+    root = str(pathlib.Path(__file__).resolve().parent.parent)
+    if root not in sys.path:
+        sys.path.insert(0, root)
 
 
 def find_stray_jits(repo_root: pathlib.Path) -> List[str]:
-    """Return ``path:line: finding`` strings for every bypass in SCOPES."""
-    findings: List[str] = []
-    for scope in SCOPES:
-        for path in sorted((repo_root / scope).rglob("*.py")):
-            rel = path.relative_to(repo_root)
-            if str(rel).replace("\\", "/") in _EXEMPT:
-                continue
-            tree = ast.parse(path.read_text(), filename=str(path))
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Attribute)
-                        and node.attr in _COMPILERS
-                        and isinstance(node.value, ast.Name)
-                        and node.value.id == "jax"):
-                    findings.append(
-                        f"{rel}:{node.lineno}: jax.{node.attr} bypasses "
-                        "runtime/compile_cache.cached_jit")
-                elif isinstance(node, ast.ImportFrom) and node.module == "jax":
-                    for alias in node.names:
-                        if alias.name in _COMPILERS:
-                            findings.append(
-                                f"{rel}:{node.lineno}: 'from jax import "
-                                f"{alias.name}' hides compiles from the "
-                                "engine")
-    return findings
+    """Return ``path:line: finding`` strings for every bypass in the
+    engine-scoped packages (delegates to the jaxlint ``stray-jit``
+    rule; paths are relative to ``repo_root`` as before)."""
+    _ensure_importable()
+    from tools.jaxlint import run_paths
+    from tools.jaxlint.rules.stray_jit import SCOPES
+
+    repo_root = pathlib.Path(repo_root)
+    scope_dirs = [repo_root / s for s in SCOPES
+                  if (repo_root / s).is_dir()]
+    out: List[str] = []
+    for f in run_paths(scope_dirs, select=["stray-jit"]):
+        try:
+            rel = pathlib.Path(f.path).relative_to(repo_root).as_posix()
+        except ValueError:
+            rel = f.path
+        out.append(f"{rel}:{f.line}: {f.message}")
+    return out
 
 
 def main() -> int:

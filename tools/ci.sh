@@ -16,6 +16,14 @@ cd "$REPO"
 # slower than serial for the fast tier).  A PLAIN pytest run (the
 # driver/judge command) executes the whole suite; only ci.sh's default
 # fast tier skips the slow files.
+# Static analysis first: jaxlint machine-checks the JAX invariants
+# (engine-routed jits, donation discipline, compat-only shard_map, pure
+# host-sync-free steps) in milliseconds — no point booting jax for the
+# test tier if the tree already violates them.  Non-zero on any finding
+# not in tools/jaxlint/baseline.json.
+echo "[ci] jaxlint"
+python -m tools.jaxlint deeplearning4j_tpu bench.py tools || exit 1
+
 if [ "${1:-}" = "--slow" ]; then
   python -m pytest tests/ -q
 else

@@ -1,0 +1,276 @@
+"""Shared AST analysis: what counts as "jitted"/"hot" code.
+
+Three rules (host-sync-in-hot-path, impure-jit, use-after-donate) need
+the same answers — which callables end up traced by XLA, which of their
+parameters are static, and which names a function binds locally — so
+the answers live here once.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+#: callables that hand their first argument to the XLA tracer
+_JIT_NAMES = {"jit", "pjit", "cached_jit"}
+_TRACING_WRAPPERS = {"shard_map", "checkpoint", "remat"}
+
+#: attribute reads that touch only trace-time METADATA — static under
+#: jit (shape specialization) and legal on a donated array (JAX frees
+#: the buffer, the aval survives)
+METADATA_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+
+def metadata_only_names(nodes) -> Set[int]:
+    """ids of Name nodes read solely as ``name.<metadata attr>``."""
+    return {id(n.value) for n in nodes
+            if isinstance(n, ast.Attribute)
+            and n.attr in METADATA_ATTRS
+            and isinstance(n.value, ast.Name)}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.experimental.shard_map`` -> that string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_reference(node: ast.AST) -> bool:
+    """Does this expression name a jit-like compiler (``jax.jit``,
+    bare ``jit``/``pjit``, any ``*.cached_jit``)?"""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in _JIT_NAMES
+
+
+def _is_partial(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and name.rsplit(".", 1)[-1] == "partial"
+
+
+def _literal_ints(node: Optional[ast.AST]) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+    return out
+
+
+def _literal_strs(node: Optional[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
+
+
+def jit_static_info(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """(static_argnums, static_argnames) literals from a jit-ish call."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= _literal_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            names |= _literal_strs(kw.value)
+    return nums, names
+
+
+def donated_argnums(call: ast.Call) -> Set[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _literal_ints(kw.value)
+    return set()
+
+
+def positional_params(fn: FunctionNode) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def dynamic_param_names(fn: FunctionNode, static_argnums: Set[int],
+                        static_argnames: Set[str]) -> Set[str]:
+    """Parameters that are TRACERS inside ``fn`` when jitted: positional
+    params minus declared statics.  Keyword-only params are excluded —
+    jitted code in this repo only ever passes them via
+    ``static_argnames`` (a kw-only tracer would already be a bug the
+    tracer itself reports)."""
+    pos = positional_params(fn)
+    out = {p for i, p in enumerate(pos) if i not in static_argnums}
+    out -= static_argnames
+    out -= {"self", "cls"}
+    return out
+
+
+@dataclass
+class HotInfo:
+    """Why a function is considered traced, and what we know about it."""
+    reason: str
+    static_argnums: Set[int] = field(default_factory=set)
+    static_argnames: Set[str] = field(default_factory=set)
+
+
+def _first_arg_names_of_tracing_calls(tree: ast.Module
+                                      ) -> Dict[str, HotInfo]:
+    """Names passed (by identifier) as the traced function of a jit-like
+    or tracing-wrapper call anywhere in the module: ``cached_jit(step,
+    ...)``, ``jax.jit(step_fn, ...)``, ``shard_map(round_fn, ...)``."""
+    out: Dict[str, HotInfo] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        callee = dotted_name(node.func)
+        if callee is None:
+            continue
+        leaf = callee.rsplit(".", 1)[-1]
+        if leaf in _JIT_NAMES:
+            reason = f"passed to {callee}"
+        elif leaf in _TRACING_WRAPPERS:
+            reason = f"wrapped by {callee}"
+        else:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            nums, names = jit_static_info(node)
+            out[target.id] = HotInfo(reason, nums, names)
+    return out
+
+
+def _decorator_hotness(fn: FunctionNode) -> Optional[HotInfo]:
+    for dec in fn.decorator_list:
+        if is_jit_reference(dec):
+            return HotInfo(f"decorated @{dotted_name(dec)}")
+        if isinstance(dec, ast.Call):
+            if is_jit_reference(dec.func):
+                nums, names = jit_static_info(dec)
+                return HotInfo(f"decorated @{dotted_name(dec.func)}(...)",
+                               nums, names)
+            if _is_partial(dec.func) and dec.args \
+                    and is_jit_reference(dec.args[0]):
+                nums, names = jit_static_info(dec)
+                return HotInfo("decorated @partial(jit, ...)", nums, names)
+    return None
+
+
+def hot_functions(tree: ast.Module) -> Dict[FunctionNode, HotInfo]:
+    """Every function the analyzer treats as XLA-traced ("hot"):
+
+    - decorated with ``jax.jit`` / ``pjit`` / ``cached_jit`` (directly or
+      via ``partial``);
+    - passed by name as the traced argument of such a call (or of
+      ``shard_map``/``checkpoint``/``remat``) anywhere in the module;
+    - named ``*_step`` — the repo's step-function convention — unless the
+      name starts with ``make_`` (factories RETURN steps, they aren't
+      steps);
+    - lexically nested inside a hot function (the tracer runs nested
+      bodies too).
+    """
+    by_call = _first_arg_names_of_tracing_calls(tree)
+    hot: Dict[FunctionNode, HotInfo] = {}
+
+    def visit(node: ast.AST, inside_hot: bool) -> None:
+        here_hot = inside_hot
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info: Optional[HotInfo] = None
+            dec = _decorator_hotness(node)
+            if dec is not None:
+                info = dec
+            elif node.name in by_call:
+                info = by_call[node.name]
+            elif node.name.endswith("_step") \
+                    and not node.name.startswith("make_"):
+                info = HotInfo("named *_step")
+            elif inside_hot:
+                info = HotInfo("nested in a traced function")
+            if info is not None:
+                hot[node] = info
+                here_hot = True
+            else:
+                here_hot = False
+        for child in ast.iter_child_nodes(node):
+            visit(child, here_hot)
+
+    visit(tree, False)
+    return hot
+
+
+def hot_roots(hot: Dict[FunctionNode, HotInfo]
+              ) -> List[Tuple[FunctionNode, HotInfo]]:
+    """Hot functions not nested inside another hot function — walking
+    each root's whole subtree visits every hot body exactly once."""
+    spans = [(fn.lineno, fn.end_lineno or fn.lineno) for fn in hot]
+    roots = []
+    for fn, info in hot.items():
+        enclosed = any(s < fn.lineno and (fn.end_lineno or fn.lineno) <= e
+                       for s, e in spans
+                       if (s, e) != (fn.lineno, fn.end_lineno or fn.lineno))
+        if not enclosed:
+            roots.append((fn, info))
+    return sorted(roots, key=lambda p: p[0].lineno)
+
+
+def local_bindings(fn: FunctionNode) -> Set[str]:
+    """Names ``fn`` binds locally: params plus every Store-context name
+    in its own body (nested function bodies excluded — those are their
+    own scopes)."""
+    a = fn.args
+    names: Set[str] = {p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    names.add(child.name)
+                continue
+            if isinstance(child, ast.Name) \
+                    and isinstance(child.ctx, (ast.Store, ast.Del)):
+                names.add(child.id)
+            elif isinstance(child, ast.alias):
+                names.add((child.asname or child.name).split(".")[0])
+            elif isinstance(child, ast.ExceptHandler) and child.name:
+                names.add(child.name)
+            visit(child)
+
+    visit(fn)
+    return names
+
+
+def enclosing_function_params(tree: ast.Module
+                              ) -> Dict[ast.AST, FunctionNode]:
+    """Map every node to its nearest enclosing function def (if any)."""
+    owner: Dict[ast.AST, FunctionNode] = {}
+
+    def visit(node: ast.AST, current: Optional[FunctionNode]) -> None:
+        if current is not None:
+            owner[node] = current
+        nxt = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else current
+        for child in ast.iter_child_nodes(node):
+            visit(child, nxt)
+
+    visit(tree, None)
+    return owner

@@ -1,0 +1,119 @@
+"""Baseline file: grandfather existing findings without muting the rule.
+
+The baseline is a checked-in JSON list of finding FINGERPRINTS — (rule,
+path, stripped source-line text, occurrence index), deliberately not raw
+line numbers, so unrelated edits above a grandfathered finding don't
+churn the file.  A finding whose fingerprint is in the baseline is
+reported separately and does not fail the run; anything new does.
+
+Workflow:
+- ``python -m tools.jaxlint <paths> --write-baseline`` snapshots the
+  current findings into the baseline file;
+- fixing a grandfathered finding leaves a stale entry behind — rerun
+  ``--write-baseline`` to shed it (entries are never auto-pruned, so a
+  finding can't silently flicker back in);
+- NEW deliberate exceptions belong inline
+  (``# jaxlint: disable=<rule> — reason``), not in the baseline: the
+  baseline records debt, the annotation records a decision.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.jaxlint.core import Finding
+
+VERSION = 1
+
+#: entries store REPO-RELATIVE paths (absolute outside the repo) so the
+#: same finding fingerprints identically whether jaxlint was invoked
+#: with relative paths from the repo root, absolute paths, or another cwd
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def norm_path(path_str: str) -> str:
+    p = Path(path_str).resolve()
+    try:
+        return p.relative_to(_REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def fingerprint_all(findings: Sequence[Finding]) -> List[Dict[str, object]]:
+    """Stable fingerprints, with an occurrence index to disambiguate
+    identical lines flagged by the same rule in one file."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Dict[str, object]] = []
+    sources: Dict[str, List[str]] = {}
+    for f in findings:
+        norm = norm_path(f.path)
+        if f.path not in sources:
+            try:
+                sources[f.path] = Path(f.path).read_text(
+                    encoding="utf-8").splitlines()
+            except OSError:
+                sources[f.path] = []
+        lines = sources[f.path]
+        text = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        key = (f.rule, norm, text)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        out.append({"rule": f.rule, "path": norm, "line_text": text,
+                    "occurrence": idx})
+    return out
+
+
+def _keys(entries: Sequence[Dict[str, object]]) -> set:
+    return {(e.get("rule"), e.get("path"), e.get("line_text"),
+             e.get("occurrence", 0)) for e in entries}
+
+
+def load(path: Path) -> List[Dict[str, object]]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if isinstance(data, dict):
+        if data.get("version") != VERSION:
+            raise ValueError(
+                f"baseline {path} has version {data.get('version')!r}, "
+                f"this jaxlint reads version {VERSION}")
+        entries = data.get("entries", [])
+    else:
+        entries = data
+    if not isinstance(entries, list) \
+            or not all(isinstance(e, dict) for e in entries):
+        raise ValueError(f"baseline {path} is malformed (expected a list "
+                         "of entry objects)")
+    return list(entries)
+
+
+def save(path: Path, findings: Sequence[Finding],
+         scanned_paths: Optional[set] = None) -> int:
+    """Snapshot ``findings`` into the baseline.  With ``scanned_paths``
+    (normalized, from the run's actual file set) entries for files
+    OUTSIDE the scan are retained — a partial-tree ``--write-baseline``
+    must not erase another file's grandfathered debt."""
+    entries = fingerprint_all(findings)
+    if scanned_paths is not None and path.exists():
+        retained = [e for e in load(path)
+                    if e.get("path") not in scanned_paths]
+        entries = retained + entries
+    path.write_text(json.dumps({"version": VERSION, "entries": entries},
+                               indent=2, sort_keys=True) + "\n",
+                    encoding="utf-8")
+    return len(entries)
+
+
+def apply(findings: Sequence[Finding], entries: Sequence[Dict[str, object]]
+          ) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (new, grandfathered) against the baseline entries."""
+    baselined_keys = _keys(entries)
+    fps = fingerprint_all(findings)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f, fp in zip(findings, fps):
+        key = (fp["rule"], fp["path"], fp["line_text"], fp["occurrence"])
+        (old if key in baselined_keys else new).append(f)
+    return new, old

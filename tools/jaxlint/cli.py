@@ -1,0 +1,111 @@
+"""``python -m tools.jaxlint [paths...]`` — the command-line front-end.
+
+Exit codes: 0 = clean (or every finding baselined/suppressed),
+1 = at least one non-baselined finding, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from tools.jaxlint import baseline as baseline_mod
+from tools.jaxlint import rules  # noqa: F401 — registers the rule set
+from tools.jaxlint.core import REGISTRY, iter_python_files, run_paths
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+DEFAULT_CACHE = Path(".jaxlint_cache.json")
+DEFAULT_PATHS = ("deeplearning4j_tpu", "bench.py", "tools")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="AST-based tracing-safety analyzer for this repo's "
+                    "JAX invariants")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="files/dirs to lint (default: %(default)s)")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule subset")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    metavar="FILE",
+                    help="baseline JSON (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into the baseline "
+                         "and exit 0")
+    # a flag + a separate FILE option on purpose: an optional-argument
+    # form (--cache [FILE]) would silently swallow the first positional
+    # path as the cache filename and lint nothing
+    ap.add_argument("--cache", action="store_true",
+                    help=f"use the per-file result cache {DEFAULT_CACHE} "
+                         "(gitignored)")
+    ap.add_argument("--cache-file", type=Path, default=None,
+                    metavar="FILE",
+                    help="result cache at FILE (implies --cache)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            rule = REGISTRY[name]
+            print(f"{name:24s} [{rule.severity}] {rule.description}")
+        return 0
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()] \
+        if args.select else None
+    cache_path = args.cache_file if args.cache_file is not None \
+        else (DEFAULT_CACHE if args.cache else None)
+    try:
+        findings = run_paths(args.paths, select, cache_path=cache_path)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if select is not None:
+            print("error: --write-baseline with --select would snapshot "
+                  "a partial rule set (erasing other rules' entries); "
+                  "run it without --select", file=sys.stderr)
+            return 2
+        scanned = {baseline_mod.norm_path(p.as_posix())
+                   for p in iter_python_files(
+                       [Path(p) for p in args.paths])}
+        try:
+            n = baseline_mod.save(args.baseline, findings,
+                                  scanned_paths=scanned)
+        except (OSError, ValueError) as e:
+            print(f"error: baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+              f"{args.baseline}")
+        return 0
+
+    try:
+        entries = [] if args.no_baseline \
+            else baseline_mod.load(args.baseline)
+    except (OSError, ValueError) as e:
+        # a corrupt/mismatched baseline must be a clean usage
+        # diagnostic, not a traceback
+        print(f"error: baseline {args.baseline}: {e}", file=sys.stderr)
+        return 2
+    new, grandfathered = baseline_mod.apply(findings, entries)
+
+    for f in new:
+        print(f.render())
+    if grandfathered:
+        print(f"({len(grandfathered)} baselined finding"
+              f"{'' if len(grandfathered) == 1 else 's'} not shown; "
+              "see --baseline)")
+    if new:
+        errors = sum(1 for f in new if f.severity == "error")
+        warnings = len(new) - errors
+        print(f"jaxlint: {errors} error(s), {warnings} warning(s)")
+        return 1
+    print(f"jaxlint: ok ({len(REGISTRY) if select is None else len(select)}"
+          f" rules, {len(findings) - len(new)} baselined)")
+    return 0

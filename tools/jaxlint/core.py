@@ -1,0 +1,322 @@
+"""jaxlint core: rule registry, suppression parsing, file runner.
+
+The repo's JAX invariants (engine-routed jits, donation discipline,
+shard_map only via ``compat.py``, pure host-sync-free step functions)
+are whole-program properties XLA cannot check for us — a violation
+compiles fine and fails silently as a recompile storm, use-after-donate
+garbage, or a hidden device→host sync.  jaxlint machine-checks them the
+way graph-level validation does in TensorFlow (arXiv:1605.08695) and
+ahead-of-time checking does in the Julia-to-TPU work (arXiv:1810.09868):
+statically, over the real ``ast``, before anything runs.
+
+Everything here is stdlib-only (``ast`` + ``tokenize`` — **no regex**,
+per the framework contract: rules match syntax trees, not strings) so
+the analyzer imports in milliseconds and never drags jax into CI.
+
+Suppression syntax (parsed from real COMMENT tokens, so string literals
+never suppress anything):
+
+- ``# jaxlint: disable=rule-a,rule-b — reason`` on a flagged line
+  suppresses those rules for that line;
+- the same comment on a ``def`` line suppresses the rules for the whole
+  function body (the reason clause is required by convention — the
+  point is a reviewed, explained exception, not a mute button);
+- ``# jaxlint: disable-file=rule-a`` anywhere suppresses the rule for
+  the entire file (e.g. ``compat.py`` IS the designated shard_map shim).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+    rule: str
+    path: str          # POSIX-style, as resolved by the runner
+    line: int
+    col: int
+    message: str
+    severity: str      # "error" | "warning" — display only; any
+                       # non-baselined finding fails the run
+    end_line: int = 0  # last physical line of the flagged node, so a
+                       # disable comment trailing a multi-line statement
+                       # still suppresses it (0 = same as ``line``)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.severity}] {self.rule}: {self.message}")
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``severity``/``description``,
+    implement ``check``.  Register with ``@register``."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def applies_to(self, posix_path: str) -> bool:
+        """Path filter (POSIX string).  Default: every file."""
+        return True
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # helper so rules build findings without repeating themselves
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(self.name, path, line,
+                       getattr(node, "col_offset", 0), message,
+                       self.severity,
+                       end_line=getattr(node, "end_lineno", None) or line)
+
+
+#: name -> rule INSTANCE (rules are stateless; one instance serves all runs)
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    if not cls.name:
+        raise ValueError(f"rule class {cls.__name__} has no name")
+    if cls.name in REGISTRY:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    REGISTRY[cls.name] = cls()
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def _parse_directive(comment: str) -> Optional[Tuple[str, Set[str]]]:
+    """Parse ``# jaxlint: disable=a,b — reason`` without regex.
+
+    Returns (kind, rule-names) where kind is "line" or "file", or None
+    if the comment carries no jaxlint directive.
+    """
+    # the directive must be the comment's CONTENT, not a mention inside
+    # prose ("# TODO: the jaxlint: disable syntax exists" mutes nothing)
+    marker = "jaxlint:"
+    text = comment.lstrip("#").strip()
+    if not text.startswith(marker):
+        return None
+    rest = text[len(marker):].strip()
+    for prefix, kind in (("disable-file=", "file"), ("disable=", "line")):
+        if rest.startswith(prefix):
+            # comma-separated rule names, tolerating spaces after commas
+            # (``disable=rule-a, rule-b — reason``): each chunk's leading
+            # [a-z0-9_-] run is the rule name; the first chunk with
+            # trailing junk starts the human reason clause
+            names: Set[str] = set()
+            for chunk in rest[len(prefix):].split(","):
+                chunk = chunk.strip()
+                head = ""
+                for ch in chunk:
+                    if ch.isalnum() or ch in "-_":
+                        head += ch
+                    else:
+                        break
+                if head:
+                    names.add(head)
+                if head != chunk:
+                    break
+            return (kind, names) if names else None
+    return None
+
+
+class Suppressions:
+    """Per-file suppression state, built once from the token stream."""
+
+    def __init__(self, source: str, tree: ast.Module):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_wide: Set[str] = set()
+        standalone: Set[int] = set()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                if not tok.line[:tok.start[1]].strip():
+                    standalone.add(tok.start[0])
+                parsed = _parse_directive(tok.string)
+                if parsed is None:
+                    continue
+                kind, names = parsed
+                if kind == "file":
+                    self.file_wide |= names
+                else:
+                    self.by_line.setdefault(tok.start[0], set()).update(names)
+        except tokenize.TokenError:
+            pass
+        # a disable TRAILING a `def`/decorator line (up to the first body
+        # statement) covers the whole function body — the idiom for
+        # "this function is a deliberate exception".  Standalone comment
+        # lines in that range do NOT widen to the function: a developer
+        # writing a full-line comment above the first statement means
+        # that spot, not a blanket mute.
+        self.spans: List[Tuple[int, int, Set[str]]] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            first_body = node.body[0].lineno if node.body else node.lineno
+            covered: Set[str] = set()
+            start = min(d.lineno for d in node.decorator_list) \
+                if node.decorator_list else node.lineno
+            for line in range(start, first_body):
+                if line not in standalone:
+                    covered |= self.by_line.get(line, set())
+            if covered:
+                self.spans.append(
+                    (node.lineno, node.end_lineno or node.lineno, covered))
+
+    def hides(self, finding: Finding) -> bool:
+        if finding.rule in self.file_wide:
+            return True
+        # any physical line of the flagged node may carry the comment —
+        # a multi-line call is suppressed from its closing line too
+        last = max(finding.end_line, finding.line)
+        if any(finding.rule in self.by_line.get(line, set())
+               for line in range(finding.line, last + 1)):
+            return True
+        return any(start <= finding.line <= end and finding.rule in rules
+                   for start, end, rules in self.spans)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def iter_python_files(paths: Sequence[Path]) -> List[Path]:
+    """Expand files/dirs to a sorted, deduplicated .py list."""
+    out: List[Path] = []
+    seen = set()
+    for p in paths:
+        if p.is_dir():
+            candidates = sorted(p.rglob("*.py"))
+        else:
+            candidates = [p]
+        for c in candidates:
+            if "__pycache__" in c.parts:
+                continue
+            r = c.resolve()
+            if r not in seen:
+                seen.add(r)
+                out.append(c)
+    return out
+
+
+def check_source(source: str, posix_path: str,
+                 rules: Optional[Sequence[Rule]] = None,
+                 filename: Optional[str] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one source blob.
+
+    Returns only findings that survive inline suppressions.  Exposed
+    directly so tests can lint fixture snippets without touching disk.
+    """
+    tree = ast.parse(source, filename=filename or posix_path)
+    sup = Suppressions(source, tree)
+    active = list(REGISTRY.values()) if rules is None else list(rules)
+    findings: List[Finding] = []
+    for rule in active:
+        if not rule.applies_to(posix_path):
+            continue
+        findings.extend(f for f in rule.check(tree, posix_path)
+                        if not sup.hides(f))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+_ANALYZER_FP: Optional[str] = None
+
+
+def _analyzer_fingerprint() -> str:
+    """Hash of the analyzer's OWN sources — part of every cache key so a
+    rule fix invalidates cached results for unchanged files too."""
+    global _ANALYZER_FP
+    if _ANALYZER_FP is None:
+        import hashlib
+        h = hashlib.sha256()
+        pkg = Path(__file__).resolve().parent
+        for f in sorted(pkg.rglob("*.py")):
+            if "__pycache__" not in f.parts:
+                h.update(f.as_posix().encode())
+                h.update(f.read_bytes())
+        _ANALYZER_FP = h.hexdigest()
+    return _ANALYZER_FP
+
+
+def run_paths(paths: Sequence, select: Optional[Sequence[str]] = None,
+              cache_path: Optional[Path] = None) -> List[Finding]:
+    """Lint every .py under ``paths``; returns unsuppressed findings.
+
+    ``select`` restricts to a subset of rule names.  Baseline filtering
+    is layered on top by the CLI (``baseline.apply``) so API callers see
+    the raw truth.  With ``cache_path`` a per-file result cache is
+    consulted and updated — keyed on (analyzer sources, rule selection,
+    file source), so editing either the file or jaxlint itself re-lints.
+    """
+    if select is not None:
+        unknown = set(select) - set(REGISTRY)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        rules = [REGISTRY[n] for n in select]
+        rule_names = sorted(select)
+    else:
+        rules = None
+        rule_names = sorted(REGISTRY)
+
+    cache: dict = {}
+    dirty = False
+    if cache_path is not None and cache_path.exists():
+        import json
+        try:
+            cache = json.loads(cache_path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            cache = {}
+
+    findings: List[Finding] = []
+    for path in iter_python_files([Path(p) for p in paths]):
+        posix = path.as_posix()
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("parse-error", posix, 1, 0,
+                                    f"unreadable: {e}", "error"))
+            continue
+        key = None
+        if cache_path is not None:
+            import hashlib
+            key = hashlib.sha256(
+                (_analyzer_fingerprint() + "\x00"
+                 + "\x00".join(rule_names) + "\x00" + source)
+                .encode("utf-8")).hexdigest()
+            hit = cache.get(posix)
+            if hit is not None and hit.get("key") == key:
+                findings.extend(Finding(**f) for f in hit["findings"])
+                continue
+        try:
+            file_findings = check_source(source, posix, rules)
+        except SyntaxError as e:
+            file_findings = [Finding("parse-error", posix, e.lineno or 1,
+                                     e.offset or 0,
+                                     f"syntax error: {e.msg}", "error")]
+        findings.extend(file_findings)
+        if key is not None:
+            cache[posix] = {"key": key,
+                            "findings": [vars(f) for f in file_findings]}
+            dirty = True
+
+    if cache_path is not None and dirty:
+        import json
+        try:
+            cache_path.write_text(json.dumps(cache), encoding="utf-8")
+        except OSError:
+            pass
+    return findings

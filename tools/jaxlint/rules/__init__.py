@@ -1,0 +1,9 @@
+"""Rule modules register themselves on import (see core.register)."""
+
+from tools.jaxlint.rules import (  # noqa: F401
+    host_sync,
+    impure_jit,
+    raw_shard_map,
+    stray_jit,
+    use_after_donate,
+)

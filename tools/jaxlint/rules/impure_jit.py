@@ -1,0 +1,120 @@
+"""impure-jit: jitted function bodies must be pure.
+
+A traced function runs its Python body ONCE per (shapes, dtypes)
+signature; everything that isn't a jax op is frozen into the program or
+silently skipped on cached dispatches.  ``time.time()`` bakes the trace
+timestamp in forever, ``np.random.*`` bakes one fixed draw, ``print``
+fires only while tracing (then never again), and mutating a closed-over
+container leaks trace-time state that replays differently per compile —
+all four are the classic "works in eager, wrong under jit" bugs.
+
+Flagged inside hot functions (see ``astutil.hot_functions``):
+- ``time.time/perf_counter/monotonic/process_time/sleep`` calls,
+- any ``np.random.*`` use,
+- ``print(...)`` (use ``jax.debug.print`` for traced values),
+- ``global``/``nonlocal`` declarations,
+- mutation of names NOT bound in the function itself (``.append()`` &
+  co., or subscript/augmented assignment to a closed-over name).
+  Mutating a function-local container at trace time (building a layer
+  list, say) is fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time", "sleep"}
+#: container methods that mutate in place.  ``update`` is deliberately
+#: absent: in jax code ``x.update(...)`` is overwhelmingly optax's PURE
+#: ``GradientTransformation.update`` (every step function here calls
+#: it), and the dict.update spelling of this bug is caught anyway when
+#: the result is stored back into the closed-over container.
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "add", "discard", "setdefault", "sort", "reverse", "popitem"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+@register
+class ImpureJitRule(Rule):
+    name = "impure-jit"
+    severity = "error"
+    description = ("side effect inside a jitted function (time.*, "
+                   "np.random.*, print, global, closed-over mutation)")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        hot = astutil.hot_functions(tree)
+        if not hot:
+            return
+        owner = astutil.enclosing_function_params(tree)
+        locals_of: Dict[ast.AST, Set[str]] = {
+            fn: astutil.local_bindings(fn) for fn in hot}
+
+        for root, _ in astutil.hot_roots(hot):
+            for node in ast.walk(root):
+                yield from self._check_node(node, posix_path, owner,
+                                            locals_of)
+
+    def _check_node(self, node, posix_path, owner, locals_of
+                    ) -> Iterable[Finding]:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _TIME_FNS \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "time":
+                yield self.finding(
+                    posix_path, node,
+                    f"time.{fn.attr}() runs at TRACE time only — its "
+                    "value is baked into the compiled program")
+            elif isinstance(fn, ast.Name) and fn.id == "print":
+                yield self.finding(
+                    posix_path, node,
+                    "print() fires only while tracing, never on cached "
+                    "dispatches (use jax.debug.print)")
+            elif isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS \
+                    and isinstance(fn.value, ast.Name):
+                name = fn.value.id
+                enclosing = owner.get(node)
+                if enclosing is not None \
+                        and enclosing in locals_of \
+                        and name not in locals_of[enclosing]:
+                    yield self.finding(
+                        posix_path, node,
+                        f"mutating closed-over {name!r} leaks trace-time "
+                        "state (runs once per compile, not per step)")
+        elif isinstance(node, ast.Attribute):
+            # any np.random.<member> — including np.random.random()
+            # itself (the inner np.random node has a Name base, so the
+            # walk never double-reports)
+            base = node.value
+            if isinstance(base, ast.Attribute) and base.attr == "random" \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id in _NP_NAMES:
+                yield self.finding(
+                    posix_path, node,
+                    f"np.random.{node.attr} draws ONE value at trace "
+                    "time — use jax.random with a threaded key")
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield self.finding(
+                posix_path, node,
+                f"`{kw}` rebinding inside a traced function is a "
+                "trace-time side effect")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name):
+                    name = tgt.value.id
+                    enclosing = owner.get(node)
+                    if enclosing is not None \
+                            and enclosing in locals_of \
+                            and name not in locals_of[enclosing]:
+                        yield self.finding(
+                            posix_path, node,
+                            f"item assignment into closed-over {name!r} "
+                            "leaks trace-time state")

@@ -1,0 +1,54 @@
+"""raw-shard-map: ``shard_map`` is only reached via ``compat.py``.
+
+The repo supports both jax 0.4.x (``jax.experimental.shard_map`` with
+``check_rep``) and current jax (``jax.shard_map`` with ``check_vma``)
+through one shim — ``deeplearning4j_tpu/compat.py`` — which translates
+the replication-check kwarg.  A direct import anywhere else either
+crashes on one jax generation or silently skips the replication check
+on the other.  ``compat.py`` itself carries a file-wide
+``# jaxlint: disable-file=raw-shard-map`` (it IS the shim) rather than
+a path exemption baked in here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.jaxlint.core import Finding, Rule, register
+
+_MSG = ("direct shard_map import bypasses deeplearning4j_tpu/compat.py "
+        "(the check_rep/check_vma shim); use "
+        "'from deeplearning4j_tpu.compat import shard_map'")
+
+
+@register
+class RawShardMapRule(Rule):
+    name = "raw-shard-map"
+    severity = "error"
+    description = ("shard_map imported from jax instead of the "
+                   "compat.py shim")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax.experimental.shard_map":
+                    yield self.finding(posix_path, node, _MSG)
+                elif mod in ("jax", "jax.experimental") and any(
+                        a.name == "shard_map" for a in node.names):
+                    yield self.finding(posix_path, node, _MSG)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("jax.experimental.shard_map"):
+                        yield self.finding(posix_path, node, _MSG)
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr == "shard_map":
+                # expression use: jax.shard_map / jax.experimental.shard_map
+                base = node.value
+                if (isinstance(base, ast.Name) and base.id == "jax") or (
+                        isinstance(base, ast.Attribute)
+                        and base.attr == "experimental"
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "jax"):
+                    yield self.finding(posix_path, node, _MSG)

@@ -1,0 +1,71 @@
+"""stray-jit: hot-path packages must compile through the runtime engine.
+
+A raw ``jax.jit`` in ``nn/``, ``optimize/``, ``runtime/``, ``serving/``
+or ``eval/`` bypasses ``runtime/compile_cache.cached_jit`` — the
+cross-network compile cache and the compile-count/cache-hit/compile-ms
+counters — silently re-charging every worker replica a full XLA compile
+and hiding the compile from the ``compile_delta == 0`` acceptance
+assertions.  This is the AST port of the original
+``tools/check_no_stray_jit.py`` (which now shims into this rule).
+
+The one legitimate ``jax.jit`` site — the engine implementation itself —
+carries an inline ``# jaxlint: disable=stray-jit`` annotation instead of
+a hardcoded exemption list.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from tools.jaxlint.core import Finding, Rule, register
+
+#: package dirs whose every .py is a hot path routed through the engine
+#: (matched as path substrings so fixture trees exercise the rule too)
+SCOPES = (
+    "deeplearning4j_tpu/nn/",
+    "deeplearning4j_tpu/optimize/",
+    "deeplearning4j_tpu/runtime/",
+    "deeplearning4j_tpu/serving/",
+    "deeplearning4j_tpu/eval/",
+)
+
+#: jax callables that compile programs and must go through the engine
+_COMPILERS = {"jit", "pjit"}
+
+
+@register
+class StrayJitRule(Rule):
+    name = "stray-jit"
+    severity = "error"
+    description = ("raw jax.jit/pjit in an engine-scoped package "
+                   "bypasses runtime/compile_cache.cached_jit")
+
+    def applies_to(self, posix_path: str) -> bool:
+        # resolve relative spellings against the cwd so `cd
+        # deeplearning4j_tpu && jaxlint nn/` still matches the scope —
+        # a raw substring test on the as-given path would silently skip
+        # the rule (a false clean from the enforcement gate)
+        p = Path(posix_path)
+        resolved = (p if p.is_absolute() else Path.cwd() / p)
+        full = resolved.resolve().as_posix()
+        return any(scope in full for scope in SCOPES)
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in _COMPILERS
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                yield self.finding(
+                    posix_path, node,
+                    f"jax.{node.attr} bypasses "
+                    "runtime/compile_cache.cached_jit")
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name in _COMPILERS:
+                        yield self.finding(
+                            posix_path, node,
+                            f"'from jax import {alias.name}' hides "
+                            "compiles from the engine")

@@ -1,0 +1,393 @@
+"""use-after-donate: a buffer passed in a donated position is dead.
+
+``donate_argnums`` lets XLA reuse an argument's HBM for the output —
+after the call the Python variable still points at a DELETED buffer, and
+touching it raises (best case) or reads garbage on some backends (worst
+case).  The engine's contract (runtime/compile_cache.py docstring) is
+copy-on-entry at API boundaries; this rule catches the scope-local
+version of the bug the copy guards exist for:
+
+    step = cached_jit(body, donate_argnums=(0,))
+    out = step(params, batch)
+    loss(params)            # <-- params' buffer was donated away
+
+Tracked donating callables (literal ``donate_argnums`` only):
+- names assigned from ``cached_jit(...)`` / ``jax.jit(...)`` — at module
+  scope or locally;
+- functions decorated ``@partial(jax.jit, donate_argnums=...)``.
+
+A read is flagged when the donated argument was a plain name and that
+name is read again later in the same scope before being rebound.  The
+scan is lexical (statement order, assignment targets kill the taint), so
+loop-carried rebinding like ``x = step(x)`` stays clean; reads hidden
+behind back-edges of a loop are out of scope for a linter.  Two
+refinements: metadata reads (``.shape``/``.ndim``/``.dtype``/``.size``)
+of a donated name are legal — JAX deletes the buffer, not the aval —
+and a rebind inside any branch that does not already enclose the
+donating call (a sibling ``if``, a deeper ``if``, a loop body) is
+conditional, so it does not clear the taint; rebind on the call's own
+unconditional continuation (or suppress with a reason) to satisfy the
+rule.  Reads in branches mutually exclusive with the call's (the other
+arm of its ``if``/``match``) are never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+ScopeNode = ast.AST  # Module | FunctionDef | AsyncFunctionDef
+
+
+#: per-scope entry: name -> (donated positions, the BINDING statement)
+DonationTable = Dict[str, Tuple[Set[int], ast.stmt]]
+
+
+def _donation_tables(tree: ast.Module) -> Dict[ScopeNode, DonationTable]:
+    """Per-scope tables (the Module node is a scope like any other):
+    name -> (donated argument positions, binding statement).  The
+    binding statement lets the checker ignore entries superseded by a
+    later rebind of the same name."""
+    tbls: Dict[ScopeNode, DonationTable] = {}
+
+    def scan(scope: ScopeNode) -> None:
+        table = tbls.setdefault(scope, {})
+        for stmt, _depth in _scope_statements(scope):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and astutil.is_jit_reference(stmt.value.func):
+                donated = astutil.donated_argnums(stmt.value)
+                if donated:
+                    table[stmt.targets[0].id] = (donated, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                # descend so METHOD bodies get their own local tables
+                # (class-level donating assigns are only callable via
+                # attribute access, which this rule doesn't track)
+                scan(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in stmt.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        jit_like = astutil.is_jit_reference(dec.func) or (
+                            astutil.dotted_name(dec.func) is not None
+                            and astutil.dotted_name(dec.func)
+                            .rsplit(".", 1)[-1] == "partial"
+                            and dec.args
+                            and astutil.is_jit_reference(dec.args[0]))
+                        if jit_like:
+                            donated = astutil.donated_argnums(dec)
+                            if donated:
+                                table[stmt.name] = (donated, stmt)
+                scan(stmt)
+
+    scan(tree)
+    return tbls
+
+
+def _binds_name(stmt: ast.stmt, name: str) -> bool:
+    """Does this statement (re)bind ``name`` in ITS OWN scope?  Nested
+    function/class bodies are separate scopes and don't count (a def's
+    NAME binding does)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return stmt.name == name
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        if isinstance(node, ast.alias):
+            if (node.asname or node.name).split(".")[0] == name:
+                return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+    return False
+
+
+def _child_stmt_groups(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """The statement lists nested one level under ``stmt`` (if/loop
+    bodies, else branches, try handlers/finally, match case bodies)."""
+    groups: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        group = getattr(stmt, field, None)
+        if group:
+            groups.append(list(group))
+    for handler in getattr(stmt, "handlers", []) or []:
+        groups.append(list(handler.body))
+    for case in getattr(stmt, "cases", []) or []:
+        groups.append(list(case.body))
+    return groups
+
+
+def _subtree_statements(stmts: List[ast.stmt]) -> Set[int]:
+    """ids of every statement nested anywhere under ``stmts``."""
+    out: Set[int] = set()
+    stack = list(stmts)
+    while stack:
+        s = stack.pop()
+        out.add(id(s))
+        if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            for group in _child_stmt_groups(s):
+                stack.extend(group)
+    return out
+
+
+def _ancestor_map(body: List[ast.stmt]) -> Dict[int, Set[int]]:
+    """id(stmt) -> ids of the compound statements enclosing it (within
+    this scope).  A later write KILLS the donation taint only when its
+    ancestors are a subset of the call's — i.e. it sits on the call's
+    own continuation, not inside some new branch that may not run."""
+    out: Dict[int, Set[int]] = {}
+
+    def build(stmts: List[ast.stmt], stack: Set[int]) -> None:
+        for s in stmts:
+            out[id(s)] = set(stack)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for group in _child_stmt_groups(s):
+                build(group, stack | {id(s)})
+
+    build(body, set())
+    return out
+
+
+def _exclusive_with(body: List[ast.stmt], call_stmt: ast.stmt) -> Set[int]:
+    """ids of statements in branches MUTUALLY EXCLUSIVE with the one
+    holding ``call_stmt``: the other arm of every enclosing ``if`` and
+    the other cases of every enclosing ``match``.  A read there runs
+    only when the donating call didn't, so it must not be flagged."""
+    excluded: Set[int] = set()
+
+    def visit(stmts: List[ast.stmt]) -> bool:
+        found = False
+        for s in stmts:
+            if s is call_stmt:
+                found = True
+                continue
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            groups = _child_stmt_groups(s)
+            hits = [visit(g) for g in groups]
+            if any(hits):
+                found = True
+                if isinstance(s, (ast.If, ast.Match)):
+                    for g, hit in zip(groups, hits):
+                        if not hit:
+                            excluded.update(_subtree_statements(g))
+        return found
+
+    visit(body)
+    return excluded
+
+
+def _scope_statements(scope: ScopeNode
+                      ) -> Iterator[Tuple[ast.stmt, int]]:
+    """All (statement, nesting depth) of ``scope`` in source order,
+    descending into compound statements but NOT into nested
+    function/class scopes.  Depth 0 is the scope's own body; each
+    if/for/while/try body adds one."""
+    body = scope.body if hasattr(scope, "body") else []
+    stack: List[Tuple[ast.stmt, int]] = [(s, 0) for s in reversed(body)]
+    while stack:
+        stmt, depth = stack.pop()
+        yield stmt, depth
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        children: List[ast.stmt] = []
+        for group in _child_stmt_groups(stmt):
+            children.extend(group)
+        stack.extend((c, depth + 1) for c in reversed(children))
+
+
+def _immediate_walk(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Walk ``stmt`` at THIS-statement granularity.
+
+    Child statements of compound statements (for/if/while/try bodies)
+    are their own entries in the scope statement list, so descending
+    into them here would attribute their reads/calls to the header
+    statement too.  Nested function/class bodies, by contrast, are NOT
+    separate entries — a nested def is one statement whose closure
+    captures names — so once a scope node is entered the walk covers
+    its whole subtree.
+    """
+    scope_types = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.Lambda)
+    stack: List[Tuple[ast.AST, bool]] = [(stmt, False)]
+    while stack:
+        node, inside_scope = stack.pop()
+        yield node
+        entering = inside_scope or isinstance(node, scope_types)
+        for child in ast.iter_child_nodes(node):
+            if not entering and isinstance(child, ast.stmt):
+                continue
+            stack.append((child, entering))
+
+
+def _name_events(stmt: ast.stmt, name: str) -> Tuple[bool, bool]:
+    """(reads, writes) of ``name`` attributable to this statement; a
+    nested def capturing a dead buffer counts as a read, a bare
+    metadata access (``name.shape`` — JAX frees the buffer, not the
+    aval) does not."""
+    nodes = list(_immediate_walk(stmt))
+    metadata = astutil.metadata_only_names(nodes)
+    reads = writes = False
+    for node in nodes:
+        if isinstance(node, ast.Name) and node.id == name:
+            if isinstance(node.ctx, ast.Load):
+                reads = reads or id(node) not in metadata
+            else:
+                writes = True
+    return reads, writes
+
+
+@register
+class UseAfterDonateRule(Rule):
+    name = "use-after-donate"
+    severity = "error"
+    description = ("variable read after being passed in a donated "
+                   "argument position (its buffer is deleted)")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        tbls = _donation_tables(tree)
+        scopes: List[ScopeNode] = [tree]
+        scopes.extend(n for n in ast.walk(tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)))
+        for scope in scopes:
+            local = tbls.get(scope, {})
+            outer = tbls.get(tree, {}) if scope is not tree else {}
+            # even with no tracked names the scope can contain the
+            # direct form cached_jit(f, donate_argnums=...)(x)
+            yield from self._check_scope(scope, local, outer, posix_path)
+
+    def _check_scope(self, scope: ScopeNode, local: DonationTable,
+                     outer: DonationTable, posix_path: str
+                     ) -> Iterator[Finding]:
+        stmts = list(_scope_statements(scope))
+        shadowed = astutil.local_bindings(scope) \
+            if not isinstance(scope, ast.Module) else set()
+        for i, (stmt, _depth) in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for call in _immediate_walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                if isinstance(call.func, ast.Name):
+                    donated = self._resolve_donation(
+                        call.func.id, stmts, i, stmt, local, outer,
+                        shadowed)
+                    if donated is None:
+                        continue
+                    label = call.func.id
+                elif isinstance(call.func, ast.Call) \
+                        and astutil.is_jit_reference(call.func.func):
+                    # direct form: cached_jit(f, donate_argnums=...)(x)
+                    donated = astutil.donated_argnums(call.func)
+                    label = astutil.dotted_name(call.func.func) or "jit"
+                    if not donated:
+                        continue
+                else:
+                    continue
+                for pos, arg in enumerate(call.args):
+                    if pos in donated and isinstance(arg, ast.Name):
+                        yield from self._track(
+                            stmts, i, stmt, call, label, arg.id,
+                            posix_path)
+
+    @staticmethod
+    def _resolve_donation(name: str, stmts, call_idx: int,
+                          call_stmt: ast.stmt, local: DonationTable,
+                          outer: DonationTable, shadowed: Set[str]
+                          ) -> Optional[Set[int]]:
+        """Donated positions for calling ``name`` here, or None.
+
+        The table entry only holds if its binding statement is the LAST
+        binding of the name before the call — a rebind to a plain
+        callable supersedes it.  A module-level entry applies only when
+        the name is not shadowed by any local binding (params included;
+        Python scoping makes the name local for the whole function the
+        moment it's assigned anywhere in it).
+        """
+        entry = local.get(name)
+        if entry is not None:
+            donated, binder = entry
+            last = None
+            for stmt, _d in stmts[:call_idx]:
+                if _binds_name(stmt, name):
+                    last = stmt
+            return donated if last is binder else None
+        entry = outer.get(name)
+        if entry is not None and name not in shadowed:
+            return entry[0]
+        return None
+
+    def _track(self, stmts: List[Tuple[ast.stmt, int]], call_idx: int,
+               call_stmt: ast.stmt, call: ast.Call, label: str,
+               name: str, posix_path: str) -> Iterator[Finding]:
+        # reads in the SAME statement that evaluate after the call —
+        # Python evaluates left to right, so a load positioned past the
+        # call's end (``out = step(params, b) + loss(params)``) reads
+        # the already-deleted buffer even though the statement may also
+        # rebind the name afterwards
+        call_end = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+        in_call = {id(n) for n in ast.walk(call)}
+        stmt_nodes = list(_immediate_walk(call_stmt))
+        metadata = astutil.metadata_only_names(stmt_nodes)
+        for node in stmt_nodes:
+            if isinstance(node, ast.Name) and node.id == name \
+                    and isinstance(node.ctx, ast.Load) \
+                    and id(node) not in in_call \
+                    and id(node) not in metadata \
+                    and (node.lineno, node.col_offset) > call_end:
+                yield self.finding(
+                    posix_path, node,
+                    f"{name!r} read after being donated to {label}() at "
+                    f"line {call.lineno} — the buffer is deleted; copy "
+                    "before the call or rebind from the result")
+                return
+        # the donating statement's own assignment targets rebind the name
+        # (the loop-threading idiom: ``x, s = step(x, s)``)
+        if isinstance(call_stmt, ast.Assign):
+            for tgt in call_stmt.targets:
+                for node in ast.walk(tgt):
+                    if isinstance(node, ast.Name) and node.id == name:
+                        return
+        elif isinstance(call_stmt, (ast.AugAssign, ast.AnnAssign)) \
+                and isinstance(call_stmt.target, ast.Name) \
+                and call_stmt.target.id == name:
+            return
+        top = [s for s, d in stmts if d == 0]
+        exclusive = _exclusive_with(top, call_stmt)
+        ancestors = _ancestor_map(top)
+        call_anc = ancestors.get(id(call_stmt), set())
+        for later, _depth in stmts[call_idx + 1:]:
+            if id(later) in exclusive:
+                continue
+            reads, writes = _name_events(later, name)
+            if reads:
+                yield self.finding(
+                    posix_path, later,
+                    f"{name!r} read after being donated to {label}() at "
+                    f"line {call.lineno} — the buffer is deleted; copy "
+                    "before the call or rebind from the result")
+                return
+            if writes and ancestors.get(id(later), set()) <= call_anc:
+                # a rebind inside ANY branch not already enclosing the
+                # call (a sibling if, a deeper if, a loop body) is
+                # conditional — the taint survives the branch-not-taken
+                # path; only a write on the call's own unconditional
+                # continuation clears it
+                return
