@@ -320,15 +320,18 @@ def bench_lenet(batch_size: int = 128, steps: int = 64, epochs: int = 64,
     # specializes on the stacked leading dim (and on the static epoch
     # count), so a different length would put a fresh compile inside the
     # timing window
-    net.fit_backprop([batch] * steps, num_epochs=1)            # compile E=1
-    net.fit_backprop([batch] * steps, num_epochs=epochs)       # compile E=N
+    # mesh=None: this row measures SINGLE-chip throughput (the metric is
+    # per-chip); letting the 8-virtual-device CPU proxy auto-shard would
+    # change what the row has measured since round 1
+    net.fit_backprop([batch] * steps, num_epochs=1, mesh=None)  # compile E=1
+    net.fit_backprop([batch] * steps, num_epochs=epochs, mesh=None)
     true_sync()
     t0 = time.perf_counter()
-    net.fit_backprop([batch] * steps, num_epochs=1)
+    net.fit_backprop([batch] * steps, num_epochs=1, mesh=None)
     true_sync()
     w1 = time.perf_counter() - t0
     t0 = time.perf_counter()
-    net.fit_backprop([batch] * steps, num_epochs=epochs)
+    net.fit_backprop([batch] * steps, num_epochs=epochs, mesh=None)
     true_sync()
     we = time.perf_counter() - t0
     dev_sps = batch_size * steps * epochs / we
@@ -359,10 +362,10 @@ def bench_lenet(batch_size: int = 128, steps: int = 64, epochs: int = 64,
     # device_put is async, so the H2D DMA of batch k+1 rides under the
     # device compute of step k instead of under the dispatch
     it = PrefetchIterator(inner, depth=2, device=jax.devices()[0])
-    net.fit_iterator(it, num_epochs=1)                 # compile + warm path
+    net.fit_iterator(it, num_epochs=1, mesh=None)      # compile + warm path
     true_sync()
     t0 = time.perf_counter()
-    net.fit_iterator(it, num_epochs=ing_epochs)
+    net.fit_iterator(it, num_epochs=ing_epochs, mesh=None)
     true_sync()
     wi = time.perf_counter() - t0
     n_batches = inner.batches_per_epoch * ing_epochs
@@ -565,99 +568,226 @@ def _bench_dcn_two_process(d: int = 256, per_shard_batch: int = 64,
                                          1)}
 
 
-def bench_scaling(ndp: int = 8, steps: int = 20, warmup: int = 3,
-                  d: int = 256, per_shard_batch: int = 64):
-    """Gradient-sharing DP cost on N shards, measured honestly.
-
-    Round-2 lesson: on the virtual-CPU proxy all shards share the host's
-    cores, so a 1->N "scaling efficiency" number measures core contention,
-    not scaling, and reads as a false regression.  Instead this runs the
-    SAME N-shard step twice under identical contention — once with the
-    gradient all-reduce (pmean over `data`, i.e. grad sharing), once with
-    shard-local updates only (stacked per-shard params, zero collectives)
-    — and reports value = t_local / t_collective: the fraction of step
-    time NOT spent on the collective (1.0 = the allreduce is free).  On
-    real multi-chip hardware the same ratio isolates ICI allreduce
-    overhead.  A 2-process jax.distributed variant (DCN path over gRPC)
-    is smoke-measured when the environment supports it."""
-    import jax
+def _dp_fit_fixture(d: int, hidden, n_out: int, batch: int, n_batches: int,
+                    grad_accum: int = 1, seed: int = 0):
+    """(conf, batches) for the dp_fit/scaling rows: a plain tanh/softmax
+    MLP (no dropout/BN, so the sharded and single-device programs are
+    mathematically identical) over a deterministic dataset."""
+    import numpy as np
     import jax.numpy as jnp
-    from deeplearning4j_tpu.compat import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(d).lr(0.05).momentum(0.5).use_adagrad(False)
+            .num_iterations(1).activation("tanh")
+            .list(3).hidden_layer_sizes(*hidden)
+            .override(2, kind=LayerKind.OUTPUT, n_out=n_out,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).grad_accum(grad_accum).build())
+    rng = np.random.RandomState(seed)
+    batches = [DataSet(jnp.asarray(rng.randn(batch, d).astype(np.float32)),
+                       jnp.asarray(np.eye(n_out, dtype=np.float32)[
+                           rng.randint(0, n_out, batch)]))
+               for _ in range(n_batches)]
+    return conf, batches
+
+
+def _time_fit(fit_fn, reps: int = 3):
+    """BEST-OF-``reps`` wall time of ``fit_fn()`` (which must return its
+    trained params for the block_until_ready sync).  Minimum, not mean:
+    on the shared-core CI host a single rep can absorb multi-second
+    scheduler stalls that swamp the measured path; the min is the
+    reproducible cost of the code itself."""
+    import jax
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fit_fn()
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_scaling(ndp: int = 8, n_batches: int = 16, num_epochs: int = 4,
+                  per_shard_batch: int = 32, d: int = 128):
+    """Real N-device scaling efficiency, measured from the dp_fit path
+    (replacing the old collective-fraction row that clamped to a
+    constant 1.0): the SAME scanned-epoch fit over the SAME global
+    batches, once single-device and once sharded over ``ndp`` devices,
+    value = t_single / t_sharded.
+
+    Honesty note (the round-2 lesson still applies): on the forced-CPU
+    proxy all shards share one host's cores, so the IDEAL here is 1.0 —
+    equal total compute, sharding/collective overhead pushes the ratio
+    below it.  On real multi-chip hardware the same two timings give
+    true scaling (ideal ``ndp``); the row reports both raw times so
+    either reading is available.  A 2-process jax.distributed variant
+    (DCN path over gRPC) is smoke-measured when the environment
+    supports it."""
+    import jax
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 
     platform, kind, n_dev = _platform_info()
     ndp = min(ndp, n_dev)
+    if ndp < 2:
+        return {"metric": "dp_fit_scaling_efficiency", "value": None,
+                "unit": "skipped", "error": f"needs >= 2 devices, "
+                f"have {n_dev}"}
     mesh = make_mesh(MeshSpec(data=ndp), devices=jax.devices()[:ndp])
-
-    def loss_fn(params, x, y):
-        h = jnp.tanh(x @ params["w1"] + params["b1"])
-        logits = h @ params["w2"] + params["b2"]
-        return jnp.mean((logits - y) ** 2)
-
-    params = {
-        "w1": jax.random.normal(jax.random.key(0), (d, d)) * 0.05,
-        "b1": jnp.zeros((d,)),
-        "w2": jax.random.normal(jax.random.key(1), (d, d)) * 0.05,
-        "b2": jnp.zeros((d,)),
-    }
-    # per-shard params copies, stacked on the data axis: both variants run
-    # the identical local program; they differ ONLY by the gradient pmean
-    stacked = jax.tree.map(
-        lambda a: jnp.broadcast_to(a[None], (ndp,) + a.shape), params)
     B = per_shard_batch * ndp
-    x = jax.random.normal(jax.random.key(2), (B, d))
-    y = jax.random.normal(jax.random.key(3), (B, d))
+    conf, batches = _dp_fit_fixture(d, (256, 128), 10, B, n_batches)
 
-    def make_step(share_grads: bool):
-        def inner(p, xs, ys):
-            p0 = jax.tree.map(lambda l: l[0], p)
-            g = jax.grad(loss_fn)(p0, xs, ys)
-            if share_grads:
-                g = jax.lax.pmean(g, "data")
-            newp = jax.tree.map(lambda a, gg: a - 0.01 * gg, p0, g)
-            return jax.tree.map(lambda l: l[None], newp)
+    def timed(mesh_arg):
+        net = MultiLayerNetwork(conf).init(seed=0)
+        net.fit_backprop(batches, num_epochs=num_epochs, mesh=mesh_arg)
+        # warm (compiles banked); the timed run reuses the engine entry
+        net = MultiLayerNetwork(conf).init(seed=0)
+        return _time_fit(lambda: (net.fit_backprop(
+            batches, num_epochs=num_epochs, mesh=mesh_arg), net.params)[1])
 
-        spec = P("data")
-        return jax.jit(shard_map(inner, mesh=mesh,
-                                 in_specs=(spec, spec, spec),
-                                 out_specs=spec, check_vma=False))
-
-    # host-side timing harness AROUND the jitted step, not traced code:
-    # the float() syncs and perf_counter() reads ARE the measurement
-    def time_step(fn):  # jaxlint: disable=impure-jit,host-sync-in-hot-path — timing harness
-        p = stacked
-        for _ in range(warmup):
-            p = fn(p, x, y)
-        float(jax.tree.leaves(p)[0].ravel()[0])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            p = fn(p, x, y)
-        float(jax.tree.leaves(p)[0].ravel()[0])
-        return (time.perf_counter() - t0) / steps
-
-    t_coll = time_step(make_step(True))
-    t_local = time_step(make_step(False))
-    frac = min(t_local / t_coll, 1.0)
+    t_single = timed(None)
+    t_shard = timed(mesh)
+    eff = t_single / t_shard
+    steps = n_batches * num_epochs
     out = {
-        "metric": f"grad_sharing_dp_compute_fraction_{ndp}shard",
-        "value": round(frac, 3),
-        "unit": "frac_of_step_not_collective",
-        "vs_baseline": round(frac, 3),  # target: near 1.0 (allreduce free)
+        "metric": f"dp_fit_scaling_efficiency_{ndp}shard",
+        "value": round(eff, 3),
+        "unit": "t_single_over_t_sharded",
+        "vs_baseline": round(eff, 3),
         "platform": platform,
         "n_devices": n_dev,
-        "config_sig": f"dp{ndp}_d{d}_b{per_shard_batch}_s{steps}",
-        "step_ms_collective": round(t_coll * 1e3, 3),
-        "step_ms_local_only": round(t_local * 1e3, 3),
-        "samples_per_sec_collective": round(B / t_coll, 1),
-        "note": "same N-shard program +/- the gradient pmean under "
-                "identical core contention; see docstring",
+        "config_sig": f"dp{ndp}_d{d}_b{per_shard_batch}_nb{n_batches}"
+                      f"_e{num_epochs}",
+        "fit_ms_single_device": round(t_single * 1e3, 1),
+        "fit_ms_sharded": round(t_shard * 1e3, 1),
+        "samples_per_sec_sharded": round(steps * B / t_shard, 1),
+        "samples_per_sec_single": round(steps * B / t_single, 1),
+        "note": "same scanned fit single-device vs sharded on shared "
+                "cores: ideal 1.0 here, ideal N on real chips; see "
+                "docstring",
     }
     dcn = _bench_dcn_two_process(d=d, per_shard_batch=per_shard_batch)
     if dcn:
         out.update(dcn)
     else:
         out["dcn"] = "2-process jax.distributed unavailable here"
+    return out
+
+
+def bench_dp_fit(ndp: int = 8, per_shard_batch: int = 16,
+                 n_batches: int = 32, num_epochs: int = 8, d: int = 32):
+    """Mesh-sharded scanned training row (the PR 5 tentpole): the same
+    data-parallel workload three ways —
+
+    1. the per-batch ``DataParallelTrainer.fit`` dispatch loop (one XLA
+       program per batch, the pre-scanning scaleout path);
+    2. the scanned sharded epoch (``MultiLayerNetwork.fit_backprop``
+       under the mesh): ONE dispatch for the whole fit;
+    3. the microbatch gradient-accumulation curve (``grad_accum`` in
+       1/2/4/8 at the same effective batch).
+
+    Acceptance evidence carried in the row: ``compile_delta`` == 0 for
+    the timed scanned fits (one compile per config, banked at warmup),
+    ``scan_speedup_vs_perbatch`` >= 2, and the sharded result
+    bit-identical to a single-device fit at equal effective batch
+    (mesh-of-N, accum=1 vs mesh=None, accum=N — the masked sum-loss
+    formulation makes the reduction order identical)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.updaters import dl4j_updater
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.runtime.metrics import compile_metrics, dp_metrics
+
+    platform, kind, n_dev = _platform_info()
+    ndp = min(ndp, n_dev)
+    if ndp < 2:
+        return {"metric": "dp_fit_scan_speedup", "value": None,
+                "unit": "skipped", "error": f"needs >= 2 devices, "
+                f"have {n_dev}"}
+    mesh = make_mesh(MeshSpec(data=ndp), devices=jax.devices()[:ndp])
+    B = per_shard_batch * ndp
+    conf, batches = _dp_fit_fixture(d, (64, 32), 10, B, n_batches)
+    steps = n_batches * num_epochs
+
+    # -- 1. per-batch dispatch loop (DataParallelTrainer, scan=False) ------
+    loss_net = MultiLayerNetwork(conf).init(seed=0)
+
+    def loss_fn(p, x, y, key):
+        return loss_net.loss(p, x, y)
+
+    trainer = DataParallelTrainer(
+        loss_fn, dl4j_updater(lr=0.05, momentum=0.5, use_adagrad=False),
+        mesh)
+    pb = [(b.features, b.labels) for b in batches]
+    key = jax.random.key(1)
+    trainer.fit(loss_net.params, pb[:2], key, scan=False)       # warm
+    t_loop = _time_fit(lambda: trainer.fit(
+        loss_net.params, pb, key, scan=False, num_epochs=num_epochs))
+
+    # -- 2. scanned sharded epochs (ONE dispatch per fit) ------------------
+    warm = MultiLayerNetwork(conf).init(seed=0)
+    warm.fit_backprop(batches, num_epochs=num_epochs, mesh=mesh)
+    before = compile_metrics.snapshot()["compile_count"]
+    dp_metrics.reset()
+    net = MultiLayerNetwork(conf).init(seed=0)
+    t_scan = _time_fit(lambda: (net.fit_backprop(
+        batches, num_epochs=num_epochs, mesh=mesh), net.params)[1])
+    compile_delta = compile_metrics.snapshot()["compile_count"] - before
+    dp_snap = dp_metrics.snapshot()
+
+    # -- 3. bit-equivalence: mesh-of-N vs single-device at equal
+    #       effective batch (grad_accum = N microbatches of the shard size)
+    conf_acc, _ = _dp_fit_fixture(d, (64, 32), 10, B, n_batches,
+                                  grad_accum=ndp)
+    nA = MultiLayerNetwork(conf).init(seed=3)
+    nA.fit_backprop(batches, num_epochs=2, mesh=mesh)
+    nB = MultiLayerNetwork(conf_acc).init(seed=3)
+    nB.fit_backprop(batches, num_epochs=2, mesh=None)
+    max_diff = float(jnp.max(jnp.abs(nA.params_flat() - nB.params_flat())))
+
+    # -- 4. microbatch gradient-accumulation throughput curve --------------
+    accum_curve = {}
+    for accum in (1, 2, 4, 8):
+        conf_k, _ = _dp_fit_fixture(d, (64, 32), 10, B, n_batches,
+                                    grad_accum=accum)
+        wnet = MultiLayerNetwork(conf_k).init(seed=0)
+        wnet.fit_backprop(batches, num_epochs=2, mesh=mesh)     # warm
+        tnet = MultiLayerNetwork(conf_k).init(seed=0)
+        t_k = _time_fit(lambda: (tnet.fit_backprop(
+            batches, num_epochs=2, mesh=mesh), tnet.params)[1], reps=2)
+        accum_curve[f"samples_per_sec_accum{accum}"] = round(
+            2 * n_batches * B / t_k, 1)
+
+    speedup = t_loop / t_scan
+    out = {
+        "metric": f"dp_fit_scan_speedup_{ndp}shard",
+        "value": round(speedup, 2),
+        "unit": "x_vs_perbatch_dispatch",
+        "vs_baseline": round(speedup, 2),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"dp{ndp}_d{d}_b{per_shard_batch}_nb{n_batches}"
+                      f"_e{num_epochs}",
+        "fit_ms_perbatch_loop": round(t_loop * 1e3, 1),
+        "fit_ms_scanned": round(t_scan * 1e3, 1),
+        "samples_per_sec_scanned": round(steps * B / t_scan, 1),
+        "samples_per_sec_perbatch": round(steps * B / t_loop, 1),
+        # acceptance: the warmed scanned fit must not retrace
+        "compile_delta": compile_delta,
+        "steps_per_dispatch": dp_snap["steps_per_dispatch"],
+        "ingest_bytes_staged": dp_snap["bytes_staged"],
+        "ingest_stage_ms": dp_snap["stage_ms"],
+        "bit_identical_vs_single_device": max_diff == 0.0,
+        "max_abs_diff_vs_single_device": max_diff,
+        "effective_batch": B,
+    }
+    out.update(accum_curve)
     return out
 
 
@@ -1006,8 +1136,10 @@ def bench_resilience(batch_size: int = 64, n_batches: int = 16,
         batches.append(DataSet(jnp.asarray(x), jnp.asarray(y)))
 
     net = MultiLayerNetwork(conf).init(seed=0)
-    # warmup: compile the guarded step outside the timed window
-    net.fit_backprop(batches[0], num_epochs=2)
+    # warmup: compile the guarded step outside the timed window —
+    # mesh=None so the warm compile is the SAME single-device step
+    # ResilientFit (mesh=None default) drives in the timed window
+    net.fit_backprop(batches[0], num_epochs=2, mesh=None)
     before = compile_metrics.snapshot()["compile_count"]
     resilience_metrics.reset()
     with tempfile.TemporaryDirectory() as ckdir:
@@ -1164,7 +1296,10 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "resilience": bench_resilience,
          # inference serving row: eager-vs-engine throughput, p50/p99
          # under concurrent load, steady-state compile_delta == 0
-         "serving": bench_serving}
+         "serving": bench_serving,
+         # sharded scanned training: scanned-vs-per-batch speedup,
+         # scaling efficiency, grad_accum curve, bit-equivalence
+         "dp_fit": bench_dp_fit}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
@@ -1181,7 +1316,9 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
             "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
             "resnet_s2d": (1800, 0), "resilience": (300, 240),
-            "serving": (420, 300)}
+            "serving": (420, 300),
+            # dp_fit needs >= 2 devices: cpu-only like scaling
+            "dp_fit": (0, 900)}
 
 
 # -- perf-regression guard --------------------------------------------------
@@ -1530,8 +1667,8 @@ def main() -> None:
     headline = run_config("bert", tpu_ok)
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
-    names = ["serving", "lenet", "resnet", "longctx", "word2vec", "glove",
-             "scaling", "w2v_dp"]
+    names = ["serving", "dp_fit", "lenet", "resnet", "longctx", "word2vec",
+             "glove", "scaling", "w2v_dp"]
     if tpu_ok:
         # tpu-only capability point LAST: if the suite budget runs out it
         # is the row sacrificed, never the production throughput metrics

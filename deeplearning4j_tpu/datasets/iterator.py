@@ -229,28 +229,68 @@ class PrefetchIterator(DataSetIterator):
     """Background-thread prefetch + async device_put: a producer thread
     pulls batches from the inner iterator and stages them (optionally onto a
     device — ``device_put`` is async, so the H2D DMA overlaps compute) into
-    a bounded queue, keeping the TPU fed while the host prepares data."""
+    a bounded queue, keeping the TPU fed while the host prepares data.
+
+    Mesh-aware staging: pass ``sharding`` (a ``NamedSharding`` with the
+    batch axis over ``data``, e.g. ``parallel/sharded_fit.batch_sharding``)
+    and the producer stages each batch PRE-SHARDED — the H2D transfer IS
+    the scatter, each device receives only its slice, and the sharded
+    train step finds its shard resident.  ``pad_rows_to`` zero-pads each
+    batch's example axis up to that multiple BEFORE staging (padding
+    after staging would be a second transfer); the batch's real row
+    count rides along as ``DataSet.n_valid`` for the masked-loss
+    contract (``parallel/mesh.pad_global_batch``).  Every staged batch
+    books bytes + submission wall-ms into
+    ``runtime.metrics.dp_metrics``."""
 
     _STOP = object()
 
     def __init__(self, inner: DataSetIterator, depth: int = 2,
-                 device: Optional[jax.Device] = None):
+                 device: Optional[jax.Device] = None,
+                 sharding=None, pad_rows_to: int = 0):
         super().__init__(inner.batch)
         self.inner = inner
         self.depth = depth
         self.device = device
+        self.sharding = sharding
+        self.pad_rows_to = pad_rows_to
         self._queue: Optional["queue.Queue"] = None
         self._thread: Optional[threading.Thread] = None
         self._stop: Optional[threading.Event] = None
         self._peeked: Optional[DataSet] = None
         self._done = False
 
+    def _stage(self, ds: DataSet) -> DataSet:
+        """Pad + device_put one batch onto the mesh (producer thread)."""
+        import time
+
+        from deeplearning4j_tpu.runtime.metrics import dp_metrics
+
+        from deeplearning4j_tpu.parallel.mesh import pad_rows
+
+        n_valid = ds.features.shape[0]
+        x, y = ds.features, ds.labels
+        if self.pad_rows_to > 1 and n_valid % self.pad_rows_to != 0:
+            target = -(-n_valid // self.pad_rows_to) * self.pad_rows_to
+            x = pad_rows(x, target)
+            y = pad_rows(y, target)
+        t0 = time.perf_counter()
+        x = jax.device_put(x, self.sharding)
+        y = jax.device_put(y, self.sharding)
+        dp_metrics.note_staged(x.nbytes + y.nbytes,
+                               (time.perf_counter() - t0) * 1e3)
+        staged = DataSet(x, y)
+        staged.n_valid = n_valid
+        return staged
+
     def _producer(self, q, stop) -> None:
         import queue as _queue
         try:
             while self.inner.has_next() and not stop.is_set():
                 ds = self.inner.next()
-                if self.device is not None:
+                if self.sharding is not None:
+                    ds = self._stage(ds)
+                elif self.device is not None:
                     ds = DataSet(jax.device_put(ds.features, self.device),
                                  jax.device_put(ds.labels, self.device))
                 while not stop.is_set():

@@ -255,6 +255,14 @@ class ListBuilder:
         self._mlc_kwargs["backprop"] = flag
         return self
 
+    def grad_accum(self, k: int) -> "ListBuilder":
+        """Microbatch gradient-accumulation factor (see
+        MultiLayerConfiguration.grad_accum)."""
+        if k < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {k}")
+        self._mlc_kwargs["grad_accum"] = int(k)
+        return self
+
     def input_preprocessor(self, layer: int, name: str, **kw) -> "ListBuilder":
         self._mlc_kwargs.setdefault("input_preprocessors", {})[layer] = \
             {"name": name, **kw}
@@ -280,6 +288,11 @@ class MultiLayerConfiguration:
     pretrain: bool = True
     backprop: bool = False
     use_drop_connect: bool = False
+    #: microbatch gradient accumulation: each train step splits its batch
+    #: into ``grad_accum`` microbatches, scanned with fp32 sum-accumulated
+    #: gradients and ONE update at the end — effective batch = micro x
+    #: accum x n_devices at the HBM footprint of one microbatch
+    grad_accum: int = 1
     # layer index -> preprocessor spec {"name": ..., **kwargs}
     input_preprocessors: Dict[int, Dict[str, Any]] = field(default_factory=dict)
     output_preprocessors: Dict[int, Dict[str, Any]] = field(default_factory=dict)
@@ -298,6 +311,7 @@ class MultiLayerConfiguration:
             "pretrain": self.pretrain,
             "backprop": self.backprop,
             "use_drop_connect": self.use_drop_connect,
+            "grad_accum": self.grad_accum,
             "input_preprocessors": {str(k): v for k, v in self.input_preprocessors.items()},
             "output_preprocessors": {str(k): v for k, v in self.output_preprocessors.items()},
         }
@@ -310,6 +324,7 @@ class MultiLayerConfiguration:
             pretrain=bool(d.get("pretrain", True)),
             backprop=bool(d.get("backprop", False)),
             use_drop_connect=bool(d.get("use_drop_connect", False)),
+            grad_accum=int(d.get("grad_accum", 1)),
             input_preprocessors={int(k): v for k, v in d.get("input_preprocessors", {}).items()},
             output_preprocessors={int(k): v for k, v in d.get("output_preprocessors", {}).items()},
         )

@@ -43,6 +43,26 @@ class OutputLayer(Layer):
             return L.sigmoid_binary_cross_entropy_with_logits(labels, z)
         return L.score(labels, lf, self.activation(z))
 
+    def per_example_loss_from_logits(self, z: Array, labels: Array) -> Array:
+        """Unreduced ``[B]`` row losses (``loss_from_logits`` is their
+        mean).  The sharded/microbatched train step sums these under a
+        validity mask and divides by the REAL row count, so zero-padded
+        trailing-batch rows contribute nothing to loss or gradient."""
+        lf = L.LossFunction(self.conf.loss_function)
+        act = self.conf.activation
+        if act == "softmax" and lf in (L.LossFunction.MCXENT,
+                                       L.LossFunction.NEGATIVELOGLIKELIHOOD):
+            return L.per_example_softmax_cross_entropy_with_logits(labels, z)
+        if act == "sigmoid" and lf is L.LossFunction.XENT:
+            return L.per_example_sigmoid_binary_cross_entropy_with_logits(
+                labels, z)
+        return L.per_example_score(labels, lf, self.activation(z))
+
+    def per_example_loss(self, params: Params, x: Array,
+                         labels: Array) -> Array:
+        return self.per_example_loss_from_logits(self.pre_output(params, x),
+                                                 labels)
+
     def loss(self, params: Params, x: Array, labels: Array) -> Array:
         """Score on (input, labels): activation -> LossFunctions.score
         (OutputLayer.java:68-92).  L2 regularization is NOT added here — it

@@ -25,6 +25,7 @@ from __future__ import annotations
 import io
 import logging
 import math
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -75,8 +76,11 @@ class MultiLayerNetwork:
                          for i, spec in conf.output_preprocessors.items()}
         # compiled-step bundles live in the MODULE-LEVEL engine
         # (runtime/compile_cache.py) keyed on the canonical conf JSON —
-        # per-instance attrs here only memoize the engine lookup
-        self._bp_cache = None
+        # per-instance attrs here only memoize the engine lookup.
+        # _bp_cache maps machinery mode (single-device / per-mesh) to the
+        # engine bundle: mesh-shape+devices are part of the engine key, so
+        # two meshes never silently share a compiled sharded step
+        self._bp_cache: Dict = {}
         self._serving_cache = None
         self._serving_engine_memo = None
 
@@ -418,9 +422,10 @@ class MultiLayerNetwork:
         derived from exactly this."""
         return self.conf.to_json()
 
-    def _backprop_machinery(self):
+    def _backprop_machinery(self, mesh=None):
         """(train_step, train_epochs, updaters) from the MODULE-LEVEL
-        compile engine, keyed on the canonical conf signature.
+        compile engine, keyed on the canonical conf signature (plus the
+        mesh signature on the sharded path).
 
         The jitted step closes over conf-derived state only, so N
         identically-configured networks — e.g. the worker replicas
@@ -431,14 +436,35 @@ class MultiLayerNetwork:
         contract as the reference's init()-once lifecycle; the engine
         key would otherwise go stale).
 
+        With ``mesh`` (a Mesh with a ``data`` axis) — or whenever
+        ``conf.grad_accum > 1`` — the bundle is the DATA-PARALLEL
+        machinery: steps take ``(x, y, n_valid)`` batch tuples (zero-pad
+        + mask contract, ``parallel/mesh.pad_global_batch``), shard the
+        batch axis over ``data``, psum grads in-graph, and decide guard
+        skips from the COLLECTIVE values so replicas never diverge.
+        Such steps carry ``takes_n_valid = True`` so generic drivers
+        (``ResilientFit``) can adapt.  The engine key grows the mesh
+        signature (axis sizes AND device ids): same conf on two meshes
+        is two entries, never a silent cross-mesh cache hit.
+
         Donation contract: ``train_step`` and ``train_epochs`` donate
         params + updater state, so their HBM is reused in place — the
         fit entry points copy caller params once at the API boundary."""
-        if self._bp_cache is None:
-            self._bp_cache = compile_cache.get_or_build(
-                ("multilayer_backprop", self._conf_signature()),
-                self._build_backprop_machinery)
-        return self._bp_cache
+        from deeplearning4j_tpu.parallel.mesh import mesh_signature
+
+        dp = mesh is not None or self.conf.grad_accum > 1
+        memo_key = ("dp", mesh_signature(mesh)) if dp else "legacy"
+        if memo_key not in self._bp_cache:
+            if dp:
+                self._bp_cache[memo_key] = compile_cache.get_or_build(
+                    ("multilayer_backprop_dp", self._conf_signature(),
+                     mesh_signature(mesh)),
+                    lambda: self._build_dp_machinery(mesh))
+            else:
+                self._bp_cache[memo_key] = compile_cache.get_or_build(
+                    ("multilayer_backprop", self._conf_signature()),
+                    self._build_backprop_machinery)
+        return self._bp_cache[memo_key]
 
     def _build_backprop_machinery(self):
         # Close over a DETACHED replica rebuilt from the conf JSON
@@ -546,8 +572,221 @@ class MultiLayerNetwork:
 
         return (train_step, train_epochs, updaters)
 
+    def _build_dp_machinery(self, mesh):
+        """Data-parallel engine bundle: the scanned-epoch step under a
+        device mesh (batch sharded over ``data``, grads psum'd in-graph,
+        params/updater state replicated) and/or microbatch gradient
+        accumulation (``conf.grad_accum`` inner scan, fp32 sum
+        accumulators, ONE update per step).
+
+        The loss is computed in masked-SUM form — per-example losses
+        times a validity mask, summed, then psum'd with the real row
+        count and divided ONCE — so (a) zero-padded trailing-batch rows
+        contribute nothing to loss or gradient, and (b) shard/microbatch
+        combination is a single global reduction whose math equals the
+        full-batch mean exactly.  The in-step guard then sees the
+        COLLECTIVE (score, grads): one shard's non-finite gradient
+        poisons the psum, so every replica skips the same step and the
+        replicated params cannot diverge."""
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel import sharded_fit
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+        net = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(self._conf_signature()))
+        updaters = [dl4j_updater(
+            lr=c.lr, momentum=c.momentum, momentum_schedule=c.momentum_after,
+            use_adagrad=c.use_adagrad, l2=c.l2,
+            use_regularization=c.use_regularization,
+            constrain_unit_norm=c.constrain_gradient_to_unit_norm,
+        ) for c in net.conf.confs]
+        bn_layers = [i for i, c in enumerate(net.conf.confs)
+                     if c.kind is LayerKind.BATCH_NORM]
+        accum = max(net.conf.grad_accum, 1)
+        axis = DATA_AXIS if mesh is not None else None
+
+        def micro_fn(params, x, y, mask, key):
+            """Masked SUM loss + masked BN-stat sums for one microbatch
+            (the unit both the accumulation scan and the shard psum
+            combine linearly)."""
+            n = len(net.layers)
+            acts = net.feed_forward(params, x, key, train=True, upto=n - 1)
+            h = acts[-1]
+            last = n - 1
+            if last in net._in_pre:
+                h = net._in_pre[last](h, key)
+            per = net.output_layer.per_example_loss(params[-1], h, y)
+            loss_sum = jnp.sum(per * mask)
+            stats = {}
+            for i in bn_layers:
+                h_in = acts[i]
+                m = mask.reshape(mask.shape + (1,) * (h_in.ndim - 1))
+                red = tuple(range(h_in.ndim - 1))
+                # pre-divide by the static spatial extent (conv BN
+                # reduces H*W too) so the step-level combine is just
+                # Σ/row_count: mean = Σ(h)/(rows*spatial)
+                spatial = float(np.prod(h_in.shape[1:-1])) \
+                    if h_in.ndim > 2 else 1.0
+                stats[i] = (jnp.sum(h_in * m, axis=red) / spatial,
+                            jnp.sum(jnp.square(h_in) * m, axis=red)
+                            / spatial)
+            return loss_sum, stats
+
+        def dp_step(params, ustate, batch, key, iteration):
+            x, y, n_valid = batch
+            key = jax.random.fold_in(key, iteration)
+            local = x.shape[0]
+            if axis is not None:
+                # distinct per-shard noise stream (dropout/sampling);
+                # masks are computed against GLOBAL row indices so only
+                # the zero-padded tail is excluded
+                key = jax.random.fold_in(key, lax.axis_index(axis))
+                offset = lax.axis_index(axis) * local
+            else:
+                offset = 0
+            mask = ((offset + jnp.arange(local)) < n_valid) \
+                .astype(jnp.float32)
+            # the GLOBAL valid count is n_valid by construction (padding
+            # only ever extends the tail), so no psum is needed for it
+            count = n_valid.astype(jnp.float32)
+
+            if accum == 1:
+                (loss_sum, stats), grads = jax.value_and_grad(
+                    micro_fn, has_aux=True)(params, x, y, mask, key)
+            else:
+                micro = local // accum
+                xm = x.reshape((accum, micro) + x.shape[1:])
+                ym = y.reshape((accum, micro) + y.shape[1:])
+                mm = mask.reshape(accum, micro)
+
+                def micro_body(carry, inp):
+                    g_acc, s_acc = carry
+                    xi, yi, mi, i = inp
+                    (s, st), g = jax.value_and_grad(
+                        micro_fn, has_aux=True)(
+                            params, xi, yi, mi,
+                            jax.random.fold_in(key, i))
+                    # fp32 sum accumulators: constant-HBM effective
+                    # batch growth regardless of param/compute dtype
+                    g_acc = jax.tree.map(
+                        lambda a, gg: a + gg.astype(jnp.float32), g_acc, g)
+                    return (g_acc, s_acc + s), st
+
+                g0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (grads, loss_sum), stats_seq = lax.scan(
+                    micro_body, (g0, jnp.float32(0.0)),
+                    (xm, ym, mm, jnp.arange(accum)))
+                grads = jax.tree.map(
+                    lambda g, p: g.astype(p.dtype), grads, params)
+                stats = jax.tree.map(lambda s: jnp.sum(s, axis=0),
+                                     stats_seq)
+
+            if axis is not None:
+                loss_sum = lax.psum(loss_sum, axis)
+                grads = jax.tree.map(lambda g: lax.psum(g, axis), grads)
+                stats = jax.tree.map(lambda s: lax.psum(s, axis), stats)
+            denom = jnp.maximum(count, 1.0)
+            score = loss_sum / denom
+            grads = jax.tree.map(lambda g: g / denom, grads)
+
+            new_params, new_ustate = [], []
+            for i, upd in enumerate(updaters):
+                u_i, s_i = upd.update(ustate[i], grads[i], params[i],
+                                      iteration, 1)
+                new_params.append(apply_updates(params[i], u_i))
+                new_ustate.append(s_i)
+            for i in bn_layers:
+                # masked moments over the GLOBAL batch (rows were mask-
+                # weighted, spatial extent pre-divided in micro_fn) —
+                # the sharded EMA refresh sees full-batch statistics,
+                # not one shard's
+                sum_h, sum_h2 = stats[i]
+                mean = sum_h / denom
+                var = sum_h2 / denom - jnp.square(mean)
+                p = dict(new_params[i])
+                p["running_mean"] = 0.9 * p["running_mean"] + 0.1 * mean
+                p["running_var"] = 0.9 * p["running_var"] + 0.1 * var
+                new_params[i] = p
+            new_params, new_ustate, skipped = resilience.guard_update(
+                params, ustate, new_params, new_ustate, (score, grads))
+            return new_params, new_ustate, score, skipped
+
+        batch_specs = (P(DATA_AXIS), P(DATA_AXIS), P()) \
+            if mesh is not None else None
+        train_step = sharded_fit.build_sharded_step(
+            dp_step, mesh, batch_specs=batch_specs,
+            label="multilayer.train_step")
+        train_epochs = sharded_fit.build_scanned_epochs(
+            dp_step, mesh, batch_specs=batch_specs,
+            label="multilayer.train_epochs")
+        train_step.takes_n_valid = True
+        train_epochs.takes_n_valid = True
+        return (train_step, train_epochs, updaters)
+
+    def _resolve_fit_mesh(self, mesh, min_batch: int):
+        """The sharded-by-default policy.  ``mesh="auto"`` (the fit
+        default) picks the all-device ``data`` mesh when it can shard
+        SAFELY: >1 device, every batch holds at least one row per shard,
+        and the conf has no per-replica stochastic state (dropout /
+        DropConnect noise streams and BatchNorm batch statistics become
+        per-shard under sharding — legitimate ghost-batch training, but
+        not something auto-detection should silently switch on).  Pass
+        an explicit ``make_mesh(...)`` to shard those anyway, or
+        ``mesh=None`` to force single-device."""
+        from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS,
+                                                      auto_data_mesh)
+
+        if mesh is None or mesh is False:
+            return None
+        if mesh != "auto":                  # explicit Mesh: caller's call
+            if min_batch < mesh.shape[DATA_AXIS]:
+                raise ValueError(
+                    f"batch of {min_batch} cannot shard over "
+                    f"data-parallel degree {mesh.shape[DATA_AXIS]}: every "
+                    f"device needs at least one example — use a bigger "
+                    f"batch, a smaller mesh, or mesh=None")
+            return mesh
+        m = auto_data_mesh()
+        if m is None or min_batch < m.shape[DATA_AXIS]:
+            return None
+        if any(c.dropout > 0 or c.drop_connect
+               or c.kind is LayerKind.BATCH_NORM for c in self.conf.confs):
+            return None
+        return m
+
+    @staticmethod
+    def _pad_chunk(mesh, accum: int) -> int:
+        """Row-count multiple every dispatched batch is padded to."""
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+        ndp = mesh.shape[DATA_AXIS] if mesh is not None else 1
+        return ndp * max(accum, 1)
+
+    @staticmethod
+    def _pad_rows(arr: Array, target: int) -> Array:
+        from deeplearning4j_tpu.parallel.mesh import pad_rows
+        return pad_rows(arr, target)
+
+    def _check_bn_padding(self, needs_pad: bool) -> None:
+        """Zero-padded rows are exactly masked out of loss, gradients,
+        and the BN EMA refresh — but the training forward inside a
+        BatchNormLayer normalizes with the CURRENT batch's statistics,
+        which the mask cannot reach.  Rather than silently training a
+        BN net on pad-contaminated statistics, refuse the combination
+        (auto-detection never routes BN confs here; this guards the
+        explicit-mesh and grad_accum paths)."""
+        if needs_pad and any(c.kind is LayerKind.BATCH_NORM
+                             for c in self.conf.confs):
+            raise ValueError(
+                "batch size does not divide by data_degree x grad_accum "
+                "and the conf contains BatchNorm: padded rows would "
+                "contaminate BN's in-batch normalization statistics — "
+                "use divisible batch sizes (or mesh=None, grad_accum=1)")
+
     def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
-                     num_epochs: int = 1, seed: int = 2) -> None:
+                     num_epochs: int = 1, seed: int = 2,
+                     mesh="auto") -> None:
         """Full-network supervised minibatch training with ONE fused,
         jit-compiled train step (value+grad+GradientAdjustment+update),
         compiled once per CONFIG — shared across fit calls AND across
@@ -559,8 +798,26 @@ class MultiLayerNetwork:
         scanned per-step scores afterwards.  Ragged batch lists (or a
         lone DataSet) use the per-step path.
 
+        When a mesh with a ``data`` axis of size > 1 is available
+        (auto-detected; ``mesh=`` overrides per call) the SAME scanned
+        program runs sharded: batch axis over ``data``, grads psum'd
+        in-graph, params/updater state replicated, guard skips decided
+        collectively — still ONE dispatch per fit.  ``conf.grad_accum``
+        adds the microbatch accumulation scan inside the step.  Batches
+        that don't divide by the shard count are zero-padded and the
+        padded rows masked out of loss and gradient (exact, not
+        approximate).
+
         Each layer gets its OWN updater from its conf, so per-layer
         lr/momentum/l2 overrides (ConfOverride parity) take effect."""
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        if not batches:
+            return
+        min_batch = min(b.features.shape[0] for b in batches)
+        rmesh = self._resolve_fit_mesh(mesh, min_batch)
+        if rmesh is not None or self.conf.grad_accum > 1:
+            self._fit_backprop_dp(batches, num_epochs, seed, rmesh)
+            return
         # donation guard: the engine steps donate params/ustate buffers;
         # one copy at the API boundary keeps caller-held references to
         # the pre-fit params valid (only loop-internal buffers, which no
@@ -568,7 +825,6 @@ class MultiLayerNetwork:
         params = jax.tree.map(jnp.copy, self._require_params())
         train_step, train_epochs, updaters = self._backprop_machinery()
         ustate = [u.init(p) for u, p in zip(updaters, params)]
-        batches = [data] if isinstance(data, DataSet) else list(data)
         run_key = jax.random.key(seed)
         # the scanned path stacks every batch on device: only take it when
         # the whole dataset comfortably fits in HBM, else stream per-step.
@@ -604,6 +860,95 @@ class MultiLayerNetwork:
             self._note_skips(skips)
         self.params = params
 
+    def _fit_backprop_dp(self, batches, num_epochs: int, seed: int,
+                         rmesh) -> None:
+        """The data-parallel/microbatched fit body: same structure as the
+        legacy path (scanned single dispatch when uniform, per-step
+        stream otherwise) but through the DP machinery — batches padded
+        to the shard x accum multiple with their real row count carried
+        alongside, stacked tensors staged onto the mesh with the batch
+        axis pre-sharded (the H2D transfer lands each shard's slice on
+        its device, no gather-then-scatter)."""
+        from deeplearning4j_tpu.parallel import sharded_fit
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+        from deeplearning4j_tpu.runtime.metrics import dp_metrics
+
+        params = jax.tree.map(jnp.copy, self._require_params())
+        train_step, train_epochs, updaters = self._backprop_machinery(rmesh)
+        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        run_key = jax.random.key(seed)
+        accum = max(self.conf.grad_accum, 1)
+        ndp = rmesh.shape[DATA_AXIS] if rmesh is not None else 1
+        chunk = self._pad_chunk(rmesh, accum)
+        sizes = [b.features.shape[0] for b in batches]
+        pad_to = [-(-s // chunk) * chunk for s in sizes]
+        self._check_bn_padding(any(s != p for s, p in zip(sizes, pad_to)))
+
+        def _nbytes(a):
+            return math.prod(a.shape) * jnp.dtype(a.dtype).itemsize
+        total_bytes = sum(_nbytes(b.features) + _nbytes(b.labels)
+                          for b in batches)
+        # uniform-enough for ONE scanned dispatch: same non-batch dims
+        # everywhere and equal batch rows except a smaller TRAILING
+        # remainder (which pads up to the common size and masks out —
+        # the classic last-batch raggedness); anything more ragged
+        # streams per-step
+        uniform = (len(batches) > 1
+                   and total_bytes <= self.SCAN_MAX_DATASET_BYTES
+                   and len({(b.features.shape[1:], b.labels.shape[1:])
+                            for b in batches}) == 1
+                   and len(set(sizes[:-1])) == 1
+                   and sizes[-1] <= sizes[0])
+        it = 0
+        if uniform:
+            target = max(pad_to)
+            xs = jnp.stack([self._pad_rows(b.features, target)
+                            for b in batches])
+            ys = jnp.stack([self._pad_rows(b.labels, target)
+                            for b in batches])
+            nvs = jnp.asarray([b.features.shape[0] for b in batches],
+                              jnp.int32)
+            if rmesh is not None:
+                # pre-shard the stacked epoch on its way into HBM: the
+                # transfer itself is the scatter, and the one fit
+                # dispatch below finds every shard already resident
+                t0 = time.perf_counter()
+                sharding = sharded_fit.stacked_sharding(rmesh)
+                xs = jax.device_put(xs, sharding)
+                ys = jax.device_put(ys, sharding)
+                dp_metrics.note_staged(
+                    _nbytes(xs) + _nbytes(ys),
+                    (time.perf_counter() - t0) * 1e3)
+            params, ustate, scores, skips = train_epochs(
+                params, ustate, (xs, ys, nvs), run_key, it, num_epochs)
+            dp_metrics.note_dispatch(
+                steps=num_epochs * len(batches), accum=accum,
+                data_degree=ndp)
+            self._note_skips(skips)
+            if self.listeners:
+                for j, s in enumerate(np.asarray(scores).ravel()):
+                    for ls in self.listeners:
+                        ls.iteration_done(self, it + j, float(s))
+            it += num_epochs * len(batches)
+        else:
+            skips = []
+            for epoch in range(num_epochs):
+                for b, target in zip(batches, pad_to):
+                    dp_batch = (self._pad_rows(b.features, target),
+                                self._pad_rows(b.labels, target),
+                                jnp.int32(b.features.shape[0]))
+                    params, ustate, score, skipped = train_step(
+                        params, ustate, dp_batch, run_key, it)
+                    skips.append(skipped)
+                    if self.listeners:
+                        for ls in self.listeners:
+                            ls.iteration_done(self, it, float(score))
+                    it += 1
+                    dp_metrics.note_dispatch(steps=1, accum=accum,
+                                             data_degree=ndp)
+            self._note_skips(skips)
+        self.params = params
+
     def _step_and_notify(self, train_step, params, ustate, batch,
                          run_key, step, skips=None):
         """One train_step dispatch + listener replay — shared by the
@@ -629,7 +974,8 @@ class MultiLayerNetwork:
         device scalars); shared impl in runtime/resilience.py."""
         resilience.note_skips(skips, where="multilayer")
 
-    def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2) -> None:
+    def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2,
+                     mesh="auto", prefetch_depth: int = 2) -> None:
         """STREAMING supervised backprop straight from a
         ``DataSetIterator`` — the backprop stage of the reference's
         ``fit(DataSetIterator)`` (nn/multilayer/MultiLayerNetwork.java:918)
@@ -645,26 +991,78 @@ class MultiLayerNetwork:
         ingestion overlaps compute instead of serializing with it.
         Updater state persists across the whole call (unlike repeated
         single-batch ``fit_backprop`` calls, which would reset
-        momentum)."""
+        momentum).
+
+        Under a ``data`` mesh (auto-detected; ``mesh=`` overrides) the
+        stream additionally runs through a depth-``prefetch_depth``
+        double-buffered SHARDED staging stage: a producer thread
+        ``device_put``s each batch with the batch axis pre-sharded over
+        the mesh, so every device's host->HBM slice transfer overlaps
+        the previous step's compute, and the sharded train step finds
+        its shard already resident."""
         if self.conf.pretrain or not self.conf.backprop:
             raise ValueError(
                 "fit_iterator is the streaming backprop trainer; this "
                 "conf wants pretrain/finetune (pretrain="
                 f"{self.conf.pretrain}, backprop={self.conf.backprop}) — "
                 "use fit() with materialized batches")
+        batch_hint = getattr(it, "batch", 0) or 0
+        if mesh == "auto" and batch_hint <= 0:
+            rmesh = None        # unknown batch size: don't auto-shard blind
+        else:
+            # explicit mesh with an unknown batch size: trust the caller
+            # (ragged batches are padded per step anyway)
+            rmesh = self._resolve_fit_mesh(
+                mesh, batch_hint if batch_hint > 0 else (1 << 30))
         # donation guard — see fit_backprop
         params = jax.tree.map(jnp.copy, self._require_params())
-        train_step, _, updaters = self._backprop_machinery()
+        train_step, _, updaters = self._backprop_machinery(rmesh)
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         run_key = jax.random.key(seed)
+        dp_mode = getattr(train_step, "takes_n_valid", False)
+        accum = max(self.conf.grad_accum, 1)
+        chunk = self._pad_chunk(rmesh, accum)
+        src = it
+        if rmesh is not None:
+            from deeplearning4j_tpu.datasets.iterator import \
+                PrefetchIterator
+            from deeplearning4j_tpu.parallel import sharded_fit
+            # wrap unless the caller's iterator ALREADY stages sharded —
+            # a device-pinned PrefetchIterator still needs the sharded
+            # stage on top (its gather-to-one-device would otherwise be
+            # re-scattered inside every dispatch)
+            if not (isinstance(it, PrefetchIterator)
+                    and it.sharding is not None):
+                src = PrefetchIterator(
+                    it, depth=prefetch_depth,
+                    sharding=sharded_fit.batch_sharding(rmesh),
+                    pad_rows_to=chunk)
         step = 0
         skips = []
         for _ in range(num_epochs):
-            it.reset()
-            while it.has_next():
-                params, ustate, step = self._step_and_notify(
-                    train_step, params, ustate, it.next(), run_key, step,
-                    skips)
+            src.reset()
+            while src.has_next():
+                batch = src.next()
+                if dp_mode:
+                    n_valid = getattr(batch, "n_valid", None)
+                    if n_valid is None:
+                        n_valid = batch.features.shape[0]
+                    target = -(-int(n_valid) // chunk) * chunk
+                    self._check_bn_padding(target != int(n_valid))
+                    dp_batch = (self._pad_rows(batch.features, target),
+                                self._pad_rows(batch.labels, target),
+                                jnp.int32(n_valid))
+                    params, ustate, score, skipped = train_step(
+                        params, ustate, dp_batch, run_key, step)
+                    skips.append(skipped)
+                    if self.listeners:
+                        for ls in self.listeners:
+                            ls.iteration_done(self, step, float(score))
+                    step += 1
+                else:
+                    params, ustate, step = self._step_and_notify(
+                        train_step, params, ustate, batch, run_key, step,
+                        skips)
         self._note_skips(skips)
         self.params = params
 

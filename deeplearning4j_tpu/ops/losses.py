@@ -34,50 +34,71 @@ class LossFunction(str, enum.Enum):
     COSINE_PROXIMITY = "cosine_proximity"
 
 
-def score(labels: Array, loss: LossFunction | str, output: Array) -> Array:
-    """Mean loss over the batch. ``output`` is the model's (post-activation)
-    prediction, as in the reference (loss composed with softmax/sigmoid output
-    activations, not logits — logit-space variants live in the model families
-    where they matter for numerics)."""
+def per_example_score(labels: Array, loss: LossFunction | str,
+                      output: Array) -> Array:
+    """Per-row losses, shape ``labels.shape[:-1]`` — the unreduced form of
+    :func:`score` (``score == mean(per_example_score)``).  The sharded /
+    microbatched training paths need the unreduced vector so zero-padded
+    rows can be masked out of the sum BEFORE normalizing by the REAL row
+    count (the trailing-batch padding contract in ``parallel/mesh.py``)."""
     loss = LossFunction(loss)
     labels = labels.astype(jnp.float32)
     output = output.astype(jnp.float32)
-    n = labels.shape[0]
 
     if loss in (LossFunction.MSE, LossFunction.SQUARED_LOSS):
         per = jnp.sum((labels - output) ** 2, axis=-1)
         if loss is LossFunction.MSE:
             per = per / labels.shape[-1]
-        return jnp.mean(per)
+        return per
     if loss is LossFunction.RMSE_XENT:
-        return jnp.mean(jnp.sqrt(jnp.sum((labels - output) ** 2, axis=-1) + _EPS))
+        return jnp.sqrt(jnp.sum((labels - output) ** 2, axis=-1) + _EPS)
     if loss is LossFunction.XENT or loss is LossFunction.RECONSTRUCTION_CROSSENTROPY:
         p = jnp.clip(output, _EPS, 1.0 - _EPS)
-        per = -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p), axis=-1)
-        return jnp.mean(per)
+        return -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p), axis=-1)
     if loss in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
         p = jnp.clip(output, _EPS, 1.0)
-        return jnp.mean(-jnp.sum(labels * jnp.log(p), axis=-1))
+        return -jnp.sum(labels * jnp.log(p), axis=-1)
     if loss is LossFunction.EXPLL:
-        # Poisson NLL: mean(output - labels*log(output))
+        # Poisson NLL: output - labels*log(output)
         p = jnp.clip(output, _EPS, None)
-        return jnp.mean(jnp.sum(p - labels * jnp.log(p), axis=-1))
+        return jnp.sum(p - labels * jnp.log(p), axis=-1)
     if loss is LossFunction.COSINE_PROXIMITY:
         num = jnp.sum(labels * output, axis=-1)
         den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(output, axis=-1) + _EPS
-        return -jnp.mean(num / den)
+        return -(num / den)
     raise ValueError(f"unhandled loss {loss}")
+
+
+def score(labels: Array, loss: LossFunction | str, output: Array) -> Array:
+    """Mean loss over the batch. ``output`` is the model's (post-activation)
+    prediction, as in the reference (loss composed with softmax/sigmoid output
+    activations, not logits — logit-space variants live in the model families
+    where they matter for numerics)."""
+    return jnp.mean(per_example_score(labels, loss, output))
+
+
+def per_example_softmax_cross_entropy_with_logits(labels: Array,
+                                                  logits: Array) -> Array:
+    """Per-row stable MCXENT on logits (unreduced ``[B]`` form)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
 
 
 def softmax_cross_entropy_with_logits(labels: Array, logits: Array) -> Array:
     """Numerically-stable MCXENT on logits — the TPU-native path the model
     families use (fuses into one XLA op chain; avoids log(softmax) blowup)."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * logp, axis=-1))
+    return jnp.mean(per_example_softmax_cross_entropy_with_logits(labels,
+                                                                  logits))
 
 
-def sigmoid_binary_cross_entropy_with_logits(labels: Array, logits: Array) -> Array:
+def per_example_sigmoid_binary_cross_entropy_with_logits(
+        labels: Array, logits: Array) -> Array:
     logits = logits.astype(jnp.float32)
     labels = labels.astype(jnp.float32)
     per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-    return jnp.mean(jnp.sum(per, axis=-1))
+    return jnp.sum(per, axis=-1)
+
+
+def sigmoid_binary_cross_entropy_with_logits(labels: Array, logits: Array) -> Array:
+    return jnp.mean(per_example_sigmoid_binary_cross_entropy_with_logits(
+        labels, logits))

@@ -16,7 +16,8 @@ Axes convention (mesh.py): ``data`` (DP), ``model`` (TP), ``pipe`` (PP),
 """
 
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
-    MeshSpec, make_mesh, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+    MeshSpec, make_mesh, auto_data_mesh, mesh_signature, local_batch_size,
+    pad_global_batch, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
     EXPERT_AXIS,
 )
 from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401

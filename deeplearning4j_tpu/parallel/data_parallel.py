@@ -22,18 +22,21 @@ so they serve MultiLayerNetwork, BERT, or any model family.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Iterable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from deeplearning4j_tpu.compat import shard_map
 
 from deeplearning4j_tpu.ops.updaters import Dl4jUpdater, apply_updates
-from deeplearning4j_tpu.parallel import collectives
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.parallel import collectives, sharded_fit
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, local_batch_size
 from deeplearning4j_tpu.runtime import compile_cache, resilience
+from deeplearning4j_tpu.runtime.metrics import dp_metrics
 
 Array = jax.Array
 PyTree = Any
@@ -62,9 +65,11 @@ class DataParallelTrainer:
 
         def step(params, ustate, x, y, key, it):
             # Per-shard loss/grads; each shard sees its local batch slice.
-            # Fold the data-axis index into the key so dropout/sampling
-            # noise differs per shard.
-            shard_key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+            # Fold the step index (the scanned-epoch path feeds every
+            # step the same run key) and the data-axis index into the
+            # key so dropout/sampling noise differs per step AND shard.
+            shard_key = jax.random.fold_in(
+                jax.random.fold_in(key, it), lax.axis_index(DATA_AXIS))
             score, grads = jax.value_and_grad(self.loss_fn)(
                 params, x, y, shard_key)
             grads = collectives.grad_share(grads, DATA_AXIS)
@@ -80,19 +85,24 @@ class DataParallelTrainer:
                 new_ustate, (score, grads))
             return new_params, new_ustate, score, skipped
 
-        sharded = shard_map(
-            step, mesh=mesh,
-            in_specs=(param_spec, param_spec, batch_spec, batch_spec,
-                      P(), P()),
-            out_specs=(param_spec, param_spec, P(), P()),
-            check_vma=False,
-        )
-        # through the compile engine for the compile counters; no
-        # cross-instance key (loss_fn is an arbitrary user closure).
-        # step() donates params/ustate raw; fit() copies on entry.
-        self._step = compile_cache.cached_jit(
-            sharded, label="parallel.dp_step",
-            donate_argnums=(0, 1) if donate else ())
+        def shard_step(params, ustate, batch, key, it):
+            x, y = batch
+            return step(params, ustate, x, y, key, it)
+
+        # both dispatch shapes come from the SAME shared builder the
+        # multilayer engine uses (parallel/sharded_fit.py): the per-batch
+        # step for streaming, and the scanned-epoch program — ONE device
+        # dispatch per fit over stacked [NB, B, ...] batches — for
+        # materialized batch lists.  No cross-instance engine key
+        # (loss_fn is an arbitrary user closure); steps donate
+        # params/ustate raw, fit() copies on entry.
+        specs = (batch_spec, batch_spec)
+        self._step = sharded_fit.build_sharded_step(
+            shard_step, mesh, batch_specs=specs, label="parallel.dp_step",
+            donate=donate)
+        self._epochs = sharded_fit.build_scanned_epochs(
+            shard_step, mesh, batch_specs=specs, label="parallel.dp_epochs",
+            donate=donate)
 
     def init_state(self, params: PyTree) -> PyTree:
         return self.updater.init(params)
@@ -101,25 +111,67 @@ class DataParallelTrainer:
              key: Array, iteration: int | Array):
         """One global step. x/y are GLOBAL batches (leading dim divisible by
         the data-parallel degree)."""
-        return self._step(params, ustate, x, y, key,
+        local_batch_size(x.shape[0], self.mesh, pad=False)
+        return self._step(params, ustate, (x, y), key,
                           jnp.asarray(iteration))
 
     def fit(self, params: PyTree, batches: Iterable[Tuple[Array, Array]],
-            key: Array, listeners=()) -> PyTree:
+            key: Array, listeners=(), num_epochs: int = 1,
+            scan: bool = True) -> PyTree:
+        """Uniform-shape batch lists run as ONE scanned dispatch for the
+        whole fit (batches stacked [NB, B, ...] and staged pre-sharded;
+        listeners replayed from the scanned per-step scores afterwards —
+        MIGRATION.md).  Ragged lists, or ``scan=False``, keep the
+        per-batch dispatch loop.  ``num_epochs`` repeats the batch list
+        with updater state carried through (scanned path only)."""
         # donation guard: the first step consumes its params/ustate args;
         # copy once so the caller's arrays stay valid (pointless when the
         # trainer was built non-donating, so skip the traffic then)
         if self.donate:
             params = jax.tree.map(jnp.copy, params)
         ustate = self.init_state(params)
+        batches = list(batches)
+        for x, _ in batches:
+            local_batch_size(x.shape[0], self.mesh, pad=False)
+        # stacking puts the whole list on device: only scan while it
+        # comfortably fits in HBM (same budget as the multilayer path),
+        # else keep streaming batch by batch
+        total_bytes = sum(x.nbytes + y.nbytes for x, y in batches)
+        uniform = (scan and len(batches) > 1
+                   and total_bytes <= sharded_fit.SCAN_MAX_DATASET_BYTES
+                   and len({(x.shape, y.shape) for x, y in batches}) == 1)
+        if uniform:
+            t0 = time.perf_counter()
+            sharding = sharded_fit.stacked_sharding(self.mesh)
+            xs = jax.device_put(jnp.stack([x for x, _ in batches]), sharding)
+            ys = jax.device_put(jnp.stack([y for _, y in batches]), sharding)
+            dp_metrics.note_staged(xs.nbytes + ys.nbytes,
+                                   (time.perf_counter() - t0) * 1e3)
+            params, ustate, scores, skips = self._epochs(
+                params, ustate, (xs, ys), key, jnp.int32(0), num_epochs)
+            dp_metrics.note_dispatch(
+                steps=num_epochs * len(batches), accum=1,
+                data_degree=self.mesh.shape[DATA_AXIS])
+            _note_skips(skips)
+            if listeners:
+                for it, s in enumerate(np.asarray(scores).ravel()):
+                    for ls in listeners:
+                        ls.iteration_done(self, it, float(s))
+            return params
         skips = []
-        for it, (x, y) in enumerate(batches):
-            key, sub = jax.random.split(key)
-            params, ustate, score, skipped = self.step(
-                params, ustate, x, y, sub, it)
-            skips.append(skipped)
-            for ls in listeners:
-                ls.iteration_done(self, it, float(score))
+        it = 0
+        for _ in range(num_epochs):
+            for (x, y) in batches:
+                key, sub = jax.random.split(key)
+                params, ustate, score, skipped = self._step(
+                    params, ustate, (x, y), sub, jnp.asarray(it))
+                skips.append(skipped)
+                dp_metrics.note_dispatch(
+                    steps=1, accum=1,
+                    data_degree=self.mesh.shape[DATA_AXIS])
+                for ls in listeners:
+                    ls.iteration_done(self, it, float(score))
+                it += 1
         _note_skips(skips)
         return params
 

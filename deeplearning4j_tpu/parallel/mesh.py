@@ -134,9 +134,99 @@ def initialize_from_env() -> bool:
     return True
 
 
-def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+def local_batch_size(global_batch: int, mesh: Mesh, *,
+                     pad: bool = True) -> int:
+    """Per-shard batch size for a global batch over ``mesh``'s data axis.
+
+    Non-divisible batches are legal: the trailing remainder is zero-PADDED
+    up to the next multiple and its rows masked out of the loss/grad
+    (the serving engine's zero-pad + slice-out idiom applied to training;
+    ``pad_global_batch`` builds the padded arrays + valid count).  Only a
+    batch smaller than the data-parallel degree is a hard error — there
+    is no shard assignment where every device holds at least one real
+    row, so the caller picked the wrong mesh (or should train
+    single-device).  ``pad=False`` restores the strict divisibility
+    check for callers that cannot mask (arbitrary external loss fns)."""
     n = mesh.shape[DATA_AXIS]
+    if global_batch < n:
+        raise ValueError(
+            f"global batch {global_batch} < data-parallel degree {n}: "
+            f"at least one example per shard is required — use a bigger "
+            f"batch or a smaller mesh (MeshSpec(data=...))")
     if global_batch % n != 0:
-        raise ValueError(f"global batch {global_batch} not divisible by "
-                         f"data-parallel degree {n}")
+        if not pad:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"data-parallel degree {n} (pad=False)")
+        return -(-global_batch // n)        # ceil: trailing shard padded
     return global_batch // n
+
+
+def padded_global_batch(global_batch: int, mesh: Mesh,
+                        multiple: int = 1) -> int:
+    """Smallest padded size >= ``global_batch`` divisible by
+    ``data_degree * multiple`` (``multiple`` = microbatch accumulation
+    factor, so every shard's local batch splits evenly into
+    microbatches)."""
+    local_batch_size(global_batch, mesh)    # batch >= degree check
+    chunk = mesh.shape[DATA_AXIS] * max(multiple, 1)
+    return -(-global_batch // chunk) * chunk
+
+
+def pad_rows(arr, target: int):
+    """Zero-pad the example (leading) axis up to ``target`` rows — THE
+    padding primitive every DP path shares (fit paths, the sharded
+    prefetch stage, ResilientFit); padded rows carry zero weight in the
+    masked loss so they contribute nothing to loss or gradient."""
+    import jax.numpy as jnp
+
+    b = arr.shape[0]
+    if b == target:
+        return jnp.asarray(arr)
+    return jnp.pad(jnp.asarray(arr),
+                   [(0, target - b)] + [(0, 0)] * (arr.ndim - 1))
+
+
+def pad_global_batch(x, y, mesh: Mesh, multiple: int = 1):
+    """Zero-pad ``x``/``y`` rows up to ``padded_global_batch`` — returns
+    ``(x_pad, y_pad, n_valid)``.  Padding rows carry zero weight in the
+    sharded step's masked loss, so the gradient equals the unpadded
+    batch's exactly (tests assert it)."""
+    b = x.shape[0]
+    target = padded_global_batch(b, mesh, multiple)
+    return pad_rows(x, target), pad_rows(y, target), b
+
+
+def mesh_signature(mesh: Optional[Mesh]):
+    """Hashable identity for compile-cache keys: axis layout AND the
+    concrete device assignment.  Two meshes of the same shape over
+    DIFFERENT devices must not share a cached executable (the compiled
+    shard_map closure pins its devices), so the device ids are part of
+    the signature — no silent cross-mesh cache hits."""
+    if mesh is None:
+        return None
+    return (tuple(zip(mesh.axis_names,
+                      (mesh.shape[a] for a in mesh.axis_names))),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+#: memoized auto-detected data mesh (keyed on the live device list so a
+#: re-initialized backend rebuilds it)
+_AUTO_MESH: Optional[Tuple[Tuple[int, ...], Mesh]] = None
+
+
+def auto_data_mesh() -> Optional[Mesh]:
+    """The default-fit mesh: every visible device on the ``data`` axis.
+    Returns None on a single device (nothing to shard over) — callers
+    fall back to the single-device path.  This is the auto-detection
+    behind ``MultiLayerNetwork.fit_backprop(mesh="auto")``; pass an
+    explicit ``make_mesh(...)`` to override per call."""
+    global _AUTO_MESH
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    dev_ids = tuple(d.id for d in devices)
+    if _AUTO_MESH is None or _AUTO_MESH[0] != dev_ids:
+        _AUTO_MESH = (dev_ids, make_mesh(MeshSpec(data=-1),
+                                         devices=devices))
+    return _AUTO_MESH[1]

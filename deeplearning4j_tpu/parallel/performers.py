@@ -43,7 +43,12 @@ class MultiLayerNetworkPerformer(so.WorkerPerformer):
         self.num_epochs = num_epochs
 
     def perform(self, job: Job) -> None:
-        self.net.fit_backprop(job.work, num_epochs=self.num_epochs)
+        # mesh=None: a scaleout performer IS one data-parallel worker —
+        # the control plane owns the parallelism, and auto-sharding each
+        # worker's local fit over the whole device set would nest DP
+        # inside DP (N workers contending for the same mesh every step)
+        self.net.fit_backprop(job.work, num_epochs=self.num_epochs,
+                              mesh=None)
         job.result = self.net.params
 
     def update(self, params) -> None:

@@ -1,0 +1,140 @@
+"""Shared sharded-fit step builder: ONE XLA dispatch per fit, mesh-wide.
+
+This is the junction the scanned-epoch engine (nn/multilayer.py, PR 1)
+and the data-parallel trainers (parallel/data_parallel.py) meet at.
+Before it, ``DataParallelTrainer.fit`` dispatched one program per batch —
+re-paying the host->device round trip the scanned engine was built to
+eliminate — and ``MultiLayerNetwork.fit`` was single-device only.  Both
+now hand a PER-SHARD step function to the builders here and get back a
+compiled program that:
+
+- shards the batch axis over the mesh's ``data`` axis (``shard_map``
+  via the compat shim) with params/updater state replicated;
+- scans the step over stacked batches and again over epochs, so a whole
+  fit is ONE device dispatch (``build_scanned_epochs``) — or keeps the
+  per-batch dispatch shape for streaming ingestion
+  (``build_sharded_step``);
+- routes through ``runtime/compile_cache.cached_jit`` with params +
+  updater state donated, exactly like the single-device engine steps.
+
+The step function owns its collectives (psum/pmean over ``data``) and
+its guard semantics: a skip decision must be computed from COLLECTIVE
+values (post-psum grads/score) so every replica skips identically and
+replicated params never diverge.
+
+Engine keys: callers that want cross-instance sharing pass
+``engine_key`` including ``mesh.mesh_signature(mesh)`` — mesh shape AND
+device ids — so two meshes never silently share a compiled executable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Hashable, Optional, Tuple
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.compat import shard_map
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.runtime import compile_cache
+
+PyTree = Any
+#: shard_step(params, ustate, batch, key, it) -> (params, ustate, score,
+#: skipped) — written against LOCAL shards, collectives over DATA_AXIS
+ShardStep = Callable[..., Tuple[PyTree, PyTree, jax.Array, jax.Array]]
+
+#: scanned-path budget: stacking a whole batch list on device is only a
+#: win while it comfortably fits in HBM; above this the callers stream
+#: per-batch instead (same number MultiLayerNetwork.SCAN_MAX_DATASET_BYTES
+#: has used since PR 1)
+SCAN_MAX_DATASET_BYTES = 256 * 1024 * 1024
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement for ONE global batch: leading (example) axis over
+    ``data``, everything else replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def stacked_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement for a STACKED batch tensor [NB, B, ...]: the scan axis
+    replicated, the example axis sharded over ``data``."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def build_sharded_step(shard_step: ShardStep, mesh: Optional[Mesh], *,
+                       batch_specs: PyTree = None, label: str,
+                       engine_key: Optional[Hashable] = None,
+                       donate: bool = True):
+    """Per-batch dispatch shape (streaming loops): returns a compiled
+    ``fn(params, ustate, batch, key, it)``.  ``batch_specs`` is a pytree
+    of ``PartitionSpec`` matching ``batch`` (e.g. ``(P('data'),
+    P('data'), P())`` for (x, y, n_valid)).  ``mesh=None`` compiles the
+    step unsharded (the step must then avoid collectives — e.g. the
+    grad-accumulation-only path)."""
+    sharded = shard_step if mesh is None else shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), P(), batch_specs, P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return compile_cache.cached_jit(
+        sharded, key=engine_key, label=label,
+        donate_argnums=(0, 1) if donate else ())
+
+
+def build_scanned_epochs(shard_step: ShardStep, mesh: Optional[Mesh], *,
+                         batch_specs: PyTree = None, label: str,
+                         engine_key: Optional[Hashable] = None,
+                         donate: bool = True):
+    """The single-dispatch fit: ``fn(params, ustate, batches, key, it0,
+    num_epochs)`` scans ``shard_step`` over stacked batches [NB, B, ...]
+    and again over epochs — one host->device round trip for the whole
+    fit, params/updater state donated in place, per-step scores and
+    guard-skip flags returned as [num_epochs, NB] for host replay.
+
+    ``num_epochs`` is static (retrace per value, same contract as the
+    single-device ``train_epochs``).  ``mesh=None`` keeps the same
+    double scan without the shard_map wrap (grad-accumulation on one
+    device)."""
+
+    def epochs_body(params, ustate, batches, key, it0, *, num_epochs):
+        def body(carry, batch):
+            p, u, it = carry
+            p, u, score, skipped = shard_step(p, u, batch, key, it)
+            return (p, u, it + 1), (score, skipped)
+
+        def epoch_body(carry, _):
+            return lax.scan(body, carry, batches)
+
+        (params, ustate, _), (scores, skips) = lax.scan(
+            epoch_body, (params, ustate, it0), None, length=num_epochs)
+        return params, ustate, scores, skips
+
+    if mesh is None:
+        def epochs(params, ustate, batches, key, it0, num_epochs):
+            return epochs_body(params, ustate, batches, key, it0,
+                               num_epochs=num_epochs)
+    else:
+        # the scan (stacking) axis rides ahead of each batch spec
+        stacked_specs = jax.tree.map(lambda s: P(None, *s), batch_specs,
+                                     is_leaf=lambda x: isinstance(x, P))
+
+        def epochs(params, ustate, batches, key, it0, num_epochs):
+            # num_epochs is jit-static, so binding it BEFORE shard_map
+            # keeps the shard_map signature all-arrays (a static python
+            # int has no PartitionSpec)
+            sharded = shard_map(
+                functools.partial(epochs_body, num_epochs=num_epochs),
+                mesh=mesh,
+                in_specs=(P(), P(), stacked_specs, P(), P()),
+                out_specs=(P(), P(), P(), P()),
+                check_vma=False,
+            )
+            return sharded(params, ustate, batches, key, it0)
+
+    return compile_cache.cached_jit(
+        epochs, key=engine_key, label=label, static_argnums=(5,),
+        donate_argnums=(0, 1) if donate else ())

@@ -242,6 +242,70 @@ class ServingMetrics:
 #: process-wide singleton the serving engine + batcher report into
 serving_metrics = ServingMetrics()
 
+
+class DataParallelMetrics:
+    """Process-wide counters for the sharded/scanned training paths
+    (parallel/sharded_fit.py consumers: ``MultiLayerNetwork`` DP fits,
+    ``DataParallelTrainer``) and the mesh-aware ingestion stage
+    (datasets/iterator.py ``PrefetchIterator(sharding=...)``):
+
+    - ``bytes_staged`` / ``batches_staged`` / ``stage_ms``: host->HBM
+      transfers submitted by the sharded staging stage (``device_put``
+      is async — ``stage_ms`` is submission wall time, i.e. what the
+      training loop actually waits; the DMA itself overlaps compute);
+    - ``dispatches`` / ``steps``: device dispatches vs train steps they
+      carried — ``snapshot()['steps_per_dispatch']`` is the scanned-
+      epoch win (1.0 = the old per-batch loop);
+    - ``accum_factor`` / ``data_degree``: microbatch accumulation factor
+      and data-parallel shard count of the most recent dispatch, so
+      bench rows can report effective batch = micro x accum x degree.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.bytes_staged = 0
+            self.batches_staged = 0
+            self.stage_ms = 0.0
+            self.dispatches = 0
+            self.steps = 0
+            self.accum_factor = 1
+            self.data_degree = 1
+
+    def note_staged(self, nbytes: int, ms: float, batches: int = 1) -> None:
+        with self._lock:
+            self.bytes_staged += int(nbytes)
+            self.batches_staged += batches
+            self.stage_ms += ms
+
+    def note_dispatch(self, steps: int, accum: int, data_degree: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.steps += int(steps)
+            self.accum_factor = int(accum)
+            self.data_degree = int(data_degree)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "bytes_staged": self.bytes_staged,
+                "batches_staged": self.batches_staged,
+                "stage_ms": round(self.stage_ms, 3),
+                "dispatches": self.dispatches,
+                "steps": self.steps,
+                "steps_per_dispatch": round(self.steps / self.dispatches, 2)
+                if self.dispatches else 0.0,
+                "accum_factor": self.accum_factor,
+                "data_degree": self.data_degree,
+            }
+
+
+#: process-wide singleton the sharded fit paths + ingestion stage report into
+dp_metrics = DataParallelMetrics()
+
 # This import sits BELOW the compile counters on purpose: importing this
 # module can re-enter it through the
 # optimize/__init__ -> solver -> runtime.compile_cache cycle, and that

@@ -263,11 +263,21 @@ class ResilientFit:
     tested to be step-for-step equivalent to an uninterrupted run.
 
     ``detector`` is injectable for tests/soak harnesses; the default is
-    a :class:`LossSpikeDetector` built from the config."""
+    a :class:`LossSpikeDetector` built from the config.
+
+    ``mesh`` (a Mesh with a ``data`` axis) runs the driver on the
+    SHARDED engine step: batch axis over ``data``, grads psum'd
+    in-graph, guard skips decided collectively so replicas never
+    diverge — checkpoints, rollback, and resume are unchanged host
+    policy on top (resume is step-for-step equivalent to an
+    uninterrupted sharded run; tested).  Default None keeps the
+    single-device step byte-for-byte as before."""
 
     def __init__(self, net, config: ResilienceConfig,
-                 detector: Optional[LossSpikeDetector] = None):
+                 detector: Optional[LossSpikeDetector] = None,
+                 mesh=None):
         self.net = net
+        self.mesh = mesh
         self.config = config
         self.manager = CheckpointManager(config.checkpoint_dir,
                                          max_to_keep=config.max_to_keep)
@@ -326,9 +336,27 @@ class ResilientFit:
         # buffers; copy once at this API boundary (same contract as
         # fit_backprop)
         params = jax.tree.map(jnp.copy, net._require_params())
-        train_step, _, updaters = net._backprop_machinery()
+        train_step, _, updaters = net._backprop_machinery(self.mesh)
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         run_key = jax.random.key(seed)
+        # DP-mode steps take (x, y, n_valid) with zero-padded rows
+        # masked out of loss/grad (parallel/mesh padding contract)
+        dp_mode = getattr(train_step, "takes_n_valid", False)
+        pad_chunk = net._pad_chunk(self.mesh, max(net.conf.grad_accum, 1)) \
+            if dp_mode else 1
+
+        def dispatch(params, ustate, batch, key, at_step):
+            if not dp_mode:
+                return train_step(params, ustate, batch.features,
+                                  batch.labels, key, at_step)
+            b = batch.features.shape[0]
+            target = -(-b // pad_chunk) * pad_chunk
+            net._check_bn_padding(target != b)
+            return train_step(
+                params, ustate,
+                (net._pad_rows(batch.features, target),
+                 net._pad_rows(batch.labels, target), jnp.int32(b)),
+                key, at_step)
 
         step = 0
         rollbacks = 0
@@ -367,8 +395,8 @@ class ResilientFit:
             # re-folded key: rollback bumps `rollbacks`, giving the retry
             # a fresh noise stream on top of the reshuffled batch order
             eff_key = jax.random.fold_in(run_key, rollbacks)
-            params, ustate, score, skipped = train_step(
-                params, ustate, batch.features, batch.labels, eff_key, step)
+            params, ustate, score, skipped = dispatch(
+                params, ustate, batch, eff_key, step)
             skips.append(skipped)
             loss = float(score)
             steps_this_call += 1

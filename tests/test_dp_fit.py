@@ -1,0 +1,313 @@
+"""Mesh-sharded scanned training tests (PR 5 tentpole).
+
+Covers the contracts the sharded-by-default fit path promises:
+- sharded-vs-single-device equivalence (pmean-of-shard-grads == full-batch
+  grad for mean losses), including the BIT-identical case at equal
+  effective batch (mesh-of-N vs grad_accum=N — same reduction order by
+  construction);
+- microbatch gradient accumulation == the equivalent larger batch;
+- trailing-batch zero-pad + mask exactness and the one-dispatch scan;
+- collective guard skips (one shard's NaN skips EVERY replica — no
+  divergence);
+- resume-equivalence under ResilientFit on the sharded path;
+- compile-cache keying: distinct mesh shapes/devices are distinct engine
+  entries — no silent cross-mesh cache hits;
+- sharded PrefetchIterator staging (pre-sharded device_put + n_valid).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (LayerKind, MultiLayerConfiguration,
+                                        NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, MeshSpec,
+                                              auto_data_mesh,
+                                              local_batch_size, make_mesh,
+                                              mesh_signature,
+                                              pad_global_batch)
+from deeplearning4j_tpu.runtime.metrics import dp_metrics, resilience_metrics
+
+
+def _conf(accum=1, dropout=0.0):
+    return (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).momentum(0.5).use_adagrad(False)
+            .dropout(dropout).num_iterations(1).activation("tanh")
+            .list(3).hidden_layer_sizes(8, 6)
+            .override(2, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent",
+                      dropout=0.0)
+            .pretrain(False).backward(True).grad_accum(accum).build())
+
+
+def _batches(n=4, rows=32, seed=0, poison=()):
+    rng = np.random.RandomState(seed)
+    out = []
+    for b in range(n):
+        x = rng.randn(rows, 4).astype(np.float32)
+        if b in poison:
+            x[0, 0] = np.nan
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, rows)]
+        out.append(DataSet(jnp.asarray(x), jnp.asarray(y)))
+    return out
+
+
+def _fit(conf, batches, mesh, seed=1, num_epochs=2):
+    net = MultiLayerNetwork(conf).init(seed=seed)
+    net.fit_backprop(batches, num_epochs=num_epochs, mesh=mesh)
+    return np.asarray(net.params_flat())
+
+
+# -- sharded vs single-device equivalence -----------------------------------
+
+def test_shard_grads_equal_full_batch_grads(devices):
+    """The math claim: psum of masked shard grad-sums / global count ==
+    the full-batch mean gradient."""
+    mesh = auto_data_mesh()
+    conf = _conf()
+    single = _fit(conf, _batches(), None)
+    sharded = _fit(conf, _batches(), mesh)
+    np.testing.assert_allclose(sharded, single, rtol=1e-3, atol=1e-3)
+
+
+def test_mesh_of_one_matches_single_device_exactly(devices):
+    """A 1-shard mesh runs the sharded program over the full batch: same
+    reduction order as the masked single-device path — bit-exact."""
+    m1 = make_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    single = _fit(_conf(), _batches(), None)
+    sharded1 = _fit(_conf(), _batches(), m1)
+    assert np.array_equal(sharded1, single)
+
+
+def test_sharded_bit_identical_to_accum_at_equal_effective_batch(devices):
+    """The acceptance criterion: mesh-of-N (accum=1) vs single-device
+    grad_accum=N on the same batches — identical microbatch partitions,
+    identical sum-then-divide-once reduction — BIT-identical params."""
+    mesh = auto_data_mesh()
+    n = mesh.shape[DATA_AXIS]
+    sharded = _fit(_conf(), _batches(), mesh)
+    accum = _fit(_conf(accum=n), _batches(), None)
+    assert np.array_equal(sharded, accum), (
+        np.max(np.abs(sharded - accum)))
+
+
+def test_grad_accum_equals_undivided_batch(devices):
+    """grad_accum=k over a batch == one step over the same (k x larger
+    effective) batch: mean of microbatch sum-grads == full mean grad."""
+    plain = _fit(_conf(), _batches(), None)
+    accum = _fit(_conf(accum=4), _batches(), None)
+    np.testing.assert_allclose(accum, plain, rtol=1e-3, atol=1e-3)
+
+
+# -- trailing-batch padding --------------------------------------------------
+
+def test_trailing_ragged_batch_pads_into_one_dispatch(devices):
+    """A smaller trailing batch zero-pads up to the common size, joins
+    the scanned dispatch (ONE for the whole fit), and its padded rows
+    contribute nothing: results match the unpadded single-device fit."""
+    mesh = auto_data_mesh()
+    full = _batches(4)
+    ragged = full[:3] + [DataSet(full[3].features[:20],
+                                 full[3].labels[:20])]
+    dp_metrics.reset()
+    sharded = _fit(_conf(), ragged, mesh)
+    snap = dp_metrics.snapshot()
+    assert snap["dispatches"] == 1 and snap["steps"] == 8, snap
+    single = _fit(_conf(), ragged, None)
+    np.testing.assert_allclose(sharded, single, rtol=1e-3, atol=1e-3)
+
+
+def test_local_batch_size_pads_instead_of_raising(devices):
+    mesh = auto_data_mesh()
+    assert local_batch_size(32, mesh) == 4
+    assert local_batch_size(20, mesh) == 3          # ceil: tail padded
+    with pytest.raises(ValueError, match="pad=False"):
+        local_batch_size(20, mesh, pad=False)
+    with pytest.raises(ValueError, match="at least one example"):
+        local_batch_size(5, mesh)                   # batch < n_devices
+    x, y, nv = pad_global_batch(jnp.ones((20, 4)), jnp.ones((20, 3)), mesh)
+    assert x.shape[0] == 24 and y.shape[0] == 24 and nv == 20
+    assert float(jnp.sum(x[20:])) == 0.0
+
+
+def test_explicit_mesh_with_tiny_batch_raises(devices):
+    mesh = auto_data_mesh()
+    with pytest.raises(ValueError, match="cannot shard"):
+        MultiLayerNetwork(_conf()).init().fit_backprop(
+            _batches(2, rows=4), mesh=mesh)
+
+
+def test_bn_conf_refuses_padding(devices):
+    """The mask cannot reach BatchNorm's in-batch normalization stats,
+    so a BN conf + a batch that needs padding must refuse loudly."""
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).use_adagrad(False).activation("tanh")
+            .list(4).hidden_layer_sizes(8, 8, 6)
+            .override(1, kind=LayerKind.BATCH_NORM)
+            .override(3, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    mesh = auto_data_mesh()
+    with pytest.raises(ValueError, match="BatchNorm"):
+        MultiLayerNetwork(conf).init().fit_backprop(
+            _batches(2, rows=20), mesh=mesh)      # 20 % 8 != 0
+    # divisible batches are fine on an explicit mesh (ghost-batch BN)
+    net = MultiLayerNetwork(conf).init()
+    net.fit_backprop(_batches(2, rows=32), mesh=mesh)
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+
+
+# -- guard semantics on the sharded path -------------------------------------
+
+def test_collective_guard_skips_poisoned_step(devices):
+    """One NaN row lands in ONE shard's slice; the psum'd grads poison
+    every replica identically, so the skip is collective — params stay
+    finite and the skip count books once per poisoned step."""
+    mesh = auto_data_mesh()
+    resilience_metrics.reset()
+    net = MultiLayerNetwork(_conf()).init(seed=1)
+    net.fit_backprop(_batches(4, poison={2}), num_epochs=2, mesh=mesh)
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+    assert resilience_metrics.count("steps_skipped") == 2  # 1/epoch
+
+
+# -- ResilientFit on the sharded path ----------------------------------------
+
+def test_resilient_fit_sharded_resume_equivalence(devices, tmp_path):
+    """Kill-and-resume on the sharded step == the uninterrupted sharded
+    run, bit-for-bit (params AND the steps they took)."""
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+    mesh = auto_data_mesh()
+    batches = _batches(4)
+
+    netA = MultiLayerNetwork(_conf()).init(seed=2)
+    ResilientFit(netA, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "a"), checkpoint_every=3),
+        mesh=mesh).fit(batches, num_epochs=2, seed=4)
+
+    netB = MultiLayerNetwork(_conf()).init(seed=2)
+    ResilientFit(netB, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "b"), checkpoint_every=3,
+        max_steps=5), mesh=mesh).fit(batches, num_epochs=2, seed=4)
+    ResilientFit(netB, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "b"), checkpoint_every=3,
+        resume=True), mesh=mesh).fit(batches, num_epochs=2, seed=4)
+
+    assert np.array_equal(np.asarray(netA.params_flat()),
+                          np.asarray(netB.params_flat()))
+
+
+# -- compile-cache keying ----------------------------------------------------
+
+def test_sharded_machinery_cache_keyed_per_mesh(devices):
+    """Same conf on different mesh shapes (or device sets) must be
+    DISTINCT engine entries; the same mesh shares one."""
+    conf_json = _conf().to_json()
+    net1 = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    net2 = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    m8 = auto_data_mesh()
+    m4 = make_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+    m4b = make_mesh(MeshSpec(data=4), devices=jax.devices()[4:])
+
+    b8 = net1._backprop_machinery(m8)
+    b4 = net1._backprop_machinery(m4)
+    assert b8 is not b4
+    # same mesh, different network instance -> the SAME engine bundle
+    assert net2._backprop_machinery(m8) is b8
+    # same shape over different devices is still a different executable
+    assert net1._backprop_machinery(m4b) is not b4
+    assert mesh_signature(m4) != mesh_signature(m4b)
+    # and the single-device bundle is its own entry
+    assert net1._backprop_machinery() is not b8
+
+
+def test_auto_gates_keep_stochastic_confs_single_device(devices):
+    """Auto-detection must not silently flip dropout/BN confs to
+    per-shard noise streams; explicit meshes may."""
+    net = MultiLayerNetwork(_conf(dropout=0.5)).init(seed=1)
+    assert net._resolve_fit_mesh("auto", 32) is None
+    assert net._resolve_fit_mesh(auto_data_mesh(), 32) is not None
+    # plain confs do auto-shard
+    assert MultiLayerNetwork(_conf())._resolve_fit_mesh(
+        "auto", 32) is not None
+    # but not when the batch cannot give every shard a row
+    assert MultiLayerNetwork(_conf())._resolve_fit_mesh("auto", 4) is None
+
+
+# -- sharded ingestion -------------------------------------------------------
+
+def test_prefetch_iterator_stages_sharded_batches(devices):
+    from deeplearning4j_tpu.datasets.iterator import (ListDataSetIterator,
+                                                      PrefetchIterator)
+    from deeplearning4j_tpu.parallel import sharded_fit
+    mesh = auto_data_mesh()
+    inner = ListDataSetIterator(_batches(3, rows=20), batch_size=20)
+    dp_metrics.reset()
+    pf = PrefetchIterator(inner, depth=2,
+                          sharding=sharded_fit.batch_sharding(mesh),
+                          pad_rows_to=8)
+    seen = []
+    while pf.has_next():
+        seen.append(pf.next())
+    assert len(seen) == 3
+    for ds in seen:
+        assert ds.features.shape[0] == 24          # padded to the chunk
+        assert ds.n_valid == 20
+        assert len(ds.features.sharding.device_set) == 8
+    assert dp_metrics.snapshot()["batches_staged"] == 3
+    assert dp_metrics.snapshot()["bytes_staged"] > 0
+
+
+def test_fit_iterator_sharded_matches_fit_backprop(devices):
+    """The streaming sharded path (per-batch dispatch through the
+    sharded staging stage) computes the same steps as the scanned fit."""
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    mesh = auto_data_mesh()
+    batches = _batches(4)
+    net1 = MultiLayerNetwork(_conf()).init(seed=1)
+    net1.fit_backprop(batches, num_epochs=2, mesh=mesh)
+    net2 = MultiLayerNetwork(_conf()).init(seed=1)
+    net2.fit_iterator(ListDataSetIterator(batches, batch_size=32),
+                      num_epochs=2, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(net1.params_flat()),
+                               np.asarray(net2.params_flat()),
+                               rtol=1e-6, atol=1e-6)
+
+
+# -- conf serde --------------------------------------------------------------
+
+def test_grad_accum_serde_roundtrip():
+    conf = _conf(accum=4)
+    assert conf.grad_accum == 4
+    rt = MultiLayerConfiguration.from_json(conf.to_json())
+    assert rt.grad_accum == 4 and rt == conf
+    # default stays 1 for old JSON without the field
+    d = conf.to_dict()
+    del d["grad_accum"]
+    assert MultiLayerConfiguration.from_dict(d).grad_accum == 1
+
+
+def test_dp_trainer_scanned_fit_matches_loop(devices):
+    """DataParallelTrainer.fit's stacked scanned path == its per-batch
+    dispatch loop (same step program, scanned)."""
+    from deeplearning4j_tpu.ops.updaters import dl4j_updater
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    def loss(p, x, y, key):
+        lp = jax.nn.log_softmax(jnp.tanh(x @ p["W"]) @ p["V"], -1)
+        return -jnp.mean(jnp.sum(y * lp, -1))
+
+    mesh = auto_data_mesh()
+    pb = [(b.features, b.labels) for b in _batches(4)]
+    p0 = {"W": 0.01 * jax.random.normal(jax.random.key(0), (4, 8)),
+          "V": 0.01 * jax.random.normal(jax.random.key(1), (8, 3))}
+    tr = DataParallelTrainer(
+        loss, dl4j_updater(lr=0.3, momentum=0.0, use_adagrad=False), mesh)
+    ps = tr.fit(dict(p0), pb, jax.random.key(5))
+    pl = tr.fit(dict(p0), pb, jax.random.key(5), scan=False)
+    for k in p0:
+        np.testing.assert_array_equal(np.asarray(ps[k]), np.asarray(pl[k]))
