@@ -1585,6 +1585,15 @@ def _attach_compile_stats(res: dict) -> None:
         res["resilience_stats"] = resilience_metrics.snapshot()
     except Exception:
         pass
+    try:
+        from deeplearning4j_tpu.runtime.telemetry import registry
+
+        # the unified registry snapshot (run id, wall span, all four
+        # counter families, device memory) makes every BENCH_*.json row
+        # self-describing — MIGRATION.md documents the `telemetry` key
+        res["telemetry"] = registry.snapshot()
+    except Exception:
+        pass
 
 
 def _bench_cache_dir() -> str:

@@ -63,20 +63,41 @@ def cmd_train(args) -> int:
         MultiLayerConfiguration)
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+    from deeplearning4j_tpu.runtime import telemetry
 
-    with open(args.conf) as fh:
-        conf = MultiLayerConfiguration.from_json(fh.read())
-    data = _load_dataset(args.input,
-                         binarize=not args.raw_pixels)
-    net = MultiLayerNetwork(conf).init(seed=args.seed)
-    net.set_listeners([ScoreIterationListener(args.log_every)])
-    batches = (data.batch_by(args.batch) if args.batch > 0 else data)
-    net.fit(batches, num_epochs=args.epochs)
-    with open(args.output, "wb") as fh:
-        fh.write(net.to_bytes())
-    ev = net.evaluate(data)
-    print(f"saved model to {args.output}")
-    print(f"train accuracy: {ev.accuracy():.4f}")
+    tracer = None
+    journal_dir = args.telemetry
+    if journal_dir is True:                 # bare --telemetry flag
+        journal_dir = telemetry.DEFAULT_JOURNAL_DIR
+    if journal_dir:
+        tracer = telemetry.enable()
+        telemetry.registry.mark()
+    try:
+        with open(args.conf) as fh:
+            conf = MultiLayerConfiguration.from_json(fh.read())
+        data = _load_dataset(args.input,
+                             binarize=not args.raw_pixels)
+        net = MultiLayerNetwork(conf).init(seed=args.seed)
+        net.set_listeners([ScoreIterationListener(args.log_every)])
+        batches = (data.batch_by(args.batch) if args.batch > 0 else data)
+        net.fit(batches, num_epochs=args.epochs)
+        with open(args.output, "wb") as fh:
+            fh.write(net.to_bytes())
+        ev = net.evaluate(data)
+        print(f"saved model to {args.output}")
+        print(f"train accuracy: {ev.accuracy():.4f}")
+    finally:
+        # export even when the fit raises or is interrupted — a failed
+        # run is exactly when the journal is needed for the post-mortem
+        if tracer is not None:
+            import os
+            os.makedirs(journal_dir, exist_ok=True)
+            journal = os.path.join(journal_dir, f"{tracer.run_id}.jsonl")
+            tracer.export_journal(journal,
+                                  snapshot=telemetry.registry.snapshot())
+            print(f"telemetry journal: {journal}  (summarize with "
+                  f"`python -m deeplearning4j_tpu.cli telemetry "
+                  f"--journal {journal}`)")
     return 0
 
 
@@ -101,6 +122,63 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """Summarize a telemetry journal (runtime/telemetry.py JSONL): span
+    tree with aggregate timings, top-k longest spans, event counts, and
+    counter deltas between the journal's first and last registry
+    snapshots.  ``--export-trace`` additionally converts the journal to
+    chrome://tracing/Perfetto trace JSON."""
+    from deeplearning4j_tpu.runtime import telemetry
+
+    records = telemetry.read_journal(args.journal)
+    summary = telemetry.summarize_journal(records, top_k=args.top)
+
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        for run in summary["runs"]:
+            dropped = run.get("dropped", 0)
+            print(f"run {run.get('run_id')}  (dropped records: {dropped})")
+        print(f"{summary['n_spans']} span(s), "
+              f"{summary['n_events']} event(s)")
+        if summary["tree"]:
+            print("\nspan tree (aggregated by name under parent):")
+            print(f"  {'span':<44} {'count':>6} {'total ms':>10} "
+                  f"{'mean ms':>9} {'max ms':>9}")
+            for row in summary["tree"]:
+                label = "  " * row["depth"] + row["name"]
+                print(f"  {label:<44} {row['count']:>6} "
+                      f"{row['total_ms']:>10.2f} {row['mean_ms']:>9.2f} "
+                      f"{row['max_ms']:>9.2f}")
+        if summary["top"]:
+            print(f"\ntop {len(summary['top'])} spans by duration:")
+            for r in summary["top"]:
+                print(f"  {r['dur_ms']:>10.2f} ms  {r['name']}"
+                      f"  @{r['ts']:.3f}s  {r['attrs'] or ''}")
+        if summary["events"]:
+            print("\nevents:")
+            for name, n in sorted(summary["events"].items()):
+                print(f"  {n:>6} x {name}")
+        if "counter_deltas" in summary:
+            print("\ncounter deltas (last snapshot - first):")
+            print(json.dumps(summary["counter_deltas"], indent=2,
+                             default=str))
+        elif "counters" in summary:
+            print("\ncounters (single snapshot):")
+            print(json.dumps(summary["counters"], indent=2, default=str))
+
+    if args.export_trace:
+        run_id = summary["runs"][0].get("run_id", "run") \
+            if summary["runs"] else "run"
+        payload = telemetry.chrome_trace(records, run_id=run_id)
+        with open(args.export_trace, "w") as fh:
+            json.dump(payload, fh)
+        print(f"\nwrote Perfetto trace JSON to {args.export_trace} "
+              f"({len(payload['traceEvents'])} events) — load at "
+              "https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
@@ -122,6 +200,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="keep mnist pixels as [0,1] floats instead of the "
                         "reference's >30/255 binarization")
     t.add_argument("--log-every", type=int, default=10)
+    # const=True: a bare `--telemetry` resolves to the default journal
+    # dir (runtime.telemetry.DEFAULT_JOURNAL_DIR, honoring
+    # $DL4J_TPU_TELEMETRY_DIR) at use time — resolved in cmd_train so
+    # building the parser never imports the runtime
+    t.add_argument("--telemetry", nargs="?", default=None, const=True,
+                   metavar="DIR",
+                   help="enable the run tracer and write a JSONL journal "
+                        "into DIR (bare --telemetry uses the gitignored "
+                        "'.dl4j_telemetry', or $DL4J_TPU_TELEMETRY_DIR)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("test", help="evaluate a saved model")
@@ -136,6 +223,22 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--output", default=None)
     r.add_argument("--raw-pixels", action="store_true")
     r.set_defaults(fn=cmd_predict)
+
+    m = sub.add_parser(
+        "telemetry",
+        help="summarize a run-telemetry journal (span tree, top-k "
+             "durations, counter deltas; optional Perfetto export)")
+    m.add_argument("--journal", required=True,
+                   help="JSONL journal written by "
+                        "runtime/telemetry.py export_journal()")
+    m.add_argument("--top", type=int, default=10,
+                   help="how many longest spans to list")
+    m.add_argument("--json", action="store_true",
+                   help="emit the summary as JSON instead of text")
+    m.add_argument("--export-trace", default=None, metavar="PATH",
+                   help="also convert the journal to chrome://tracing/"
+                        "Perfetto trace JSON at PATH")
+    m.set_defaults(fn=cmd_telemetry)
     return p
 
 

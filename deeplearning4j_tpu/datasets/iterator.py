@@ -264,6 +264,7 @@ class PrefetchIterator(DataSetIterator):
         """Pad + device_put one batch onto the mesh (producer thread)."""
         import time
 
+        from deeplearning4j_tpu.runtime import telemetry
         from deeplearning4j_tpu.runtime.metrics import dp_metrics
 
         from deeplearning4j_tpu.parallel.mesh import pad_rows
@@ -277,8 +278,14 @@ class PrefetchIterator(DataSetIterator):
         t0 = time.perf_counter()
         x = jax.device_put(x, self.sharding)
         y = jax.device_put(y, self.sharding)
-        dp_metrics.note_staged(x.nbytes + y.nbytes,
-                               (time.perf_counter() - t0) * 1e3)
+        stage_ms = (time.perf_counter() - t0) * 1e3
+        dp_metrics.note_staged(x.nbytes + y.nbytes, stage_ms)
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            # staging runs on the producer thread; the event carries the
+            # evidence the ingestion bench needs (bytes + submit latency)
+            tr.event("ingest.stage", bytes=int(x.nbytes + y.nbytes),
+                     stage_ms=round(stage_ms, 3), rows=int(n_valid))
         staged = DataSet(x, y)
         staged.n_valid = n_valid
         return staged

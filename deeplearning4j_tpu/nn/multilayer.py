@@ -45,7 +45,7 @@ from deeplearning4j_tpu.nn.params import pack_params, unpack_params
 from deeplearning4j_tpu.ops.updaters import apply_updates, dl4j_updater
 from deeplearning4j_tpu.optimize.solver import Objective, Solver
 from deeplearning4j_tpu.optimize.listeners import IterationListener
-from deeplearning4j_tpu.runtime import compile_cache, resilience
+from deeplearning4j_tpu.runtime import compile_cache, resilience, telemetry
 
 log = logging.getLogger(__name__)
 
@@ -83,6 +83,9 @@ class MultiLayerNetwork:
         self._bp_cache: Dict = {}
         self._serving_cache = None
         self._serving_engine_memo = None
+        #: cumulative in-step guard skips across this network's fits —
+        #: exposed so listeners (MetricsListener) can log it per step
+        self.guard_skips = 0
 
     # -- wiring (init:325 parity) ------------------------------------------
     def _wire_layer_sizes(self) -> None:
@@ -261,6 +264,7 @@ class MultiLayerNetwork:
         # pre-fit params stay valid
         params = jax.tree.map(jnp.copy, self._require_params())
         batches = [data] if isinstance(data, DataSet) else list(data)
+        self._notify_fit_start()
         key = jax.random.key(seed)
         for i, layer in enumerate(self.layers):
             if not isinstance(layer, PretrainLayer):
@@ -813,11 +817,20 @@ class MultiLayerNetwork:
         batches = [data] if isinstance(data, DataSet) else list(data)
         if not batches:
             return
+        self._notify_fit_start()
         min_batch = min(b.features.shape[0] for b in batches)
         rmesh = self._resolve_fit_mesh(mesh, min_batch)
-        if rmesh is not None or self.conf.grad_accum > 1:
-            self._fit_backprop_dp(batches, num_epochs, seed, rmesh)
-            return
+        dp = rmesh is not None or self.conf.grad_accum > 1
+        with telemetry.span("multilayer.fit", path="dp" if dp else "single",
+                            epochs=num_epochs, batches=len(batches)):
+            if dp:
+                self._fit_backprop_dp(batches, num_epochs, seed, rmesh)
+            else:
+                self._fit_backprop_single(batches, num_epochs, seed)
+
+    def _fit_backprop_single(self, batches, num_epochs: int,
+                             seed: int) -> None:
+        """The single-device fit body (no mesh, no grad accumulation)."""
         # donation guard: the engine steps donate params/ustate buffers;
         # one copy at the API boundary keeps caller-held references to
         # the pre-fit params valid (only loop-internal buffers, which no
@@ -840,11 +853,19 @@ class MultiLayerNetwork:
                             for b in batches}) == 1)
         it = 0
         if uniform:
-            xs = jnp.stack([jnp.asarray(b.features) for b in batches])
-            ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
-            params, ustate, scores, skips = train_epochs(
-                params, ustate, xs, ys, run_key, it, num_epochs)
-            self._note_skips(skips)
+            with telemetry.span("multilayer.stage",
+                                batches=len(batches)) as sp:
+                xs = jnp.stack([jnp.asarray(b.features) for b in batches])
+                ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
+                sp.set(bytes=_nbytes(xs) + _nbytes(ys))
+            # the dispatch span closes after _note_skips — the one
+            # device sync that makes the scanned program's wall time
+            # honest (the dispatch itself returns immediately)
+            with telemetry.span("multilayer.dispatch", scanned=True,
+                                steps=num_epochs * len(batches)):
+                params, ustate, scores, skips = train_epochs(
+                    params, ustate, xs, ys, run_key, it, num_epochs)
+                self._note_skips(skips)
             if self.listeners:
                 for j, s in enumerate(np.asarray(scores).ravel()):
                     for ls in self.listeners:
@@ -853,10 +874,11 @@ class MultiLayerNetwork:
         else:
             skips = []
             for epoch in range(num_epochs):
-                for batch in batches:
-                    params, ustate, it = self._step_and_notify(
-                        train_step, params, ustate, batch, run_key, it,
-                        skips)
+                with telemetry.span("multilayer.epoch", epoch=epoch):
+                    for batch in batches:
+                        params, ustate, it = self._step_and_notify(
+                            train_step, params, ustate, batch, run_key, it,
+                            skips)
             self._note_skips(skips)
         self.params = params
 
@@ -912,19 +934,27 @@ class MultiLayerNetwork:
                 # pre-shard the stacked epoch on its way into HBM: the
                 # transfer itself is the scatter, and the one fit
                 # dispatch below finds every shard already resident
-                t0 = time.perf_counter()
-                sharding = sharded_fit.stacked_sharding(rmesh)
-                xs = jax.device_put(xs, sharding)
-                ys = jax.device_put(ys, sharding)
-                dp_metrics.note_staged(
-                    _nbytes(xs) + _nbytes(ys),
-                    (time.perf_counter() - t0) * 1e3)
-            params, ustate, scores, skips = train_epochs(
-                params, ustate, (xs, ys, nvs), run_key, it, num_epochs)
-            dp_metrics.note_dispatch(
-                steps=num_epochs * len(batches), accum=accum,
-                data_degree=ndp)
-            self._note_skips(skips)
+                with telemetry.span("multilayer.stage", sharded=True,
+                                    batches=len(batches)) as sp:
+                    t0 = time.perf_counter()
+                    sharding = sharded_fit.stacked_sharding(rmesh)
+                    xs = jax.device_put(xs, sharding)
+                    ys = jax.device_put(ys, sharding)
+                    dp_metrics.note_staged(
+                        _nbytes(xs) + _nbytes(ys),
+                        (time.perf_counter() - t0) * 1e3)
+                    sp.set(bytes=_nbytes(xs) + _nbytes(ys))
+            # span closes after the skip booking's device sync so the
+            # scanned dispatch's measured duration is honest wall time
+            with telemetry.span("multilayer.dispatch", scanned=True,
+                                data_degree=ndp, accum=accum,
+                                steps=num_epochs * len(batches)):
+                params, ustate, scores, skips = train_epochs(
+                    params, ustate, (xs, ys, nvs), run_key, it, num_epochs)
+                dp_metrics.note_dispatch(
+                    steps=num_epochs * len(batches), accum=accum,
+                    data_degree=ndp)
+                self._note_skips(skips)
             if self.listeners:
                 for j, s in enumerate(np.asarray(scores).ravel()):
                     for ls in self.listeners:
@@ -933,19 +963,21 @@ class MultiLayerNetwork:
         else:
             skips = []
             for epoch in range(num_epochs):
-                for b, target in zip(batches, pad_to):
-                    dp_batch = (self._pad_rows(b.features, target),
-                                self._pad_rows(b.labels, target),
-                                jnp.int32(b.features.shape[0]))
-                    params, ustate, score, skipped = train_step(
-                        params, ustate, dp_batch, run_key, it)
-                    skips.append(skipped)
-                    if self.listeners:
-                        for ls in self.listeners:
-                            ls.iteration_done(self, it, float(score))
-                    it += 1
-                    dp_metrics.note_dispatch(steps=1, accum=accum,
-                                             data_degree=ndp)
+                with telemetry.span("multilayer.epoch", epoch=epoch,
+                                    data_degree=ndp):
+                    for b, target in zip(batches, pad_to):
+                        dp_batch = (self._pad_rows(b.features, target),
+                                    self._pad_rows(b.labels, target),
+                                    jnp.int32(b.features.shape[0]))
+                        params, ustate, score, skipped = train_step(
+                            params, ustate, dp_batch, run_key, it)
+                        skips.append(skipped)
+                        if self.listeners:
+                            for ls in self.listeners:
+                                ls.iteration_done(self, it, float(score))
+                        it += 1
+                        dp_metrics.note_dispatch(steps=1, accum=accum,
+                                                 data_degree=ndp)
             self._note_skips(skips)
         self.params = params
 
@@ -967,12 +999,23 @@ class MultiLayerNetwork:
                 ls.iteration_done(self, step, float(score))
         return params, ustate, step + 1
 
-    @staticmethod
-    def _note_skips(skips) -> None:
+    def _note_skips(self, skips) -> None:
         """Book guard-skipped steps — ONE device sync per fit (skips is
         either the scanned [E, NB] flag array or a list of per-step
-        device scalars); shared impl in runtime/resilience.py."""
-        resilience.note_skips(skips, where="multilayer")
+        device scalars); shared impl in runtime/resilience.py.  The
+        count also accumulates into ``self.guard_skips`` so listeners
+        can log the model's fault history alongside its scores."""
+        self.guard_skips += resilience.note_skips(skips, where="multilayer")
+
+    def _notify_fit_start(self) -> None:
+        """Fit-entry listener hook: lets stateful listeners reset
+        per-fit state (MetricsListener's step timer) before step 0.
+        getattr-guarded — duck-typed listeners that only implement
+        iteration_done keep working."""
+        for ls in self.listeners:
+            hook = getattr(ls, "on_fit_start", None)
+            if callable(hook):
+                hook(self)
 
     def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2,
                      mesh="auto", prefetch_depth: int = 2) -> None:
@@ -1006,6 +1049,7 @@ class MultiLayerNetwork:
                 "conf wants pretrain/finetune (pretrain="
                 f"{self.conf.pretrain}, backprop={self.conf.backprop}) — "
                 "use fit() with materialized batches")
+        self._notify_fit_start()
         batch_hint = getattr(it, "batch", 0) or 0
         if mesh == "auto" and batch_hint <= 0:
             rmesh = None        # unknown batch size: don't auto-shard blind
@@ -1039,31 +1083,36 @@ class MultiLayerNetwork:
                     pad_rows_to=chunk)
         step = 0
         skips = []
-        for _ in range(num_epochs):
-            src.reset()
-            while src.has_next():
-                batch = src.next()
-                if dp_mode:
-                    n_valid = getattr(batch, "n_valid", None)
-                    if n_valid is None:
-                        n_valid = batch.features.shape[0]
-                    target = -(-int(n_valid) // chunk) * chunk
-                    self._check_bn_padding(target != int(n_valid))
-                    dp_batch = (self._pad_rows(batch.features, target),
+        with telemetry.span("multilayer.fit", path="iterator",
+                            epochs=num_epochs, sharded=rmesh is not None):
+            for epoch in range(num_epochs):
+                with telemetry.span("multilayer.epoch", epoch=epoch):
+                    src.reset()
+                    while src.has_next():
+                        batch = src.next()
+                        if dp_mode:
+                            n_valid = getattr(batch, "n_valid", None)
+                            if n_valid is None:
+                                n_valid = batch.features.shape[0]
+                            target = -(-int(n_valid) // chunk) * chunk
+                            self._check_bn_padding(target != int(n_valid))
+                            dp_batch = (
+                                self._pad_rows(batch.features, target),
                                 self._pad_rows(batch.labels, target),
                                 jnp.int32(n_valid))
-                    params, ustate, score, skipped = train_step(
-                        params, ustate, dp_batch, run_key, step)
-                    skips.append(skipped)
-                    if self.listeners:
-                        for ls in self.listeners:
-                            ls.iteration_done(self, step, float(score))
-                    step += 1
-                else:
-                    params, ustate, step = self._step_and_notify(
-                        train_step, params, ustate, batch, run_key, step,
-                        skips)
-        self._note_skips(skips)
+                            params, ustate, score, skipped = train_step(
+                                params, ustate, dp_batch, run_key, step)
+                            skips.append(skipped)
+                            if self.listeners:
+                                for ls in self.listeners:
+                                    ls.iteration_done(self, step,
+                                                      float(score))
+                            step += 1
+                        else:
+                            params, ustate, step = self._step_and_notify(
+                                train_step, params, ustate, batch, run_key,
+                                step, skips)
+            self._note_skips(skips)
         self.params = params
 
     # -- fit (fit:918 parity: pretrain -> finetune -> optional backprop) ---
@@ -1080,9 +1129,11 @@ class MultiLayerNetwork:
     # -- evaluation helper -------------------------------------------------
     def evaluate(self, data: DataSet):
         from deeplearning4j_tpu.eval.evaluation import Evaluation
-        ev = Evaluation(num_classes=data.num_outcomes())
-        ev.eval(data.labels, self.output(data.features))
-        return ev
+        with telemetry.span("multilayer.eval",
+                            rows=int(data.features.shape[0])):
+            ev = Evaluation(num_classes=data.num_outcomes())
+            ev.eval(data.labels, self.output(data.features))
+            return ev
 
     # -- params plumbing (pack:773 / unPack:817 / merge:1321 / setParams) --
     def params_flat(self) -> Array:

@@ -17,6 +17,14 @@ class IterationListener:
     def iteration_done(self, model: Any, iteration: int, score: float) -> None:
         raise NotImplementedError
 
+    def on_fit_start(self, model: Any) -> None:
+        """Called once at every fit entry (``fit_backprop`` /
+        ``fit_iterator`` / ``pretrain`` / ``ResilientFit.fit``) BEFORE
+        any step runs — stateful listeners reset per-fit state here
+        (e.g. ``MetricsListener``'s step timer, which would otherwise
+        label the first step of a second fit with the inter-fit wall
+        gap).  Default: no-op."""
+
 
 class ScoreIterationListener(IterationListener):
     """Logs the score every N iterations
@@ -41,6 +49,10 @@ class ComposableIterationListener(IterationListener):
     def iteration_done(self, model, iteration, score):
         for ls in self.listeners:
             ls.iteration_done(model, iteration, score)
+
+    def on_fit_start(self, model):
+        for ls in self.listeners:
+            ls.on_fit_start(model)
 
 
 class CollectScoresListener(IterationListener):

@@ -129,12 +129,15 @@ class WorkAccumulator(JobAggregator):
         self._n = 0
 
     def _reject(self, job: Job, why: str) -> None:
+        from deeplearning4j_tpu.runtime import telemetry
         from deeplearning4j_tpu.runtime.metrics import resilience_metrics
 
         self.rejected += 1
         resilience_metrics.note("updates_rejected")
         if self.tracker is not None:
             self.tracker.increment("updates_rejected")
+        telemetry.event("scaleout.update_rejected",
+                        worker=str(job.worker_id), why=why)
         log.warning("rejecting %s result from worker %r; excluded from "
                     "the round average", why, job.worker_id)
 
@@ -295,6 +298,8 @@ def master_pump(tracker: StateTracker, jobs: JobIterator,
     discarded because the last job wedged — and the error carries the
     queued/in-flight/worker counts for debuggability.
     """
+    from deeplearning4j_tpu.runtime import telemetry
+
     deadline = time.time() + timeout_s
     sync = router.synchronous_rounds
     round_jobs: List[Job] = []
@@ -315,46 +320,53 @@ def master_pump(tracker: StateTracker, jobs: JobIterator,
         if agg is not None:
             tracker.set_current(agg)
 
-    while time.time() < deadline:
-        if reap:
-            removed = tracker.remove_stale_workers()
-            if removed:
-                log.warning("reaped stale workers %s; jobs requeued",
-                            removed)
-                tracker.increment("workers_reaped", len(removed))
-        # 1) collect results; sync publishes only at the round boundary,
-        #    async as soon as anything arrived
-        round_jobs.extend(tracker.drain_updates())
-        if round_jobs and (not sync or not tracker.has_pending()):
+    with telemetry.span("scaleout.master_pump", timeout_s=timeout_s):
+        while time.time() < deadline:
+            if reap:
+                removed = tracker.remove_stale_workers()
+                if removed:
+                    log.warning("reaped stale workers %s; jobs requeued",
+                                removed)
+                    tracker.increment("workers_reaped", len(removed))
+                    telemetry.event("scaleout.workers_reaped",
+                                    workers=[str(w) for w in removed])
+            # 1) collect results; sync publishes only at the round
+            #    boundary, async as soon as anything arrived
+            round_jobs.extend(tracker.drain_updates())
+            if round_jobs and (not sync or not tracker.has_pending()):
+                publish(round_jobs)
+                round_jobs = []
+            # 2) only then push new work — never start round N+1 while
+            #    round N results are drained-but-unpublished
+            if jobs.has_next():
+                if router.send_work() and not (sync and round_jobs):
+                    for _ in range(max(1, n_slots())):
+                        if not jobs.has_next():
+                            break
+                        tracker.add_job(jobs.next(""))
+            elif not tracker.has_pending() and not round_jobs:
+                break
+            time.sleep(poll)
+        else:
+            # drain-and-publish completed updates BEFORE raising: partial
+            # progress stays in tracker.get_current() for the caller's
+            # post-mortem/checkpoint instead of being discarded
+            round_jobs.extend(tracker.drain_updates())
             publish(round_jobs)
-            round_jobs = []
-        # 2) only then push new work — never start round N+1 while round
-        #    N results are drained-but-unpublished
-        if jobs.has_next():
-            if router.send_work() and not (sync and round_jobs):
-                for _ in range(max(1, n_slots())):
-                    if not jobs.has_next():
-                        break
-                    tracker.add_job(jobs.next(""))
-        elif not tracker.has_pending() and not round_jobs:
-            break
-        time.sleep(poll)
-    else:
-        # drain-and-publish completed updates BEFORE raising: partial
-        # progress stays in tracker.get_current() for the caller's
-        # post-mortem/checkpoint instead of being discarded
+            queued, in_flight = tracker.pending_counts()
+            telemetry.event("scaleout.timeout", timeout_s=timeout_s,
+                            queued=queued, in_flight=in_flight,
+                            workers=len(tracker.workers()),
+                            published=len(round_jobs))
+            raise TimeoutError(
+                f"distributed run did not finish within {timeout_s}s: "
+                f"{queued} queued + {in_flight} in-flight job(s), "
+                f"{len(tracker.workers())} live worker(s); "
+                f"{len(round_jobs)} completed update(s) were published — "
+                "partial aggregate preserved in tracker.get_current()")
         round_jobs.extend(tracker.drain_updates())
         publish(round_jobs)
-        queued, in_flight = tracker.pending_counts()
-        raise TimeoutError(
-            f"distributed run did not finish within {timeout_s}s: "
-            f"{queued} queued + {in_flight} in-flight job(s), "
-            f"{len(tracker.workers())} live worker(s); "
-            f"{len(round_jobs)} completed update(s) were published — "
-            "partial aggregate preserved in tracker.get_current()")
-    round_jobs.extend(tracker.drain_updates())
-    publish(round_jobs)
-    return tracker.get_current()
+        return tracker.get_current()
 
 
 # ---------------------------------------------------------------------------
@@ -389,8 +401,11 @@ class DistributedRunner:
 
     # -- worker loop (WorkerActor.checkJobAvailable:287 parity) ------------
     def _worker_loop(self, worker_id: str) -> None:
+        from deeplearning4j_tpu.runtime import telemetry
+
         performer = self.performer_factory()
         self.tracker.add_worker(worker_id)
+        telemetry.event("scaleout.worker_join", worker=worker_id)
         while not self._stop.is_set():
             self.tracker.heartbeat(worker_id)
             job = self.tracker.job_for(worker_id)

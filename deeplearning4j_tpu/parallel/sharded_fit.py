@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.compat import shard_map
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
-from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime import compile_cache, telemetry
 
 PyTree = Any
 #: shard_step(params, ustate, batch, key, it) -> (params, ustate, score,
@@ -50,6 +50,25 @@ ShardStep = Callable[..., Tuple[PyTree, PyTree, jax.Array, jax.Array]]
 #: per-batch instead (same number MultiLayerNetwork.SCAN_MAX_DATASET_BYTES
 #: has used since PR 1)
 SCAN_MAX_DATASET_BYTES = 256 * 1024 * 1024
+
+
+def _with_dispatch_span(compiled, label: str, scanned: bool):
+    """HOST-side telemetry shim around an already-compiled engine
+    callable: every dispatch gets a ``dp.dispatch`` span (submission
+    wall time — XLA execution is async; the caller's post-dispatch sync
+    is where the remainder lands).  Outside the jitted region by
+    construction, and a disabled tracer costs one global read."""
+    def dispatch_traced(*args, **kwargs):
+        tr = telemetry.get_tracer()
+        if tr is None:
+            return compiled(*args, **kwargs)
+        with tr.span("dp.dispatch", label=label, scanned=scanned):
+            return compiled(*args, **kwargs)
+
+    # preserve the engine-callable surface callers rely on
+    dispatch_traced.engine_label = getattr(compiled, "engine_label", label)
+    dispatch_traced.jitted = getattr(compiled, "jitted", None)
+    return dispatch_traced
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -80,9 +99,11 @@ def build_sharded_step(shard_step: ShardStep, mesh: Optional[Mesh], *,
         out_specs=(P(), P(), P(), P()),
         check_vma=False,
     )
-    return compile_cache.cached_jit(
-        sharded, key=engine_key, label=label,
-        donate_argnums=(0, 1) if donate else ())
+    return _with_dispatch_span(
+        compile_cache.cached_jit(
+            sharded, key=engine_key, label=label,
+            donate_argnums=(0, 1) if donate else ()),
+        label, scanned=False)
 
 
 def build_scanned_epochs(shard_step: ShardStep, mesh: Optional[Mesh], *,
@@ -135,6 +156,8 @@ def build_scanned_epochs(shard_step: ShardStep, mesh: Optional[Mesh], *,
             )
             return sharded(params, ustate, batches, key, it0)
 
-    return compile_cache.cached_jit(
-        epochs, key=engine_key, label=label, static_argnums=(5,),
-        donate_argnums=(0, 1) if donate else ())
+    return _with_dispatch_span(
+        compile_cache.cached_jit(
+            epochs, key=engine_key, label=label, static_argnums=(5,),
+            donate_argnums=(0, 1) if donate else ()),
+        label, scanned=True)

@@ -297,6 +297,7 @@ def _join_tracker(connection_string: str, worker_id: str,
     Returns (tracker, beat_tracker) or None when the budget is spent
     (master genuinely gone — exit cleanly, the reaper handles the rest).
     """
+    from deeplearning4j_tpu.runtime import telemetry
     from deeplearning4j_tpu.runtime.metrics import resilience_metrics
 
     for attempt in range(retries + 1):
@@ -304,6 +305,8 @@ def _join_tracker(connection_string: str, worker_id: str,
         try:
             tracker = RemoteStateTracker(connection_string, authkey=authkey)
             tracker.add_worker(worker_id)
+            telemetry.event("scaleout.worker_join", worker=worker_id,
+                            attempts=attempt + 1)
             # The heartbeat gets its OWN connection: the main loop's
             # socket is held for a full RPC round-trip, so a large
             # add_update (MLN params) would otherwise block heartbeats
@@ -316,12 +319,16 @@ def _join_tracker(connection_string: str, worker_id: str,
             if tracker is not None:
                 tracker.close()
             if attempt >= retries:
+                telemetry.event("scaleout.worker_join_failed",
+                                worker=worker_id, attempts=attempt + 1)
                 log.warning("worker %s could not join %s after %d "
                             "attempt(s) (%s); exiting", worker_id,
                             connection_string, attempt + 1, exc)
                 return None
             delay = backoff_s * (2 ** attempt)
             resilience_metrics.note("worker_join_retries")
+            telemetry.event("scaleout.worker_join_retry",
+                            worker=worker_id, attempt=attempt + 1)
             log.warning("worker %s join attempt %d/%d to %s failed "
                         "(%s); retrying in %.2fs", worker_id, attempt + 1,
                         retries + 1, connection_string, exc, delay)

@@ -306,10 +306,47 @@ class DataParallelMetrics:
 #: process-wide singleton the sharded fit paths + ingestion stage report into
 dp_metrics = DataParallelMetrics()
 
-# This import sits BELOW the compile counters on purpose: importing this
-# module can re-enter it through the
-# optimize/__init__ -> solver -> runtime.compile_cache cycle, and that
-# re-entry needs ``compile_metrics`` to already be bound.
+def device_memory_stats() -> Dict[str, Any]:
+    """Per-device HBM usage where the backend reports it.
+
+    Backends without memory accounting (CPU, some plugin versions) get an
+    explicit ``{"unsupported": <reason>}`` marker instead of ``None`` —
+    a CPU run and a genuinely failed stats call must stay
+    distinguishable in journals and bench rows (the error CLASS is the
+    reason; a backend that returns nothing reports ``"unreported"``)."""
+    stats = {}
+    for d in jax.devices():
+        try:
+            s = d.memory_stats()
+            stats[str(d)] = s if s is not None else {
+                "unsupported": "unreported"}
+        except Exception as e:  # noqa: BLE001 — backend-specific errors
+            stats[str(d)] = {"unsupported": type(e).__name__}
+    return stats
+
+
+def peak_bytes_in_use(stats: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Optional[int]]:
+    """Per-device ``peak_bytes_in_use`` pulled out of
+    :func:`device_memory_stats` (None where the backend doesn't report
+    memory) — the one number capacity planning actually wants."""
+    if stats is None:
+        stats = device_memory_stats()
+    out: Dict[str, Optional[int]] = {}
+    for dev, s in stats.items():
+        if isinstance(s, dict) and "unsupported" not in s:
+            peak = s.get("peak_bytes_in_use")
+            out[dev] = int(peak) if peak is not None else None
+        else:
+            out[dev] = None
+    return out
+
+
+# This import sits BELOW the counter singletons and the memory-stats
+# helpers on purpose: importing this module can re-enter it through the
+# optimize/__init__ -> solver -> runtime.compile_cache cycle (and, since
+# PR 6, solver -> resilience -> telemetry), and that re-entry needs
+# ``compile_metrics``/``device_memory_stats`` & co. to already be bound.
 from deeplearning4j_tpu.optimize.listeners import IterationListener  # noqa: E402
 
 
@@ -340,12 +377,27 @@ class ScalarsLogger:
 
 class MetricsListener(IterationListener):
     """IterationListener that records score + step wall-time to a
-    ScalarsLogger (and optionally samples/sec given a batch size)."""
+    ScalarsLogger (and optionally samples/sec given a batch size).
+
+    The step timer resets per FIT: the fit entry points call
+    ``on_fit_start`` (``optimize/listeners.py`` hook), so the first step
+    of a second ``fit()`` on the same listener is never mislabeled with
+    the inter-fit wall gap.  When the model exposes a ``guard_skips``
+    counter (``MultiLayerNetwork`` does — cumulative in-step guard
+    skips), it rides along in every record."""
 
     def __init__(self, logger: ScalarsLogger, batch_size: int = 0):
         self.logger = logger
         self.batch_size = batch_size
         self._last = None
+
+    def reset(self) -> None:
+        """Forget the previous step's timestamp (call between fits; the
+        fit entry points do this via ``on_fit_start``)."""
+        self._last = None
+
+    def on_fit_start(self, model) -> None:
+        self.reset()
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()
@@ -356,6 +408,9 @@ class MetricsListener(IterationListener):
             if self.batch_size and dt > 0:
                 scalars["samples_per_sec"] = self.batch_size / dt
         self._last = now
+        skips = getattr(model, "guard_skips", None)
+        if skips is not None:
+            scalars["guard_skips"] = skips
         self.logger.log(iteration, **scalars)
 
 
@@ -393,17 +448,6 @@ def annotate(name: str):
     """Named region in profiler timelines (TraceAnnotation)."""
     with jax.profiler.TraceAnnotation(name):
         yield
-
-
-def device_memory_stats() -> Dict[str, Any]:
-    """Per-device HBM usage where the backend reports it."""
-    stats = {}
-    for d in jax.devices():
-        try:
-            stats[str(d)] = d.memory_stats()
-        except Exception:
-            stats[str(d)] = None
-    return stats
 
 
 class Profiler:

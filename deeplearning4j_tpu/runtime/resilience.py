@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime import compile_cache, telemetry
 from deeplearning4j_tpu.runtime.checkpoint import CheckpointManager
 from deeplearning4j_tpu.runtime.metrics import resilience_metrics
 
@@ -118,6 +118,7 @@ def note_skips(skips, where: str = "train") -> int:
     n = int(jnp.sum(skips))
     if n:
         resilience_metrics.note("steps_skipped", n)
+        telemetry.event("resilience.guard_skips", count=n, where=where)
         log.warning("non-finite loss/gradient: %d %s step update(s) "
                     "skipped by the in-step guard", n, where)
     return n
@@ -331,6 +332,18 @@ class ResilientFit:
         batches = [data] if isinstance(data, DataSet) else list(data)
         n_batches = len(batches)
         total_steps = num_epochs * n_batches
+        # fit-entry listener hook — reuse the model's own dispatch when
+        # it has one (MultiLayerNetwork._notify_fit_start) so the hook
+        # semantics can't drift between direct and driver-run fits;
+        # inline fallback for duck-typed models
+        notify = getattr(net, "_notify_fit_start", None)
+        if callable(notify):
+            notify()
+        else:
+            for ls in getattr(net, "listeners", ()):
+                hook = getattr(ls, "on_fit_start", None)
+                if callable(hook):
+                    hook(net)
 
         # donation guard: the engine step consumes its params/ustate
         # buffers; copy once at this API boundary (same contract as
@@ -368,12 +381,15 @@ class ResilientFit:
                 self._check_restored(params, latest)
                 step = int(meta["step"])
                 rollbacks = int(meta.get("rollbacks", 0))
+                telemetry.event("resilience.resume", step=step,
+                                rollbacks=rollbacks)
                 log.info("resumed from checkpoint at step %d "
                          "(rollbacks=%d)", step, rollbacks)
 
         def save(at_step: int) -> None:
-            self.manager.save(at_step, (params, ustate),
-                              meta={"rollbacks": rollbacks})
+            with telemetry.span("resilience.checkpoint", step=at_step):
+                self.manager.save(at_step, (params, ustate),
+                                  meta={"rollbacks": rollbacks})
             resilience_metrics.note("checkpoints_saved")
 
         if self.manager.latest_step() is None:
@@ -406,12 +422,17 @@ class ResilientFit:
             if self.detector.observe(loss):
                 if rollbacks >= cfg.max_rollbacks:
                     resilience_metrics.note("retry_budget_exceeded")
+                    telemetry.event("resilience.retry_budget_exceeded",
+                                    step=step, rollbacks=rollbacks)
                     raise RetryBudgetExceeded(
                         f"loss anomaly survived {cfg.max_rollbacks} "
                         f"rollbacks (last-good step {last_good}); "
                         "refusing to burn more compute")
                 rollbacks += 1
                 resilience_metrics.note("rollbacks")
+                telemetry.event("resilience.rollback", step=step,
+                                to_step=int(last_good),
+                                rollbacks=rollbacks)
                 delay = cfg.backoff_s * (2 ** (rollbacks - 1))
                 log.warning(
                     "sustained loss anomaly at step %d; rolling back to "
@@ -419,12 +440,15 @@ class ResilientFit:
                     last_good, rollbacks, cfg.max_rollbacks, delay)
                 if delay > 0:
                     time.sleep(delay)
-                (params, ustate), meta = self.manager.restore(
-                    step=last_good,
-                    like=(jax.tree.map(jnp.copy, net._require_params()),
-                          [u.init(p) for u, p in
-                           zip(updaters, net._require_params())]))
-                self._check_restored(params, last_good)
+                with telemetry.span("resilience.restore",
+                                    step=int(last_good)):
+                    (params, ustate), meta = self.manager.restore(
+                        step=last_good,
+                        like=(jax.tree.map(jnp.copy,
+                                           net._require_params()),
+                              [u.init(p) for u, p in
+                               zip(updaters, net._require_params())]))
+                    self._check_restored(params, last_good)
                 step = int(last_good)
                 self.detector.reset()
                 continue
@@ -433,7 +457,11 @@ class ResilientFit:
                 save(step)
                 last_good = step
 
-        note_skips(skips, where="resilient-fit")
+        n_skipped = note_skips(skips, where="resilient-fit")
+        if n_skipped and hasattr(net, "guard_skips"):
+            # keep the model's cumulative counter honest on driver-run
+            # fits too — MetricsListener logs it per record
+            net.guard_skips += n_skipped
         self.steps_run = steps_this_call
         self.rollbacks = rollbacks
         net.params = params

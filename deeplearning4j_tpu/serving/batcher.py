@@ -34,6 +34,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.runtime import telemetry
 from deeplearning4j_tpu.runtime.metrics import serving_metrics
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
@@ -99,7 +100,11 @@ class DynamicBatcher:
             self._pending.append(req)
             serving_metrics.note_request(req.rows)
             serving_metrics.note_queue_depth(len(self._pending))
+            depth = len(self._pending)
             self._cv.notify()
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            tr.event("serving.enqueue", rows=req.rows, queue_depth=depth)
         return req.future
 
     def infer(self, x, timeout: Optional[float] = 30.0):
@@ -172,44 +177,66 @@ class DynamicBatcher:
             # all-rejected windows) must not inflate the coalescing
             # evidence the bench row reports
             serving_metrics.note_batch(len(batch))
-            try:
-                xs = np.concatenate([r.x for r in batch], axis=0) \
-                    if len(batch) > 1 else batch[0].x
-                # count_request=False: each client request was already
-                # counted at submit; the coalesced dispatch is not a
-                # new request
-                out = self.engine.infer(xs, params=self._params, sync=True,
-                                        count_request=False)
-                # materialize once, leaf-wise: single-array models
-                # resolve to np arrays, pytree outputs keep their
-                # structure with each leaf row-sliced per request
-                out = jax.tree.map(np.asarray, out)
-            except Exception as e:          # resolve, never wedge clients
-                for r in batch:
-                    if not r.future.set_running_or_notify_cancel():
-                        continue
-                    r.future.set_exception(e)
-                continue
-            now = time.perf_counter()
-            off = 0
-            try:
-                for r in batch:
-                    a, b = off, off + r.rows
-                    res = jax.tree.map(
-                        lambda o: o[a] if r.single else o[a:b], out)
-                    off += r.rows
-                    serving_metrics.note_latency_ms((now - r.t_submit) * 1e3)
-                    if r.future.set_running_or_notify_cancel():
-                        r.future.set_result(res)
-            except Exception as e:
-                # distribution failure (e.g. an apply_fn output leaf
-                # without a leading batch dim) must fail THIS batch's
-                # unresolved futures, never kill the worker — a dead
-                # worker wedges every later client until timeout
-                for r in batch:
-                    if not r.future.done() and \
-                            r.future.set_running_or_notify_cancel():
+            tr = telemetry.get_tracer()
+            if tr is not None:
+                # queue age of the cohort = how long its OLDEST request
+                # waited for the window to close (the coalescing latency
+                # the max_delay_ms knob trades throughput against).
+                # Computed ONLY under an active tracer: the disabled
+                # path must stay free of per-cohort bookkeeping.
+                rows = sum(r.rows for r in batch)
+                age_ms = (time.perf_counter()
+                          - min(r.t_submit for r in batch)) * 1e3
+                tr.event("serving.cohort_formed", n_requests=len(batch),
+                         rows=rows, queue_age_ms=round(age_ms, 3))
+                cohort_sp = tr.span("serving.cohort",
+                                    n_requests=len(batch), rows=rows,
+                                    queue_age_ms=round(age_ms, 3))
+            else:
+                cohort_sp = telemetry.NOOP_SPAN
+            with cohort_sp:
+                try:
+                    xs = np.concatenate([r.x for r in batch], axis=0) \
+                        if len(batch) > 1 else batch[0].x
+                    # count_request=False: each client request was already
+                    # counted at submit; the coalesced dispatch is not a
+                    # new request
+                    out = self.engine.infer(xs, params=self._params,
+                                            sync=True, count_request=False)
+                    # materialize once, leaf-wise: single-array models
+                    # resolve to np arrays, pytree outputs keep their
+                    # structure with each leaf row-sliced per request
+                    out = jax.tree.map(np.asarray, out)
+                except Exception as e:      # resolve, never wedge clients
+                    for r in batch:
+                        if not r.future.set_running_or_notify_cancel():
+                            continue
                         r.future.set_exception(e)
+                    continue
+                now = time.perf_counter()
+                off = 0
+                try:
+                    for r in batch:
+                        a, b = off, off + r.rows
+                        res = jax.tree.map(
+                            lambda o: o[a] if r.single else o[a:b], out)
+                        off += r.rows
+                        lat_ms = (now - r.t_submit) * 1e3
+                        serving_metrics.note_latency_ms(lat_ms)
+                        if tr is not None:
+                            tr.event("serving.complete", rows=r.rows,
+                                     latency_ms=round(lat_ms, 3))
+                        if r.future.set_running_or_notify_cancel():
+                            r.future.set_result(res)
+                except Exception as e:
+                    # distribution failure (e.g. an apply_fn output leaf
+                    # without a leading batch dim) must fail THIS batch's
+                    # unresolved futures, never kill the worker — a dead
+                    # worker wedges every later client until timeout
+                    for r in batch:
+                        if not r.future.done() and \
+                                r.future.set_running_or_notify_cancel():
+                            r.future.set_exception(e)
 
     # -- lifecycle ---------------------------------------------------------
     def close(self, timeout: float = 30.0) -> None:

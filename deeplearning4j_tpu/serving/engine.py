@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime import compile_cache, telemetry
 from deeplearning4j_tpu.runtime.metrics import serving_metrics
 
 Array = jax.Array
@@ -162,12 +162,13 @@ class InferenceEngine:
         before = compile_metrics.snapshot()["traces"].get(self.label, 0)
         p = self.current_params(params)
         t0 = time.perf_counter()
-        outs = []
-        for b in self.buckets:
-            x = np.zeros((b,) + tuple(input_shape), dtype=dtype)
-            outs.append(self._call_forward(p, x))
-        for o in outs:
-            jax.block_until_ready(o)
+        with telemetry.span("serving.warmup", buckets=len(self.buckets)):
+            outs = []
+            for b in self.buckets:
+                x = np.zeros((b,) + tuple(input_shape), dtype=dtype)
+                outs.append(self._call_forward(p, x))
+            for o in outs:
+                jax.block_until_ready(o)
         wall_ms = (time.perf_counter() - t0) * 1e3
         compiles = (compile_metrics.snapshot()["traces"].get(self.label, 0)
                     - before)
@@ -196,7 +197,13 @@ class InferenceEngine:
         n = x.shape[0]
         bucket = pick_bucket(n, self.buckets)
         serving_metrics.note_dispatch(bucket)
-        out = self._call_forward(params, pad_rows(x, bucket))
+        # per-request hot path: guard BEFORE building the attr kwargs —
+        # the conditional only evaluates tr.span(...) when tracing
+        tr = telemetry.get_tracer()
+        sp = tr.span("serving.dispatch", bucket=bucket, rows=n) \
+            if tr is not None else telemetry.NOOP_SPAN
+        with sp:
+            out = self._call_forward(params, pad_rows(x, bucket))
         if bucket == n:
             return out
         return jax.tree.map(lambda o: o[:n], out)
@@ -215,17 +222,21 @@ class InferenceEngine:
         n = x.shape[0]
         if count_request:
             serving_metrics.note_request(n)
-        p = self.current_params(params)
-        cap = self.buckets[-1]
-        if n <= cap:
-            out = self._dispatch(x, p)
-        else:
-            parts = [self._dispatch(x[i:i + cap], p)
-                     for i in range(0, n, cap)]
-            out = jax.tree.map(lambda *ls: jnp.concatenate(ls, axis=0),
-                               *parts)
-        if sync:
-            jax.block_until_ready(out)
+        tr = telemetry.get_tracer()
+        sp = tr.span("serving.infer", rows=n) if tr is not None \
+            else telemetry.NOOP_SPAN
+        with sp:
+            p = self.current_params(params)
+            cap = self.buckets[-1]
+            if n <= cap:
+                out = self._dispatch(x, p)
+            else:
+                parts = [self._dispatch(x[i:i + cap], p)
+                         for i in range(0, n, cap)]
+                out = jax.tree.map(lambda *ls: jnp.concatenate(ls, axis=0),
+                                   *parts)
+            if sync:
+                jax.block_until_ready(out)
         if self.input_spec is None:
             self.input_spec = (x.shape[1:], x.dtype)
         if count_request:

@@ -1,0 +1,200 @@
+"""Observability-primitive tests (runtime/metrics.py): the previously
+untested ScalarsLogger and ThroughputMeter, the device_memory_stats
+unsupported-marker contract, and the MetricsListener per-fit reset +
+guard_skips logging satellites."""
+
+import json
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.runtime.metrics import (MetricsListener,
+                                                ScalarsLogger,
+                                                ThroughputMeter,
+                                                device_memory_stats,
+                                                peak_bytes_in_use)
+
+
+# -- ScalarsLogger ----------------------------------------------------------
+
+def test_scalars_logger_append_read_round_trip(tmp_path):
+    path = str(tmp_path / "sub" / "scalars.jsonl")
+    lg = ScalarsLogger(path)          # creates the parent dir
+    lg.log(0, score=1.5)
+    lg.log(1, score=1.25, lr=0.1)
+    lg.close()
+    rows = ScalarsLogger.read(path)
+    assert [r["step"] for r in rows] == [0, 1]
+    assert rows[0]["score"] == 1.5
+    assert rows[1]["lr"] == 0.1
+    assert all("wall" in r for r in rows)
+    # append-only: a second logger on the same path extends, not clobbers
+    lg2 = ScalarsLogger(path)
+    lg2.log(2, score=1.0)
+    lg2.close()
+    assert [r["step"] for r in ScalarsLogger.read(path)] == [0, 1, 2]
+
+
+def test_scalars_logger_concurrent_writers(tmp_path):
+    """N threads sharing one logger: every record lands intact (line-
+    buffered single-line writes; json.loads on every line must work)."""
+    path = str(tmp_path / "conc.jsonl")
+    lg = ScalarsLogger(path)
+    n_threads, per_thread = 8, 50
+
+    def writer(tid):
+        for i in range(per_thread):
+            lg.log(tid * per_thread + i, score=float(tid))
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lg.close()
+    rows = ScalarsLogger.read(path)   # raises if any line is mangled
+    assert len(rows) == n_threads * per_thread
+    assert {r["step"] for r in rows} == set(range(n_threads * per_thread))
+
+
+# -- ThroughputMeter --------------------------------------------------------
+
+def test_throughput_meter_window_eviction():
+    m = ThroughputMeter(window=4)
+    assert m.tick(10) is None         # a single event has no rate yet
+    for _ in range(10):
+        m.tick(10)
+    # events beyond the window are evicted, never accumulated
+    assert len(m._events) == 4
+    rate = m.tick(10)
+    assert rate is not None and rate > 0
+
+
+def test_throughput_meter_zero_dt_guard(monkeypatch):
+    """Two ticks at the SAME timestamp must return None, not divide by
+    zero (perf_counter can legally return equal values back-to-back on
+    coarse clocks)."""
+    import deeplearning4j_tpu.runtime.metrics as metrics_mod
+
+    t = [100.0]
+    monkeypatch.setattr(metrics_mod.time, "perf_counter", lambda: t[0])
+    m = ThroughputMeter(window=4)
+    m.tick(5)
+    assert m.tick(5) is None          # dt == 0 -> None, no ZeroDivisionError
+
+
+# -- device memory stats (satellite fix) ------------------------------------
+
+def test_device_memory_stats_marks_unsupported_not_none():
+    """CPU backends report no memory stats — the entry must be an
+    explicit {'unsupported': <reason>} marker, never None, so journals
+    can distinguish 'CPU run' from 'stats call failed'."""
+    stats = device_memory_stats()
+    assert stats  # at least one device
+    for dev, s in stats.items():
+        assert s is not None, f"{dev} regressed to None"
+        assert isinstance(s, dict)
+        if "unsupported" in s:
+            assert isinstance(s["unsupported"], str) and s["unsupported"]
+
+
+def test_peak_bytes_in_use_extractor():
+    # live stats: CPU -> all None, real backend -> ints
+    peaks = peak_bytes_in_use()
+    assert set(peaks) == set(device_memory_stats())
+    assert all(p is None or isinstance(p, int) for p in peaks.values())
+    # synthetic stats exercise both branches deterministically
+    fake = {"tpu:0": {"peak_bytes_in_use": 123, "bytes_in_use": 7},
+            "cpu:0": {"unsupported": "unreported"},
+            "tpu:1": {"bytes_in_use": 9}}
+    got = peak_bytes_in_use(fake)
+    assert got == {"tpu:0": 123, "cpu:0": None, "tpu:1": None}
+
+
+# -- MetricsListener (satellite fix) ----------------------------------------
+
+def _tiny_net():
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).num_iterations(1).activation("tanh")
+            .list(2).hidden_layer_sizes(6)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    return MultiLayerNetwork(conf).init(seed=0)
+
+
+def _batches(n=3, rows=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [DataSet(rng.randn(rows, 4).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.randint(0, 3, rows)])
+            for _ in range(n)]
+
+
+def test_metrics_listener_resets_between_fits(tmp_path):
+    """The first step of a SECOND fit must not be timed against the last
+    step of the first fit (the inter-fit gap): on_fit_start resets the
+    step timer, so each fit's first record has no step_seconds at all."""
+    path = str(tmp_path / "fits.jsonl")
+    lg = ScalarsLogger(path)
+    ml = MetricsListener(lg, batch_size=8)
+    net = _tiny_net()
+    net.set_listeners([ml])
+    batches = _batches()
+    net.fit_backprop(batches, num_epochs=1, mesh=None)
+    assert ml._last is not None       # armed during fit 1
+    import time as _time
+    _time.sleep(0.05)                 # the would-be mislabeled gap
+    net.fit_backprop(batches, num_epochs=1, mesh=None)
+    lg.close()
+    rows = ScalarsLogger.read(path)
+    assert len(rows) == 2 * len(batches)
+    first_of_each_fit = [rows[0], rows[len(batches)]]
+    for r in first_of_each_fit:
+        assert "step_seconds" not in r, \
+            "fit-entry reset missing: first step timed against the gap"
+    # the non-first steps DO carry timings
+    assert all("step_seconds" in r
+               for r in rows[1:len(batches)] + rows[len(batches) + 1:])
+
+
+def test_duck_typed_listener_without_on_fit_start_still_works():
+    """Listeners that only implement iteration_done (no IterationListener
+    subclassing) must survive the fit-entry hook."""
+    class Bare:
+        def __init__(self):
+            self.calls = 0
+
+        def iteration_done(self, model, iteration, score):
+            self.calls += 1
+
+    net = _tiny_net()
+    bare = Bare()
+    net.set_listeners([bare])
+    net.fit_backprop(_batches(n=2), num_epochs=1, mesh=None)
+    assert bare.calls == 2
+
+
+def test_metrics_listener_logs_guard_skips_when_exposed(tmp_path):
+    """MultiLayerNetwork exposes cumulative guard_skips; the listener
+    rides it along in every record.  A NaN-poisoned batch in fit 1 makes
+    fit 2's records carry the booked skip count."""
+    path = str(tmp_path / "skips.jsonl")
+    lg = ScalarsLogger(path)
+    net = _tiny_net()
+    net.set_listeners([MetricsListener(lg)])
+    bad = _batches(n=2)
+    feats = np.asarray(bad[0].features).copy()
+    feats[0, 0] = np.nan
+    bad[0] = DataSet(feats, bad[0].labels)
+    net.fit_backprop(bad, num_epochs=1, mesh=None)
+    assert net.guard_skips >= 1       # skips booked at fit end
+    net.fit_backprop(_batches(n=2, seed=3), num_epochs=1, mesh=None)
+    lg.close()
+    rows = ScalarsLogger.read(path)
+    assert all("guard_skips" in r for r in rows)
+    # fit 2's records see fit 1's booked skips
+    assert rows[-1]["guard_skips"] >= 1

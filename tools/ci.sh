@@ -24,6 +24,14 @@ cd "$REPO"
 echo "[ci] jaxlint"
 python -m tools.jaxlint deeplearning4j_tpu bench.py tools || exit 1
 
+# Telemetry overhead gate: a tracer-off AND a tracer-on fit must show
+# compile_delta_since_mark == 0 (the span tracer is host-side only and
+# must never change a jitted program), and the journal's Perfetto
+# conversion must stay valid.  Seconds on CPU; catches instrumentation
+# accidentally landing inside a traced region.
+echo "[ci] telemetry overhead gate"
+JAX_PLATFORMS=cpu python -m tools.telemetry_gate || exit 1
+
 if [ "${1:-}" = "--slow" ]; then
   python -m pytest tests/ -q
 else

@@ -1,0 +1,100 @@
+"""CI overhead gate for the run-telemetry layer (runtime/telemetry.py).
+
+Machine-checks the tentpole's overhead contract on a real (tiny) fit:
+
+1. warm the engine with one fit, ``registry.mark()``;
+2. a second, tracer-OFF fit must show ``compile_delta_since_mark == 0``
+   (telemetry plumbing at rest adds no trace);
+3. a tracer-ON fit must ALSO show ``compile_delta_since_mark == 0``
+   (enabling spans changes no jitted program — the tracer is host-side
+   by construction) and must produce a journal whose chrome-trace
+   conversion is valid Perfetto JSON with the fit span present.
+
+Run by ``tools/ci.sh`` before the test tiers; exits non-zero on any
+violation.  (jaxlint runs separately in ci.sh and must also stay clean —
+the instrumentation sites live in linted packages.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _net_and_data():
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).num_iterations(1).activation("tanh")
+            .list(2).hidden_layer_sizes(8)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(16, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[
+                           rng.randint(0, 3, 16)])
+               for _ in range(3)]
+    return MultiLayerNetwork(conf).init(seed=1), batches
+
+
+def main() -> int:
+    from deeplearning4j_tpu.runtime import telemetry
+
+    registry = telemetry.registry
+    net, batches = _net_and_data()
+
+    # 1) warm every program this gate will dispatch
+    net.fit_backprop(batches, num_epochs=1)
+    registry.mark()
+
+    # 2) tracer OFF: zero compile delta
+    assert not telemetry.enabled()
+    net.fit_backprop(batches, num_epochs=1)
+    delta_off = registry.compile_delta_since_mark()
+    if delta_off != 0:
+        print(f"[telemetry-gate] FAIL: tracer-off fit compiled "
+              f"{delta_off} new program(s)")
+        return 1
+
+    # 3) tracer ON: still zero compile delta, and a valid trace export
+    tracer = telemetry.enable("telemetry-gate")
+    registry.mark()
+    net.fit_backprop(batches, num_epochs=1)
+    delta_on = registry.compile_delta_since_mark()
+    if delta_on != 0:
+        print(f"[telemetry-gate] FAIL: tracer-on fit compiled "
+              f"{delta_on} new program(s) — instrumentation leaked into "
+              "a jitted region")
+        return 1
+
+    with tempfile.TemporaryDirectory() as d:
+        journal = tracer.export_journal(
+            os.path.join(d, "gate.jsonl"), snapshot=registry.snapshot())
+        records = telemetry.read_journal(journal)
+        payload = telemetry.chrome_trace(records, run_id=tracer.run_id)
+        # valid Perfetto input: a traceEvents list that survives a JSON
+        # round-trip, with the fit span among the complete slices
+        payload = json.loads(json.dumps(payload))
+        slices = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+        if not any(e["name"] == "multilayer.fit" for e in slices):
+            print("[telemetry-gate] FAIL: no multilayer.fit span in the "
+                  "exported trace")
+            return 1
+    telemetry.disable()
+    print(f"[telemetry-gate] ok: compile_delta off={delta_off} "
+          f"on={delta_on}, {len(records)} journal record(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
