@@ -1276,6 +1276,130 @@ def bench_serving(n_requests: int = 400, n_clients: int = 8,
     }
 
 
+def bench_decode_serving(n_requests: int = 24, n_clients: int = 8,
+                         n_slots: int = 8, max_tokens: int = 32,
+                         prompt_len: int = 16, hidden: int = 512,
+                         n_layers: int = 6):
+    """Continuous-batching decode row (serving/decode.py + router.py):
+    the SAME causal LM serves ``n_requests`` prompts two ways —
+
+    (1) sequential per-request ``generate()``: the strongest
+        single-stream baseline (whole prompt+continuation as ONE jitted
+        program, warmed), requests served back to back at batch 1 —
+        what the PR 3 stack would do for autoregressive traffic;
+    (2) the continuous-batching stack: ``Router`` -> ``ContinuousBatcher``
+        -> slot-structured ``DecodeEngine`` under ``n_clients``
+        concurrent client threads, requests joining the running decode
+        batch mid-flight.
+
+    Reports tokens/s for both (acceptance: continuous >= 3x sequential),
+    time-to-first-token p50/p99 under the concurrent load, slot
+    occupancy, and the compile evidence: warmup compiles == 2 executables
+    per cache-length bucket (prefill + step), then ``compile_delta == 0``
+    across the whole measured stream.
+
+    The default model is sized so its weights exceed the last-level
+    cache: batch-1 decode is then weight-STREAMING-bound (every token
+    re-reads all params), which is what slot batching amortizes — the
+    same economics as HBM bandwidth on a real accelerator.  A
+    cache-resident toy model would understate the win."""
+    import threading
+
+    import jax
+    import numpy as np
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+    from deeplearning4j_tpu.runtime import compile_cache
+    from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                    decode_metrics)
+    from deeplearning4j_tpu.serving.router import Router
+
+    platform, kind, n_dev = _platform_info()
+    cfg = TransformerConfig(
+        vocab_size=512, max_len=128, hidden=hidden, n_layers=n_layers,
+        n_heads=max(hidden // 64, 2), ffn_dim=4 * hidden, dropout=0.0,
+        causal=True, type_vocab_size=1,
+        compute_dtype="float32" if platform == "cpu" else "bfloat16")
+    params = gpt.init_params(jax.random.key(0), cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len)
+               .astype(np.int32) for _ in range(n_requests)]
+
+    # -- (1) sequential per-request generate(), jitted + warmed ------------
+    seq_fn = compile_cache.cached_jit(
+        lambda p, toks, key: gpt.generate(cfg, p, toks, max_tokens, key,
+                                          temperature=0.0),
+        key=("bench_decode_seq", repr(cfg), prompt_len, max_tokens),
+        label="bench.seq_generate")
+    key = jax.random.key(1)
+    jax.block_until_ready(seq_fn(params, prompts[0][None, :], key))
+    n_seq = max(n_requests // 4, 8)
+    t0 = time.perf_counter()
+    for p in prompts[:n_seq]:
+        jax.block_until_ready(seq_fn(params, p[None, :], key))
+    seq_s = time.perf_counter() - t0
+    seq_tps = n_seq * max_tokens / seq_s
+
+    # -- (2) continuous batching under concurrent clients ------------------
+    from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
+                                                   DecodeEngine)
+
+    decode_metrics.reset()
+    bucket = prompt_len + max_tokens
+    eng = DecodeEngine(
+        cfg, params, n_slots=n_slots,
+        buckets=(gpt.PREFILL_CHUNK * (-(-bucket // gpt.PREFILL_CHUNK)),))
+    warm = eng.warmup()                     # 2 compiles per bucket, AOT
+    router = Router([ContinuousBatcher(eng, default_max_tokens=max_tokens)],
+                    max_queue_depth=4 * n_requests)
+    before = compile_metrics.snapshot()["compile_count"]
+    per_client = [prompts[i::n_clients] for i in range(n_clients)]
+    done = []
+
+    def client(mine):
+        for p in mine:
+            done.append(router.submit(p, max_tokens=max_tokens)
+                        .result(600))
+
+    with router:
+        threads = [threading.Thread(target=client, args=(m,))
+                   for m in per_client]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cont_s = time.perf_counter() - t0
+    snap = decode_metrics.snapshot()
+    compile_delta = compile_metrics.snapshot()["compile_count"] - before
+    cont_tps = snap["tokens_out"] / cont_s
+
+    return {
+        "metric": "decode_serving_tokens_per_sec_continuous_batching",
+        "value": round(cont_tps, 1),
+        "unit": "tokens/sec",
+        # acceptance: continuous batching >= 3x sequential generate()
+        "vs_baseline": round(cont_tps / seq_tps, 2),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": (f"r{n_requests}_c{n_clients}_s{n_slots}"
+                       f"_t{max_tokens}_h{hidden}L{n_layers}"),
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "continuous_tokens_per_sec": round(cont_tps, 1),
+        "requests_completed": snap["requests_completed"],
+        "ttft_p50_ms": snap["ttft_p50_ms"],
+        "ttft_p99_ms": snap["ttft_p99_ms"],
+        "tok_p50_ms": snap["tok_p50_ms"],
+        "tok_p99_ms": snap["tok_p99_ms"],
+        "slot_occupancy": snap["slot_occupancy"],
+        "mid_flight_joins": snap["joins"],
+        # 2 executables (prefill + step) per cache-length bucket, then 0
+        "warmup": warm,
+        "warmup_compiles_expected": 2 * len(eng.buckets),
+        "compile_delta": compile_delta,
+    }
+
+
 INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
          "scaling": bench_scaling, "w2v_dp": bench_w2v_dp,
@@ -1297,6 +1421,10 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
          # inference serving row: eager-vs-engine throughput, p50/p99
          # under concurrent load, steady-state compile_delta == 0
          "serving": bench_serving,
+         # continuous-batching decode row: sequential-generate vs
+         # slot-batched tokens/s, ttft p50/p99, occupancy, zero
+         # steady-state compiles
+         "decode_serving": bench_decode_serving,
          # sharded scanned training: scanned-vs-per-batch speedup,
          # scaling efficiency, grad_accum curve, bit-equivalence
          "dp_fit": bench_dp_fit}
@@ -1316,7 +1444,7 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
             "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
             "resnet_s2d": (1800, 0), "resilience": (300, 240),
-            "serving": (420, 300),
+            "serving": (420, 300), "decode_serving": (480, 420),
             # dp_fit needs >= 2 devices: cpu-only like scaling
             "dp_fit": (0, 900)}
 
@@ -1676,8 +1804,8 @@ def main() -> None:
     headline = run_config("bert", tpu_ok)
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
-    names = ["serving", "dp_fit", "lenet", "resnet", "longctx", "word2vec",
-             "glove", "scaling", "w2v_dp"]
+    names = ["serving", "decode_serving", "dp_fit", "lenet", "resnet",
+             "longctx", "word2vec", "glove", "scaling", "w2v_dp"]
     if tpu_ok:
         # tpu-only capability point LAST: if the suite budget runs out it
         # is the row sacrificed, never the production throughput metrics

@@ -122,6 +122,146 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def _gpt_save_npz(path: str, cfg, params, chars: str) -> None:
+    """Persist a char-GPT as one .npz: nested param dict flattened to
+    slash-joined keys + a JSON header with the config and vocab."""
+    import dataclasses
+
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else k, v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", params)
+    header = json.dumps({"cfg": dataclasses.asdict(cfg), "chars": chars})
+    np.savez(path, __conf__=np.asarray(header), **flat)
+
+
+def _gpt_load_npz(path: str):
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+
+    data = np.load(path, allow_pickle=False)
+    meta = json.loads(str(data["__conf__"]))
+    params: dict = {}
+    for key in data.files:
+        if key == "__conf__":
+            continue
+        node = params
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+    return TransformerConfig(**meta["cfg"]), params, meta["chars"]
+
+
+def cmd_generate(args) -> int:
+    """Continuous-batching text generation (serving/decode.py): serve
+    every ``--prompt`` CONCURRENTLY through ``Router`` replicas of
+    slot-structured ``DecodeEngine``s — requests join the running decode
+    batch mid-flight instead of queueing behind each other.  The model
+    is a char-level GPT: either ``--params`` (an .npz saved by a prior
+    run's ``--save-params``) or trained on the fly from ``--input``
+    text."""
+    import time as _time
+
+    import jax
+
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.runtime import telemetry
+    from deeplearning4j_tpu.runtime.metrics import decode_metrics
+    from deeplearning4j_tpu.serving.router import OverloadedError, Router
+
+    tracer = None
+    journal_dir = args.telemetry
+    if journal_dir is True:
+        journal_dir = telemetry.DEFAULT_JOURNAL_DIR
+    if journal_dir:
+        tracer = telemetry.enable()
+
+    if args.params:
+        cfg, params, chars = _gpt_load_npz(args.params)
+        print(f"loaded char-GPT from {args.params} "
+              f"(vocab {cfg.vocab_size}, max_len {cfg.max_len})")
+    else:
+        if args.input:
+            with open(args.input) as fh:
+                text = fh.read()
+        else:
+            text = "the quick brown fox jumps over the lazy dog. " * 64
+        chars = "".join(sorted(set(text)))
+        stoi = {c: i for i, c in enumerate(chars)}
+        ids = np.asarray([stoi[c] for c in text], np.int32)
+        cfg = gpt.gpt_tiny(vocab_size=len(chars), max_len=args.max_len)
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        init_fn, step_fn = gpt.make_train_step(cfg, make_mesh(MeshSpec()))
+        state = init_fn(jax.random.key(args.seed))
+        T = min(32, cfg.max_len)
+        ndev = len(jax.devices())
+        reps = -(-(T * ndev + 1) // ids.size)
+        if reps > 1:
+            ids = np.tile(ids, reps)
+        n = max((ids.size - 1) // T // ndev, 1) * ndev
+        x = ids[:n * T].reshape(n, T)
+        key = jax.random.key(1)
+        print(f"training char-GPT ({args.train_steps} steps, vocab "
+              f"{len(chars)}) ...")
+        loss = None
+        for _ in range(args.train_steps):
+            state, loss = step_fn(state, x, key)
+        if loss is not None:
+            print(f"final LM loss: {float(loss):.3f}")
+        params = jax.tree.map(np.asarray, state.params)
+        if args.save_params:
+            _gpt_save_npz(args.save_params, cfg, params, chars)
+            print(f"saved params to {args.save_params}")
+
+    stoi = {c: i for i, c in enumerate(chars)}
+    prompts = args.prompt or ["the quick "]
+    enc = [np.asarray([stoi.get(c, 0) for c in p], np.int32)
+           for p in prompts]
+
+    telemetry.registry.mark()
+    router = Router.replicate(
+        cfg, params, args.replicas, n_slots=args.slots,
+        max_queue_depth=args.max_queue_depth,
+        default_max_tokens=args.max_tokens)
+    t0 = _time.perf_counter()
+    with router:
+        handles = []
+        for p, e in zip(prompts, enc):
+            try:
+                handles.append((p, router.submit(
+                    e, max_tokens=args.max_tokens,
+                    temperature=args.temperature, seed=args.seed)))
+            except OverloadedError as err:
+                print(f"SHED  {p!r}: {err}")
+        for p, h in handles:
+            toks = h.result(args.timeout)
+            text_out = "".join(chars[t] if t < len(chars) else "?"
+                               for t in toks)
+            print(f"{p!r} -> {p + text_out!r}")
+    wall = _time.perf_counter() - t0
+    snap = decode_metrics.snapshot()
+    print(f"\n{snap['tokens_out']} tokens in {wall:.2f}s "
+          f"({snap['tokens_out'] / max(wall, 1e-9):.1f} tok/s) | "
+          f"ttft p50/p99 {snap['ttft_p50_ms']}/{snap['ttft_p99_ms']} ms | "
+          f"slot occupancy {snap['slot_occupancy']:.2f} | "
+          f"joins {snap['joins']} | compile_delta "
+          f"{snap.get('compile_delta_since_mark')}")
+    if tracer is not None:
+        import os
+        os.makedirs(journal_dir, exist_ok=True)
+        journal = os.path.join(journal_dir, f"{tracer.run_id}.jsonl")
+        tracer.export_journal(journal,
+                              snapshot=telemetry.registry.snapshot())
+        print(f"telemetry journal: {journal}")
+    return 0
+
+
 def cmd_telemetry(args) -> int:
     """Summarize a telemetry journal (runtime/telemetry.py JSONL): span
     tree with aggregate timings, top-k longest spans, event counts, and
@@ -223,6 +363,42 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--output", default=None)
     r.add_argument("--raw-pixels", action="store_true")
     r.set_defaults(fn=cmd_predict)
+
+    g = sub.add_parser(
+        "generate",
+        help="continuous-batching char-GPT text generation "
+             "(serving/decode.py): all --prompt requests decode "
+             "concurrently in one slot-structured batch")
+    g.add_argument("--input", default=None,
+                   help="text file to build the char vocab from and "
+                        "train on (default: a built-in demo phrase)")
+    g.add_argument("--params", default=None, metavar="NPZ",
+                   help="load a char-GPT saved by --save-params instead "
+                        "of training")
+    g.add_argument("--save-params", default=None, metavar="NPZ",
+                   help="save the freshly trained char-GPT for reuse")
+    g.add_argument("--prompt", action="append", default=None,
+                   help="prompt text (repeatable; each one is a "
+                        "concurrent request)")
+    g.add_argument("--max-tokens", type=int, default=48)
+    g.add_argument("--temperature", type=float, default=0.3,
+                   help="0 = greedy argmax")
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--max-len", type=int, default=128,
+                   help="model context length (prompt + continuation "
+                        "must fit)")
+    g.add_argument("--train-steps", type=int, default=300)
+    g.add_argument("--replicas", type=int, default=1,
+                   help="decode engine replicas behind the router")
+    g.add_argument("--slots", type=int, default=8,
+                   help="concurrent sequences per engine")
+    g.add_argument("--max-queue-depth", type=int, default=64,
+                   help="router load-shed bound (OverloadedError above)")
+    g.add_argument("--timeout", type=float, default=300.0)
+    g.add_argument("--telemetry", nargs="?", default=None, const=True,
+                   metavar="DIR",
+                   help="enable the run tracer and write a JSONL journal")
+    g.set_defaults(fn=cmd_generate)
 
     m = sub.add_parser(
         "telemetry",

@@ -198,34 +198,135 @@ def _decode_step(cfg: TransformerConfig, params: PyTree, cache: KVCache,
     return KVCache(jnp.stack(new_k), jnp.stack(new_v)), logits
 
 
+def _prefill_chunk(cfg: TransformerConfig, params: PyTree, cache: KVCache,
+                   toks: Array, start: Array) -> Tuple[KVCache, Array]:
+    """One dense prefill chunk: ``toks`` [B, C] int32 at positions
+    ``start + [0, C)`` through the stack, K/V written into the cache as
+    a C-wide slab (``lax.dynamic_update_slice``), causal attention over
+    the cached prefix + the chunk itself.  Returns (cache', logits
+    [B, C, vocab]) — the C-token generalization of ``_decode_step``
+    (C=1 reduces to it), so prompt ingestion is matmul-bound instead of
+    T_prompt sequential steps."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    B, C = toks.shape
+    T_max = cache.k.shape[2]
+    x = tfm.embed(cfg, params, toks, None, start)             # [B, C, H]
+
+    pos_q = start + jnp.arange(C)                             # [C]
+    # causal over the whole cache row: key col <= query pos.  Stale or
+    # padded K/V beyond the written slab sits at col > pos and is never
+    # attended; garbage WITHIN the slab from padded prompt rows is
+    # excluded the same way (pad rows only ever follow real rows).
+    valid = pos_q[:, None] >= jnp.arange(T_max)[None, :]      # [C, T_max]
+    new_k, new_v = [], []
+    blocks = params["blocks"]
+    for layer in range(cfg.n_layers):
+        p = jax.tree.map(lambda a, l=layer: a[l], blocks)
+        h = x.astype(cdt)
+        q = jnp.einsum("bth,hnd->btnd", h, p["wq"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bq"]
+        k1 = jnp.einsum("bth,hnd->btnd", h, p["wk"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bk"]
+        v1 = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bv"]
+        k_cache = lax.dynamic_update_slice(
+            cache.k[layer], k1.astype(cdt), (0, start, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            cache.v[layer], v1.astype(cdt), (0, start, 0, 0))
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_cache,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid[None, None, :, :], s, -1e9)
+        probs = jax.nn.softmax(s, axis=-1).astype(cdt)
+        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_cache,
+                       preferred_element_type=jnp.float32)
+        a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bo"]
+        x = tfm.layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+
+        h = x.astype(cdt)
+        f = jnp.einsum("bth,hf->btf", h, p["w1"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b1"]
+        f = jax.nn.gelu(f).astype(cdt)
+        f = jnp.einsum("btf,fh->bth", f, p["w2"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b2"]
+        x = tfm.layer_norm(x + f, p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
+
+    logits = lm_logits(cfg, params, x)                        # [B, C, V]
+    return KVCache(jnp.stack(new_k), jnp.stack(new_v)), logits
+
+
+#: default dense-prefill chunk width (positions per slab); prompts are
+#: right-padded up to a multiple of this, so the compile count per cache
+#: shape is ONE regardless of prompt length
+PREFILL_CHUNK = 32
+
+
+def prefill_cache(cfg: TransformerConfig, params: PyTree, cache: KVCache,
+                  prompt: Array, chunk: int = PREFILL_CHUNK
+                  ) -> Tuple[KVCache, Array]:
+    """Chunked dense prefill: ingest ``prompt`` [B, T_p] into ``cache``
+    in ``chunk``-wide slabs (one ``lax.scan`` over slabs — a single
+    compiled chunk body for any prompt length) and return (cache',
+    logits [B, vocab] at the LAST prompt position) ready for the first
+    sampling step."""
+    B, T_p = prompt.shape
+    C = min(chunk, T_p)
+    n_chunks = -(-T_p // C)
+    pad = n_chunks * C - T_p
+    toks = jnp.pad(prompt, ((0, 0), (0, pad))) if pad else prompt
+    toks = toks.reshape(B, n_chunks, C)
+
+    def body(cache, inp):
+        ck, c_start, n_valid = inp
+        cache, logits = _prefill_chunk(cfg, params, cache, ck, c_start)
+        last = lax.dynamic_slice_in_dim(logits, n_valid - 1, 1, axis=1)
+        return cache, last[:, 0]
+
+    starts = jnp.arange(n_chunks) * C
+    valids = jnp.minimum(T_p - starts, C)
+    cache, lasts = lax.scan(body, cache,
+                            (jnp.moveaxis(toks, 1, 0), starts, valids))
+    return cache, lasts[-1]
+
+
+def sample_token(logits: Array, key: Array, temperature: Array) -> Array:
+    """One sampling decision [..., vocab] -> [...] int32: categorical at
+    ``temperature`` > 0, greedy argmax at ``temperature`` <= 0 (the
+    traced ``where`` keeps one compiled program serving both modes, so a
+    mixed greedy/sampled slot batch never recompiles)."""
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    sampled = jax.random.categorical(key, logits / t, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(jnp.asarray(temperature) > 0.0, sampled,
+                     greedy).astype(jnp.int32)
+
+
 def generate(cfg: TransformerConfig, params: PyTree, prompt: Array,
              n_tokens: int, key: Array, temperature: float = 1.0,
-             max_len: Optional[int] = None) -> Array:
+             max_len: Optional[int] = None,
+             prefill_chunk: int = PREFILL_CHUNK) -> Array:
     """Sample ``n_tokens`` continuations for ``prompt`` [B, T_p] int32.
 
-    Prefill walks the prompt through the cache, then one lax.scan emits
-    the continuation — the whole thing is two compiled programs total.
-    """
+    Chunked dense prefill ingests the prompt matmul-bound (K/V written
+    in slabs), then one lax.scan emits the continuation — the whole
+    thing is two compiled programs total.  ``temperature=0`` decodes
+    greedily (argmax)."""
     B, T_p = prompt.shape
     T_max = max_len or cfg.max_len
     if T_p + n_tokens > T_max:
         raise ValueError(f"prompt {T_p} + {n_tokens} exceeds max {T_max}")
     cache = init_cache(cfg, B, T_max)
-
-    def prefill_step(carry, inputs):
-        cache, _ = carry
-        tok, pos = inputs
-        cache, logits = _decode_step(cfg, params, cache, tok, pos)
-        return (cache, logits), None
-
-    (cache, logits), _ = lax.scan(
-        prefill_step, (cache, jnp.zeros((B, cfg.vocab_size))),
-        (jnp.moveaxis(prompt, 1, 0), jnp.arange(T_p)))
+    cache, logits = prefill_cache(cfg, params, cache, prompt,
+                                  chunk=prefill_chunk)
 
     def gen_step(carry, inputs):
         cache, logits = carry
         k, pos = inputs
-        nxt = jax.random.categorical(k, logits / temperature, axis=-1)
+        nxt = sample_token(logits, k, jnp.float32(temperature))
         cache, logits = _decode_step(cfg, params, cache, nxt, pos)
         return (cache, logits), nxt
 
@@ -240,6 +341,170 @@ def forward_logits(cfg: TransformerConfig, params: PyTree,
     """Dense (non-cached) forward for parity checks: [B, T] -> [B, T, V]."""
     hidden = tfm.encode(cfg, params, token_ids)
     return lm_logits(cfg, params, hidden)
+
+
+# ---------------------------------------------------------------------------
+# Slot-structured decoding (continuous-batching serving substrate)
+# ---------------------------------------------------------------------------
+
+class DecodeSlots(NamedTuple):
+    """Persistent decode state for S concurrent sequences sharing one
+    fixed-shape executable (serving/decode.DecodeEngine owns one per
+    cache-length bucket and donates it to every dispatch):
+
+    - ``k``/``v``: slot-structured KV cache [L, S, T_max, NH, D];
+    - ``tokens`` [S] int32: each slot's CURRENT token — sampled last
+      step (or at prefill), not yet written to the cache;
+    - ``pos`` [S] int32: the position that token will occupy.
+    """
+    k: Array
+    v: Array
+    tokens: Array
+    pos: Array
+
+
+def init_slots(cfg: TransformerConfig, n_slots: int,
+               max_len: Optional[int] = None) -> DecodeSlots:
+    T = max_len or cfg.max_len
+    shape = (cfg.n_layers, n_slots, T, cfg.n_heads, cfg.head_dim)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    return DecodeSlots(jnp.zeros(shape, cdt), jnp.zeros(shape, cdt),
+                       jnp.zeros((n_slots,), jnp.int32),
+                       jnp.zeros((n_slots,), jnp.int32))
+
+
+def _slot_key(seed: Array, pos: Array) -> Array:
+    """Per-(request, position) sampling key: deterministic for a given
+    request seed regardless of which slot or step the token lands on —
+    the property the continuous batcher's reproducibility rests on."""
+    return jax.random.fold_in(jax.random.fold_in(jax.random.key(0),
+                                                 seed), pos)
+
+
+def slot_prefill(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
+                 toks: Array, slot: Array, start: Array, n_valid: Array,
+                 temperature: Array, seed: Array
+                 ) -> Tuple[DecodeSlots, Array]:
+    """Prefill one chunk ``toks`` [C] of a prompt into ``slot`` at
+    positions ``start + [0, n_valid)`` (rows past ``n_valid`` are
+    padding) while the other slots' state rides along untouched — how a
+    new request joins a RUNNING batch without a barrier.  Returns
+    (slots', first_token): ``first_token`` is sampled from the logits at
+    the last valid position and is only meaningful for the final chunk
+    of a prompt (the caller then activates the slot with
+    ``tokens[slot]=first_token, pos[slot]=start+n_valid``, which this
+    function records)."""
+    L = cfg.n_layers
+    T_max = slots.k.shape[2]
+    k_slot = lax.dynamic_slice(
+        slots.k, (0, slot, 0, 0, 0),
+        (L, 1, T_max, cfg.n_heads, cfg.head_dim))
+    v_slot = lax.dynamic_slice(
+        slots.v, (0, slot, 0, 0, 0),
+        (L, 1, T_max, cfg.n_heads, cfg.head_dim))
+    cache, logits = _prefill_chunk(cfg, params, KVCache(k_slot, v_slot),
+                                   toks[None, :], start)
+    last = lax.dynamic_slice_in_dim(logits[0], n_valid - 1, 1, axis=0)[0]
+    end = start + n_valid
+    first = sample_token(last, _slot_key(seed, end - 1), temperature)
+    return DecodeSlots(
+        lax.dynamic_update_slice(slots.k, cache.k, (0, slot, 0, 0, 0)),
+        lax.dynamic_update_slice(slots.v, cache.v, (0, slot, 0, 0, 0)),
+        slots.tokens.at[slot].set(first),
+        slots.pos.at[slot].set(end),
+    ), first
+
+
+def slot_decode(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
+                active: Array, temperature: Array, seeds: Array
+                ) -> Tuple[DecodeSlots, Array]:
+    """Advance every ACTIVE slot by one token in ONE dispatch.
+
+    Each slot s feeds its current token at its own position ``pos[s]``:
+    K/V scatter at (s, pos[s]), attention over its prefix ``<= pos[s]``,
+    per-slot sampling (``temperature[s]``, key folded from ``seeds[s]``
+    and the position).  Inactive slots compute alongside (fixed shapes)
+    but neither their token nor their position changes; their cache
+    writes land at a position that is overwritten before it is ever
+    attended.  Returns (slots', tokens [S]) where ``tokens[s]`` is the
+    newly sampled token for active slots and the unchanged current token
+    for inactive ones."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    S = slots.tokens.shape[0]
+    T_max = slots.k.shape[2]
+    pos = slots.pos
+    e = params["embed"]
+    pos_c = jnp.clip(pos, 0, cfg.max_len - 1)
+    x = e["tok"][slots.tokens] + e["pos"][pos_c]              # [S, H]
+    x = tfm.layer_norm(x, e["ln_g"], e["ln_b"],
+                       cfg.layer_norm_eps)[:, None, :]        # [S, 1, H]
+
+    rows = jnp.arange(S)
+    valid = jnp.arange(T_max)[None, :] <= pos[:, None]        # [S, T_max]
+    new_k, new_v = [], []
+    blocks = params["blocks"]
+    for layer in range(cfg.n_layers):
+        p = jax.tree.map(lambda a, l=layer: a[l], blocks)
+        h = x.astype(cdt)
+        q = jnp.einsum("bth,hnd->btnd", h, p["wq"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bq"]
+        k1 = jnp.einsum("bth,hnd->btnd", h, p["wk"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bk"]
+        v1 = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bv"]
+        # per-slot-position scatter (out-of-range positions drop)
+        k_cache = slots.k[layer].at[rows, pos].set(k1[:, 0].astype(cdt),
+                                                   mode="drop")
+        v_cache = slots.v[layer].at[rows, pos].set(v1[:, 0].astype(cdt),
+                                                   mode="drop")
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_cache,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid[:, None, None, :], s, -1e9)
+        probs = jax.nn.softmax(s, axis=-1).astype(cdt)
+        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_cache,
+                       preferred_element_type=jnp.float32)
+        a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bo"]
+        x = tfm.layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+
+        h = x.astype(cdt)
+        f = jnp.einsum("bth,hf->btf", h, p["w1"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b1"]
+        f = jax.nn.gelu(f).astype(cdt)
+        f = jnp.einsum("btf,fh->bth", f, p["w2"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b2"]
+        x = tfm.layer_norm(x + f, p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
+
+    logits = lm_logits(cfg, params, x)[:, 0, :]               # [S, V]
+    keys = jax.vmap(_slot_key)(seeds, pos)
+    nxt = jax.vmap(sample_token)(logits, keys, temperature)
+    act = active.astype(jnp.int32)
+    return DecodeSlots(
+        jnp.stack(new_k), jnp.stack(new_v),
+        jnp.where(active, nxt, slots.tokens),
+        pos + act,
+    ), jnp.where(active, nxt, slots.tokens)
+
+
+def make_slot_fns(cfg: TransformerConfig):
+    """(prefill_fn, decode_fn, cache_key) for serving/decode.DecodeEngine:
+    positional signatures suitable for ``cached_jit`` with the slot
+    state donated.  The key captures everything that determines the
+    traced programs besides input shapes (the engine extends it with
+    its slot/bucket geometry)."""
+    def prefill_fn(params, slots, toks, slot, start, n_valid,
+                   temperature, seed):
+        return slot_prefill(cfg, params, slots, toks, slot, start,
+                            n_valid, temperature, seed)
+
+    def decode_fn(params, slots, active, temperature, seeds):
+        return slot_decode(cfg, params, slots, active, temperature, seeds)
+
+    return prefill_fn, decode_fn, ("gpt_slots", repr(cfg))
 
 
 def make_serving_apply(cfg: TransformerConfig):

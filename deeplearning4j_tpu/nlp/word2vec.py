@@ -475,6 +475,43 @@ def run_stream_training(syn0, syn1, syn1neg, indexed, *,
                                   vocab_size, dim,
                                   int(codes_t.shape[1]) if use_hs else 1)):
         pallas_block = 0
+    # Honor the configured batch_size at the finest granularity the
+    # selected kernel supports.  The 512-lcm floor above is only the
+    # fused kernel's largest-BlockSpec preference — applied
+    # unconditionally it rounded every small batch_size up to 256
+    # POSITIONS (~1536 pair slots) per sequential update, which
+    # collapsed convergence on small corpora to a handful of
+    # mean-normalized steps per epoch.  That granularity cliff (not a
+    # numeric issue) was the root cause of the device-mode quality
+    # failures ROADMAP item 3 tracked.
+    fine = max(8, (batch_size // W2) // 8 * 8)
+
+    def _block_ok(blk):
+        # a re-picked block must clear the same compile-probe gate the
+        # original one did (block size changes the kernel signature);
+        # on probe failure we keep the already-validated coarse block
+        return (pallas_interpret or kernel != "auto"
+                or probe_compile(blk, use_hs, negative, vocab_size, dim,
+                                 int(codes_t.shape[1]) if use_hs else 1))
+
+    if pallas_block == 0:
+        pos_chunk = fine                    # XLA path: any chunk shape
+    elif pos_chunk > fine:
+        blk2 = choose_block(vocab_size, dim, negative, fine * W2,
+                            interpret=platform != "tpu")
+        if blk2 and fine * W2 % blk2 == 0 and _block_ok(blk2):
+            pos_chunk, pallas_block = fine, blk2
+        else:
+            # compiled kernel grids need B % block == 0: fall back to
+            # the finest 128-lane-aligned chunk covering batch_size
+            step128 = 128 // math.gcd(W2, 128)
+            cand = max(step128, (batch_size // W2) // step128 * step128)
+            blk3 = choose_block(vocab_size, dim, negative, cand * W2,
+                                interpret=platform != "tpu")
+            if (blk3 and cand * W2 % blk3 == 0 and cand < pos_chunk
+                    and _block_ok(blk3)):
+                pos_chunk, pallas_block = cand, blk3
+    B = pos_chunk * W2
     kernel_used = kernel_name(pallas_block, pallas_interpret)
 
     n_shards = int(mesh.shape[data_axis]) if mesh is not None else 1

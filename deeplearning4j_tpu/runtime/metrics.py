@@ -243,6 +243,142 @@ class ServingMetrics:
 serving_metrics = ServingMetrics()
 
 
+class DecodeMetrics:
+    """Process-wide counters for the continuous-batching decode stack
+    (serving/decode.py + serving/router.py):
+
+    - ``requests`` / ``requests_completed`` / ``requests_shed``: decode
+      requests accepted, finished (EOS or token budget), and rejected by
+      the router's queue-depth load-shed bound;
+    - ``prompt_tokens`` / ``tokens_out``: prompt tokens prefilled and
+      continuation tokens streamed back;
+    - ``prefill_dispatches`` / ``decode_dispatches``: device dispatches
+      of the two slot executables;
+    - ``joins``: requests that prefilled into a slot while OTHER slots
+      were mid-decode (the continuous-batching event: nobody waited for
+      a cohort to finish);
+    - ``slot_steps`` / ``slot_capacity_steps``: active vs total slots
+      summed over decode dispatches — ``snapshot()['slot_occupancy']``
+      is their ratio (1.0 = every dispatch fully utilized);
+    - ``queue_depth`` / ``max_queue_depth``: most recent and high-water
+      PER-BATCHER pending depth (each batcher reports its own count;
+      with multiple router replicas this is a replica-level gauge, not
+      a fleet total — ``Router.depths()`` is the fleet view);
+    - time-to-first-token and per-token latency reservoirs (bounded) ->
+      ``ttft_p50_ms``/``ttft_p99_ms`` and ``tok_p50_ms``/``tok_p99_ms``;
+    - ``mark_compiles()`` / ``compile_delta_since_mark``: same
+      steady-state zero-compile assertion primitive as ServingMetrics.
+    """
+
+    MAX_SAMPLES = 8192
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.requests = 0
+            self.requests_completed = 0
+            self.requests_shed = 0
+            self.prompt_tokens = 0
+            self.tokens_out = 0
+            self.prefill_dispatches = 0
+            self.decode_dispatches = 0
+            self.joins = 0
+            self.slot_steps = 0
+            self.slot_capacity_steps = 0
+            self.queue_depth = 0
+            self.max_queue_depth = 0
+            self._ttft_ms: List[float] = []
+            self._tok_ms: List[float] = []
+            self._compile_mark: Optional[int] = None
+
+    def note_request(self, prompt_tokens: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.prompt_tokens += int(prompt_tokens)
+
+    def note_join(self) -> None:
+        with self._lock:
+            self.joins += 1
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.requests_shed += 1
+
+    def note_complete(self, tokens: int) -> None:
+        with self._lock:
+            self.requests_completed += 1
+            self.tokens_out += int(tokens)
+
+    def note_prefill(self, chunks: int = 1) -> None:
+        with self._lock:
+            self.prefill_dispatches += int(chunks)
+
+    def note_decode_dispatch(self, active: int, capacity: int) -> None:
+        with self._lock:
+            self.decode_dispatches += 1
+            self.slot_steps += int(active)
+            self.slot_capacity_steps += int(capacity)
+
+    def note_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+            self.max_queue_depth = max(self.max_queue_depth, depth)
+
+    def _push(self, buf: List[float], ms: float) -> None:
+        buf.append(ms)
+        if len(buf) > self.MAX_SAMPLES:
+            del buf[:len(buf) // 2]
+
+    def note_ttft_ms(self, ms: float) -> None:
+        with self._lock:
+            self._push(self._ttft_ms, ms)
+
+    def note_token_ms(self, ms: float) -> None:
+        with self._lock:
+            self._push(self._tok_ms, ms)
+
+    def mark_compiles(self) -> None:
+        with self._lock:
+            self._compile_mark = compile_metrics.snapshot()["compile_count"]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            ttft = sorted(self._ttft_ms)
+            tok = sorted(self._tok_ms)
+            occ = (self.slot_steps / self.slot_capacity_steps
+                   if self.slot_capacity_steps else 0.0)
+            out = {
+                "requests": self.requests,
+                "requests_completed": self.requests_completed,
+                "requests_shed": self.requests_shed,
+                "prompt_tokens": self.prompt_tokens,
+                "tokens_out": self.tokens_out,
+                "prefill_dispatches": self.prefill_dispatches,
+                "decode_dispatches": self.decode_dispatches,
+                "joins": self.joins,
+                "slot_occupancy": round(occ, 4),
+                "queue_depth": self.queue_depth,
+                "max_queue_depth": self.max_queue_depth,
+                "ttft_p50_ms": ServingMetrics._pct(ttft, 0.50),
+                "ttft_p99_ms": ServingMetrics._pct(ttft, 0.99),
+                "tok_p50_ms": ServingMetrics._pct(tok, 0.50),
+                "tok_p99_ms": ServingMetrics._pct(tok, 0.99),
+                "compile_mark": self._compile_mark,
+            }
+        if out["compile_mark"] is not None:
+            out["compile_delta_since_mark"] = (
+                compile_metrics.snapshot()["compile_count"]
+                - out["compile_mark"])
+        return out
+
+
+#: process-wide singleton the continuous-batching decode stack reports into
+decode_metrics = DecodeMetrics()
+
+
 class DataParallelMetrics:
     """Process-wide counters for the sharded/scanned training paths
     (parallel/sharded_fit.py consumers: ``MultiLayerNetwork`` DP fits,

@@ -50,6 +50,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                decode_metrics,
                                                 device_memory_stats,
                                                 dp_metrics,
                                                 peak_bytes_in_use,
@@ -495,12 +496,13 @@ class MetricsRegistry:
         return out
 
 
-#: process-wide registry pre-wired with the four counter singletons —
+#: process-wide registry pre-wired with the counter singletons —
 #: the one-stop snapshot bench rows and the CLI read
 registry = MetricsRegistry()
 registry.register("compile", compile_metrics)
 registry.register("resilience", resilience_metrics)
 registry.register("serving", serving_metrics)
+registry.register("decode", decode_metrics)
 registry.register("dp", dp_metrics)
 
 

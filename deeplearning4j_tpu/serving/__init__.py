@@ -1,5 +1,7 @@
 """TPU-native inference serving: jitted bucketed forward + dynamic
-micro-batching.
+micro-batching + continuous-batching autoregressive decode.
+
+One-shot forwards (classification, scoring):
 
 - ``InferenceEngine`` (engine.py): donated, jitted forward through the
   runtime compile engine, shape-bucketed so the compile count is bounded
@@ -8,13 +10,32 @@ micro-batching.
   requests into micro-batches under a max_batch_size / max_delay_ms
   policy.
 
+Autoregressive decode (models/gpt.py causal LMs):
+
+- ``DecodeEngine`` (decode.py): persistent slot-structured KV cache per
+  cache-length bucket, ONE donated decode-step executable advancing all
+  occupied slots per dispatch; new requests prefill into free slots
+  mid-flight (continuous batching).
+- ``ContinuousBatcher`` (decode.py): streaming per-request front-end
+  over one engine (token streams, EOS/budget slot recycling, drain on
+  close).
+- ``Router`` (router.py): N replicas behind least-depth dispatch with a
+  queue-depth load-shed bound (typed ``OverloadedError``).
+
 ``MultiLayerNetwork.output/predict/score`` and ``Evaluation.eval`` route
 through this layer; the per-model adapters live next to each model
 (``models/*.make_serving_apply``).  Metrics:
-``runtime.metrics.serving_metrics``.
+``runtime.metrics.serving_metrics`` (one-shot) and
+``runtime.metrics.decode_metrics`` (decode).
 """
 
 from deeplearning4j_tpu.serving.batcher import DynamicBatcher  # noqa: F401
+from deeplearning4j_tpu.serving.decode import (  # noqa: F401
+    ContinuousBatcher, DecodeEngine, DecodeRequest, default_length_buckets,
+)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, default_buckets, pad_rows, pick_bucket,
+)
+from deeplearning4j_tpu.serving.router import (  # noqa: F401
+    OverloadedError, Router,
 )

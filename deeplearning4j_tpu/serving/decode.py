@@ -1,0 +1,549 @@
+"""Continuous-batching autoregressive decode serving.
+
+The PR 3 stack (`engine.py`/`batcher.py`) serves ONE-SHOT forwards:
+each request is a single jitted dispatch and the cohort dissolves.
+Autoregressive GPT traffic is a different shape — a request is a
+SEQUENCE of dependent dispatches (one per token), so per-request
+`generate()` calls serialize: every user waits behind every other
+user's whole continuation, and the MXU runs at batch size 1.  The
+serving half of Gemma-on-TPU (arXiv:2605.25645) and TensorFlow's
+persistent-dataflow lesson (arXiv:1605.08695) both land on the same
+recipe, implemented here:
+
+- ``DecodeEngine`` owns a persistent slot-structured KV cache
+  ``[L, S, T_max, NH, D]`` per cache-length bucket (S = max concurrent
+  sequences, bucketed T_max ladder like PR 3's batch ladder) and ONE
+  jitted, donated decode-step executable per (conf, bucket) — compiled
+  through ``runtime/compile_cache.cached_jit`` — that advances ALL
+  occupied slots by one token per dispatch.
+- New requests JOIN the running batch: the prompt is prefilled into a
+  free slot with the chunked dense prefill executable (matmul-bound
+  slabs + ``lax.dynamic_update_slice`` into the live cache) between two
+  decode steps — nobody waits for a cohort to finish.  Finished
+  sequences (EOS or token budget) free their slot mid-flight and the
+  next pending request takes it.
+- ``ContinuousBatcher`` is the front-end: a background worker owns the
+  engine, streams tokens back per request (``DecodeRequest`` handles),
+  books time-to-first-token and per-token latency into
+  ``runtime.metrics.decode_metrics``, and drains on close.
+
+A replicated front-end with load-shedding lives in
+``serving/router.py``.  Steady state is compile-free: ``warmup()``
+pre-traces both executables for every bucket, after which any mix of
+prompt lengths, joins, and slot recycling dispatches only cached
+programs (asserted by the bench row and the telemetry gate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.models import gpt
+from deeplearning4j_tpu.runtime import compile_cache, telemetry
+from deeplearning4j_tpu.runtime.metrics import decode_metrics
+
+
+def default_length_buckets(max_len: int, min_bucket: int = 32
+                           ) -> Tuple[int, ...]:
+    """Powers-of-two cache-length ladder up to (and including)
+    ``max_len`` — same compile-bounding idea as the batch-size ladder in
+    serving/engine.py, but over sequence capacity."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1: {max_len}")
+    ladder = [min(min_bucket, max_len)]
+    while ladder[-1] < max_len:
+        ladder.append(min(ladder[-1] * 2, max_len))
+    return tuple(ladder)
+
+
+class _Bucket:
+    """Host-side state for one cache-length bucket: the device slot
+    state plus the occupancy/sampling arrays the decode dispatch takes
+    each step."""
+
+    __slots__ = ("t_max", "slots", "active", "temps", "seeds", "owners")
+
+    def __init__(self, t_max: int, n_slots: int):
+        self.t_max = t_max
+        self.slots = None                       # DecodeSlots, lazy-init
+        self.active = np.zeros((n_slots,), np.bool_)
+        self.temps = np.zeros((n_slots,), np.float32)
+        self.seeds = np.zeros((n_slots,), np.uint32)
+        self.owners: List[Any] = [None] * n_slots
+
+    def free_slot(self) -> Optional[int]:
+        for i, o in enumerate(self.owners):
+            if o is None:
+                return i
+        return None
+
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+
+class DecodeEngine:
+    """Slot-structured KV-cache decode engine for a causal LM
+    (models/gpt.py).  NOT thread-safe: exactly one thread (normally the
+    ``ContinuousBatcher`` worker) may drive ``start``/``advance``/
+    ``release``; construction and ``warmup()`` happen before serving.
+
+    ``params`` may be the pytree or a zero-arg callable returning it
+    (live-params convention shared with ``InferenceEngine``).  Both the
+    prefill and the decode executables are built through the module
+    compile engine with the slot state DONATED, so the cache updates in
+    place (no 2x HBM) and identically-configured replicas share one
+    compile per bucket.
+    """
+
+    def __init__(self, cfg, params: Any, *, n_slots: int = 8,
+                 buckets: Optional[Sequence[int]] = None,
+                 prefill_chunk: int = gpt.PREFILL_CHUNK,
+                 label: str = "decode"):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1: {n_slots}")
+        self.cfg = cfg
+        self._params = params
+        self.n_slots = int(n_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.buckets = tuple(sorted(set(
+            buckets if buckets is not None
+            else default_length_buckets(cfg.max_len))))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"bad bucket ladder: {self.buckets}")
+        if self.buckets[-1] > cfg.max_len:
+            raise ValueError(
+                f"bucket {self.buckets[-1]} exceeds the model's "
+                f"max_len {cfg.max_len}")
+        # prefill slabs are written at chunk-aligned offsets, so every
+        # bucket length must be a multiple of the chunk width or the
+        # final slab of a near-full prompt would fall off the cache
+        # end.  The chunk is a perf knob, not a semantic one: shrink it
+        # to the largest width dividing every rung (>= 1 always works)
+        # rather than reject ladders like (32, 48) that max_len and
+        # default_length_buckets legitimately produce.
+        import math
+        chunk = min(self.prefill_chunk, self.buckets[0])
+        for t in self.buckets:
+            chunk = math.gcd(chunk, t)
+        if chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1: {self.prefill_chunk}")
+        self.prefill_chunk = chunk
+        self.label = label
+        self._buckets: Dict[int, _Bucket] = {
+            t: _Bucket(t, self.n_slots) for t in self.buckets}
+        prefill_fn, decode_fn, key = gpt.make_slot_fns(cfg)
+        # one executable pair per (conf, slot-geometry): the shapes
+        # traced differ only in T_max across buckets, so the compile
+        # count is bounded by 2 x len(buckets)
+        geo = (self.n_slots, self.prefill_chunk)
+        self._prefill = compile_cache.cached_jit(
+            prefill_fn, key=(key, geo, "prefill"),
+            label=f"{label}.prefill", donate_argnums=(1,))
+        self._decode = compile_cache.cached_jit(
+            decode_fn, key=(key, geo, "step"),
+            label=f"{label}.step", donate_argnums=(1,))
+
+    # -- params ------------------------------------------------------------
+    def current_params(self) -> Any:
+        p = self._params
+        return p() if callable(p) else p
+
+    # -- geometry ----------------------------------------------------------
+    def pick_bucket(self, total_len: int) -> int:
+        """Smallest cache-length bucket that fits prompt + budget."""
+        for t in self.buckets:
+            if t >= total_len:
+                return t
+        raise ValueError(
+            f"request needs {total_len} positions; largest bucket is "
+            f"{self.buckets[-1]} (model max_len {self.cfg.max_len})")
+
+    def free_slot(self, bucket: int) -> Optional[int]:
+        return self._buckets[bucket].free_slot()
+
+    def n_active(self) -> int:
+        return sum(b.n_active() for b in self._buckets.values())
+
+    def active_buckets(self) -> List[int]:
+        return [t for t, b in self._buckets.items() if b.n_active()]
+
+    def _state(self, b: _Bucket):
+        if b.slots is None:
+            b.slots = gpt.init_slots(self.cfg, self.n_slots, b.t_max)
+        return b.slots
+
+    # -- AOT warmup --------------------------------------------------------
+    def warmup(self) -> dict:
+        """Pre-trace the prefill + decode executables for every bucket
+        (AOT), then reset the slot state — steady-state traffic after
+        this is compile-free for any prompt length / join pattern.
+        Returns {"buckets": n, "compiles": traces, "warmup_ms": wall}."""
+        from deeplearning4j_tpu.runtime.metrics import compile_metrics
+
+        before = sum(
+            compile_metrics.snapshot()["traces"].get(k, 0)
+            for k in (f"{self.label}.prefill", f"{self.label}.step"))
+        params = self.current_params()
+        t0 = time.perf_counter()
+        with telemetry.span("decode.warmup", buckets=len(self.buckets)):
+            for t in self.buckets:
+                b = self._buckets[t]
+                slots = self._state(b)
+                toks = np.zeros((self.prefill_chunk,), np.int32)
+                slots, _ = self._prefill(
+                    params, slots, toks, np.int32(0), np.int32(0),
+                    np.int32(1), np.float32(0.0), np.uint32(0))
+                slots, out = self._decode(
+                    params, slots, b.active, b.temps, b.seeds)
+                jax.block_until_ready(out)
+                b.slots = None                  # fresh state for serving
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        compiles = sum(
+            compile_metrics.snapshot()["traces"].get(k, 0)
+            for k in (f"{self.label}.prefill", f"{self.label}.step")
+        ) - before
+        decode_metrics.mark_compiles()
+        return {"buckets": len(self.buckets), "compiles": compiles,
+                "warmup_ms": round(wall_ms, 1)}
+
+    # -- serving -----------------------------------------------------------
+    def start(self, prompt: np.ndarray, *, max_tokens: int,
+              temperature: float = 0.0, seed: int = 0,
+              owner: Any = True) -> Tuple[int, int, int]:
+        """Prefill ``prompt`` [T_p] int32 into a free slot of the bucket
+        fitting ``T_p + max_tokens`` and return (bucket, slot,
+        first_token).  The other slots' decode state rides along
+        untouched — this is the mid-flight JOIN.  Raises RuntimeError
+        when the bucket has no free slot (callers gate on
+        ``free_slot``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1: {max_tokens}")
+        bucket = self.pick_bucket(prompt.size + max_tokens)
+        b = self._buckets[bucket]
+        slot = b.free_slot()
+        if slot is None:
+            raise RuntimeError(f"no free slot in bucket {bucket}")
+        params = self.current_params()
+        slots = self._state(b)
+        C = self.prefill_chunk
+        n_chunks = -(-prompt.size // C)
+        tr = telemetry.get_tracer()
+        sp = tr.span("decode.prefill", bucket=bucket, slot=slot,
+                     prompt_tokens=int(prompt.size), chunks=n_chunks) \
+            if tr is not None else telemetry.NOOP_SPAN
+        with sp:
+            first = None
+            try:
+                for c in range(n_chunks):
+                    lo = c * C
+                    n_valid = min(C, prompt.size - lo)
+                    chunk = np.zeros((C,), np.int32)
+                    chunk[:n_valid] = prompt[lo:lo + n_valid]
+                    slots, first = self._prefill(
+                        params, slots, chunk, np.int32(slot),
+                        np.int32(lo), np.int32(n_valid),
+                        np.float32(temperature), np.uint32(seed))
+            except Exception:
+                # the state was donated into the failed dispatch — drop
+                # it so the bucket re-initializes instead of serving a
+                # deleted buffer
+                b.slots = None
+                raise
+            b.slots = slots
+            first_tok = int(first)              # join-time sync, once
+        decode_metrics.note_prefill(n_chunks)
+        b.active[slot] = True
+        b.temps[slot] = np.float32(temperature)
+        b.seeds[slot] = np.uint32(seed)
+        b.owners[slot] = owner
+        return bucket, slot, first_tok
+
+    def advance(self, bucket: int) -> np.ndarray:
+        """One decode dispatch for ``bucket``: every active slot emits
+        its next token.  Returns the [S] token array (entries for
+        inactive slots are stale and must be ignored via the caller's
+        ownership map)."""
+        b = self._buckets[bucket]
+        params = self.current_params()
+        slots = self._state(b)
+        n_act = b.n_active()
+        tr = telemetry.get_tracer()
+        sp = tr.span("decode.dispatch", bucket=bucket, active=n_act) \
+            if tr is not None else telemetry.NOOP_SPAN
+        with sp:
+            try:
+                slots, out = self._decode(params, slots, b.active.copy(),
+                                          b.temps, b.seeds)
+            except Exception:
+                b.slots = None                  # donated into the failure
+                raise
+            b.slots = slots
+            toks = np.asarray(out)              # the per-step stream sync
+        decode_metrics.note_decode_dispatch(n_act, self.n_slots)
+        return toks
+
+    def release(self, bucket: int, slot: int) -> None:
+        """Free a finished slot — the cache rows need no scrubbing: a
+        future occupant prefills its prompt over them and decode never
+        attends past its own position."""
+        b = self._buckets[bucket]
+        b.active[slot] = False
+        b.owners[slot] = None
+
+
+class DecodeRequest:
+    """Handle for one in-flight decode request: tokens stream into an
+    internal buffer as the engine emits them; ``result()`` blocks for
+    the full continuation, ``stream()`` yields tokens as they land."""
+
+    _DONE = object()
+
+    def __init__(self, prompt: np.ndarray, max_tokens: int,
+                 temperature: float, seed: int, eos_id: Optional[int]):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.seed = seed
+        self.eos_id = eos_id
+        self.ttft_ms: Optional[float] = None
+        self._t_submit = time.perf_counter()
+        self._tokens: List[int] = []
+        self._cond = threading.Condition()
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    # -- producer side (batcher worker) ------------------------------------
+    def _push(self, tok: int) -> None:
+        with self._cond:
+            if self.ttft_ms is None:
+                self.ttft_ms = (time.perf_counter()
+                                - self._t_submit) * 1e3
+                decode_metrics.note_ttft_ms(self.ttft_ms)
+            self._tokens.append(int(tok))
+            self._cond.notify_all()
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            self._error = error
+            self._done = True
+            self._cond.notify_all()
+
+    # -- consumer side -----------------------------------------------------
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    def result(self, timeout: Optional[float] = 120.0) -> np.ndarray:
+        """Block until the request finishes; returns the generated
+        tokens [n] int32 (prompt excluded)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise TimeoutError(
+                    f"decode request not finished within {timeout}s")
+            if self._error is not None:
+                raise self._error
+            return np.asarray(self._tokens, np.int32)
+
+    def stream(self, timeout: Optional[float] = 120.0):
+        """Yield tokens as they are generated; raises the request's
+        error (if any) after the buffered tokens.  Tokens are yielded
+        OUTSIDE the request lock: a consumer doing slow work per token
+        (or abandoning the generator mid-stream) must never block the
+        batcher worker's ``_push`` — that would stall every other
+        request on the engine."""
+        i = 0
+        while True:
+            with self._cond:
+                ok = self._cond.wait_for(
+                    lambda: self._done or len(self._tokens) > i, timeout)
+                if not ok:
+                    raise TimeoutError(
+                        f"no token within {timeout}s")
+                pending = self._tokens[i:]
+                # _push always precedes _finish, so once done is set the
+                # token list cannot grow — this snapshot is final
+                finished = self._done
+                err = self._error
+            for tok in pending:
+                i += 1
+                yield tok
+            if finished:
+                if err is not None:
+                    raise err
+                return
+
+
+class ContinuousBatcher:
+    """Streaming front-end over a ``DecodeEngine``: one worker thread
+    admits pending requests into free slots (prefill joins between
+    decode steps), advances every occupied bucket one token per
+    iteration, recycles slots on EOS/budget, and resolves
+    ``DecodeRequest`` handles.  ``close()`` drains: accepted requests
+    run to completion, then the worker exits."""
+
+    def __init__(self, engine: DecodeEngine, *,
+                 default_max_tokens: int = 64):
+        self.engine = engine
+        self.default_max_tokens = int(default_max_tokens)
+        self._cv = threading.Condition()
+        self._pending: List[DecodeRequest] = []
+        self._placed: Dict[Tuple[int, int], DecodeRequest] = {}
+        self._open = True
+        self._thread = threading.Thread(
+            target=self._loop, name="dl4j-decode-batcher", daemon=True)
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt, max_tokens: Optional[int] = None,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> DecodeRequest:
+        """Enqueue one prompt [T_p] (ints); returns its streaming
+        handle.  Prompt-too-long raises synchronously (typed ValueError
+        from the bucket ladder)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        max_tokens = int(max_tokens or self.default_max_tokens)
+        self.engine.pick_bucket(prompt.size + max_tokens)  # sync validate
+        req = DecodeRequest(prompt, max_tokens, float(temperature),
+                            int(seed), eos_id)
+        with self._cv:
+            if not self._open:
+                raise RuntimeError("ContinuousBatcher is closed")
+            self._pending.append(req)
+            decode_metrics.note_request(prompt.size)
+            decode_metrics.note_queue_depth(len(self._pending))
+            self._cv.notify()
+        return req
+
+    def generate(self, prompt, timeout: Optional[float] = 120.0,
+                 **kw) -> np.ndarray:
+        """Blocking convenience: submit + wait for the full result."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def depth(self) -> int:
+        """Pending + in-flight request count — the router's least-depth
+        dispatch and load-shed signal."""
+        with self._cv:
+            return len(self._pending) + len(self._placed)
+
+    # -- worker side -------------------------------------------------------
+    def _admit(self) -> int:
+        """Place as many pending requests as free slots allow; returns
+        how many were admitted.  Runs on the worker thread only."""
+        admitted = 0
+        while True:
+            with self._cv:
+                req = None
+                for i, r in enumerate(self._pending):
+                    bucket = self.engine.pick_bucket(
+                        r.prompt.size + r.max_tokens)
+                    if self.engine.free_slot(bucket) is not None:
+                        req = self._pending.pop(i)
+                        break
+                if req is None:
+                    decode_metrics.note_queue_depth(len(self._pending))
+                    return admitted
+            joined = self.engine.n_active() > 0
+            try:
+                bucket, slot, first = self.engine.start(
+                    req.prompt, max_tokens=req.max_tokens,
+                    temperature=req.temperature, seed=req.seed,
+                    owner=req)
+            except Exception as e:      # resolve, never wedge the client
+                req._finish(e)
+                continue
+            if joined:
+                decode_metrics.note_join()
+            tr = telemetry.get_tracer()
+            if tr is not None:
+                tr.event("decode.join", bucket=bucket, slot=slot,
+                         prompt_tokens=int(req.prompt.size),
+                         mid_flight=joined)
+            admitted += 1
+            with self._cv:
+                self._placed[(bucket, slot)] = req
+            req._push(first)
+            self._maybe_finish(bucket, slot, req, first, n_out=1)
+
+    def _maybe_finish(self, bucket: int, slot: int, req: DecodeRequest,
+                      tok: int, n_out: int) -> bool:
+        if (req.eos_id is not None and tok == req.eos_id) \
+                or n_out >= req.max_tokens:
+            self.engine.release(bucket, slot)
+            with self._cv:
+                self._placed.pop((bucket, slot), None)
+            decode_metrics.note_complete(n_out)
+            req._finish()
+            tr = telemetry.get_tracer()
+            if tr is not None:
+                tr.event("decode.complete", bucket=bucket, slot=slot,
+                         tokens=n_out,
+                         ttft_ms=round(req.ttft_ms or 0.0, 3))
+            return True
+        return False
+
+    def _advance_all(self) -> None:
+        for bucket in self.engine.active_buckets():
+            t0 = time.perf_counter()
+            try:
+                toks = self.engine.advance(bucket)
+            except Exception as e:
+                # a failed dispatch poisons this bucket's in-flight
+                # requests (state was donated); resolve them all rather
+                # than wedge their clients, and free the slots
+                with self._cv:
+                    doomed = [(k, r) for k, r in self._placed.items()
+                              if k[0] == bucket]
+                for (bk, slot), r in doomed:
+                    self.engine.release(bk, slot)
+                    with self._cv:
+                        self._placed.pop((bk, slot), None)
+                    r._finish(e)
+                continue
+            decode_metrics.note_token_ms(
+                (time.perf_counter() - t0) * 1e3)
+            with self._cv:
+                owned = [(k, r) for k, r in self._placed.items()
+                         if k[0] == bucket]
+            for (bk, slot), r in owned:
+                tok = int(toks[slot])
+                r._push(tok)
+                self._maybe_finish(bk, slot, r, tok,
+                                   n_out=len(r._tokens))
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._open and not self._pending \
+                        and not self._placed:
+                    self._cv.wait()
+                if not self._open and not self._pending \
+                        and not self._placed:
+                    return
+            self._admit()
+            self._advance_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout: float = 120.0) -> None:
+        """Stop accepting, drain accepted requests to completion, join
+        the worker."""
+        with self._cv:
+            self._open = False
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

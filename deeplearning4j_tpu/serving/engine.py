@@ -30,6 +30,11 @@ request size must never cost a forward-pass compile.
 
 ``DynamicBatcher`` (serving/batcher.py) sits in front of this engine to
 coalesce many small concurrent requests into one MXU dispatch.
+
+This engine serves ONE-SHOT forwards.  Autoregressive decode traffic —
+where a request is a sequence of dependent dispatches, one per generated
+token — is a different shape with its own engine: the slot-structured
+continuous-batching ``DecodeEngine`` in serving/decode.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,126 @@
+"""Replicated decode serving: least-depth routing + load-shedding.
+
+One ``DecodeEngine`` saturates one device; production traffic wants N
+replicas with a router in front — the fan-out half of the serving story
+in arXiv:2605.25645 (replicated decode servers behind a dispatcher) and
+the classic admission-control lesson: beyond a queue-depth bound,
+REJECTING work keeps p99 bounded while accepting it melts every
+client's latency.
+
+- ``Router`` holds N ``ContinuousBatcher`` front-ends and submits each
+  request to the least-loaded one (pending + in-flight depth).
+- When even the least-loaded replica is at ``max_queue_depth``, the
+  request is shed with the typed :class:`OverloadedError` (booked in
+  ``runtime.metrics.decode_metrics.requests_shed`` and, when tracing,
+  a ``decode.shed`` event) — clients see a clean, immediate, typed
+  rejection they can retry against, not a timeout.
+- ``Router.replicate(...)`` builds the replicas, placing each engine's
+  params on a device round-robin (``jax.devices()``) so replicas decode
+  on distinct chips when the platform has them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.runtime.metrics import decode_metrics
+from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
+                                               DecodeEngine, DecodeRequest)
+
+
+class OverloadedError(RuntimeError):
+    """Typed load-shed rejection: every replica is above the router's
+    queue-depth bound.  Carries the observed depth so clients/backoff
+    policies can reason about it."""
+
+    def __init__(self, depth: int, bound: int, replicas: int):
+        super().__init__(
+            f"all {replicas} decode replica(s) at queue depth >= "
+            f"{bound} (least-loaded: {depth}); request shed")
+        self.depth = depth
+        self.bound = bound
+        self.replicas = replicas
+
+
+class Router:
+    """Least-depth dispatch over N ``ContinuousBatcher`` replicas with
+    a hard queue-depth admission bound."""
+
+    def __init__(self, batchers: Sequence[ContinuousBatcher], *,
+                 max_queue_depth: int = 64):
+        if not batchers:
+            raise ValueError("Router needs at least one batcher")
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1: {max_queue_depth}")
+        self.batchers = list(batchers)
+        self.max_queue_depth = int(max_queue_depth)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def replicate(cls, cfg, params: Any, n_replicas: int, *,
+                  devices: Optional[Sequence] = None,
+                  max_queue_depth: int = 64,
+                  n_slots: int = 8,
+                  buckets: Optional[Sequence[int]] = None,
+                  prefill_chunk: Optional[int] = None,
+                  default_max_tokens: int = 64,
+                  warmup: bool = True) -> "Router":
+        """Build N engine+batcher replicas for one model, params placed
+        round-robin over ``devices`` (default: all local devices)."""
+        from deeplearning4j_tpu.models import gpt
+
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1: {n_replicas}")
+        devices = list(devices) if devices is not None else jax.devices()
+        chunk = prefill_chunk or gpt.PREFILL_CHUNK
+        batchers = []
+        for i in range(n_replicas):
+            dev = devices[i % len(devices)]
+            p = jax.device_put(params, dev)
+            eng = DecodeEngine(cfg, p, n_slots=n_slots, buckets=buckets,
+                               prefill_chunk=chunk)
+            if warmup:
+                eng.warmup()
+            batchers.append(ContinuousBatcher(
+                eng, default_max_tokens=default_max_tokens))
+        return cls(batchers, max_queue_depth=max_queue_depth)
+
+    # -- dispatch ----------------------------------------------------------
+    def depths(self) -> list:
+        return [b.depth() for b in self.batchers]
+
+    def submit(self, prompt, **kw) -> DecodeRequest:
+        """Route one request to the least-loaded replica; shed with
+        :class:`OverloadedError` when every replica is at the bound."""
+        depths = self.depths()
+        i = int(np.argmin(depths))
+        if depths[i] >= self.max_queue_depth:
+            decode_metrics.note_shed()
+            tr = telemetry.get_tracer()
+            if tr is not None:
+                tr.event("decode.shed", depth=depths[i],
+                         bound=self.max_queue_depth,
+                         replicas=len(self.batchers))
+            raise OverloadedError(depths[i], self.max_queue_depth,
+                                  len(self.batchers))
+        return self.batchers[i].submit(prompt, **kw)
+
+    def generate(self, prompt, timeout: Optional[float] = 120.0,
+                 **kw) -> np.ndarray:
+        return self.submit(prompt, **kw).result(timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout: float = 120.0) -> None:
+        for b in self.batchers:
+            b.close(timeout)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

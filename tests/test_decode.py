@@ -1,0 +1,328 @@
+"""Continuous-batching decode serving (serving/decode.py + router.py).
+
+The load-bearing property is SLOT PARITY: a request decoded inside a
+busy continuous batch — including one that JOINS mid-flight while other
+slots are mid-decode — must be token-identical to a solo
+``gpt.generate()`` run (greedy, float32).  Plus: chunked-prefill logits
+parity against the dense forward, EOS slot recycling, sampling
+reproducibility across placements, router least-depth dispatch and
+load-shedding, and the zero-steady-state-compile contract.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import gpt
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.runtime.metrics import decode_metrics
+from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
+                                               DecodeEngine,
+                                               default_length_buckets)
+from deeplearning4j_tpu.serving.router import OverloadedError, Router
+
+CFG = TransformerConfig(vocab_size=64, max_len=64, hidden=32, n_layers=2,
+                        n_heads=2, ffn_dim=64, dropout=0.0,
+                        compute_dtype="float32", causal=True,
+                        type_vocab_size=1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init_params(jax.random.key(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    eng = DecodeEngine(CFG, params, n_slots=4, buckets=(32, 64))
+    eng.warmup()
+    return eng
+
+
+def _solo(params, prompt, n_tokens):
+    """Reference: solo greedy generate() (same chunked prefill path)."""
+    out = gpt.generate(CFG, params, np.asarray(prompt, np.int32)[None, :],
+                       n_tokens, jax.random.key(0), temperature=0.0)
+    return np.asarray(out)[0]
+
+
+# -- bucket ladder ----------------------------------------------------------
+
+def test_default_length_buckets():
+    assert default_length_buckets(128) == (32, 64, 128)
+    assert default_length_buckets(48) == (32, 48)
+    assert default_length_buckets(16) == (16,)
+    with pytest.raises(ValueError):
+        default_length_buckets(0)
+
+
+def test_bucket_chunk_divisibility(params):
+    # the chunk shrinks to the largest width dividing every rung —
+    # default construction must work for ANY ladder (e.g. a max_len=48
+    # model yields the (32, 48) ladder)
+    eng = DecodeEngine(CFG, params, buckets=(24, 64), prefill_chunk=16)
+    assert eng.prefill_chunk == 8
+    eng = DecodeEngine(CFG, params, buckets=(32, 48))
+    assert eng.prefill_chunk == 16
+    with pytest.raises(ValueError, match="exceeds the model"):
+        DecodeEngine(CFG, params, buckets=(128,))
+
+
+# -- chunked dense prefill --------------------------------------------------
+
+def test_chunked_prefill_logits_parity(params):
+    """prefill_cache (slab-written K/V, any chunk width) reproduces the
+    dense forward's last-position logits for prompts off/on chunk
+    boundaries."""
+    rng = np.random.RandomState(0)
+    for t_p in (3, 8, 9, 17, 32):
+        prompt = rng.randint(1, CFG.vocab_size, size=(2, t_p))
+        prompt = prompt.astype(np.int32)
+        ref = gpt.forward_logits(CFG, params, prompt)[:, -1]
+        cache = gpt.init_cache(CFG, 2, 64)
+        _, logits = gpt.prefill_cache(CFG, params, cache, prompt, chunk=8)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+# -- slot parity (the acceptance test) --------------------------------------
+
+def test_mid_flight_join_token_parity(params, engine):
+    """Engine-level continuous batching: A decodes alone for several
+    steps, B JOINS the running batch (prefill into a free slot while A's
+    state rides along), both run to budget — and both are
+    token-identical to their solo greedy runs."""
+    rng = np.random.RandomState(1)
+    pa = rng.randint(1, CFG.vocab_size, size=7).astype(np.int32)
+    pb = rng.randint(1, CFG.vocab_size, size=11).astype(np.int32)
+    n_a, n_b = 12, 9
+
+    bucket, slot_a, first_a = engine.start(pa, max_tokens=n_a,
+                                           owner="A")
+    toks_a = [first_a]
+    for _ in range(4):                       # A decodes alone ...
+        toks_a.append(int(engine.advance(bucket)[slot_a]))
+
+    joins_before = decode_metrics.snapshot()["joins"]
+    assert engine.n_active() == 1
+    bucket_b, slot_b, first_b = engine.start(pb, max_tokens=n_b,
+                                             owner="B")
+    assert bucket_b == bucket and slot_b != slot_a   # joined, mid-flight
+    toks_b = [first_b]
+    while len(toks_a) < n_a or len(toks_b) < n_b:    # ... then together
+        out = engine.advance(bucket)
+        if len(toks_a) < n_a:
+            toks_a.append(int(out[slot_a]))
+        if len(toks_b) < n_b:
+            toks_b.append(int(out[slot_b]))
+    engine.release(bucket, slot_a)
+    engine.release(bucket, slot_b)
+
+    np.testing.assert_array_equal(toks_a, _solo(params, pa, n_a))
+    np.testing.assert_array_equal(toks_b, _solo(params, pb, n_b))
+    assert joins_before == decode_metrics.snapshot()["joins"]  # engine-level
+
+
+def test_busy_batcher_token_parity(params, engine):
+    """Batcher-level: requests submitted concurrently into a busy batch
+    (later ones join mid-flight) all match their solo runs."""
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, CFG.vocab_size, size=n).astype(np.int32)
+               for n in (5, 9, 3, 14)]
+    n_tok = 16
+    refs = [_solo(params, p, n_tok) for p in prompts]
+
+    joins_before = decode_metrics.snapshot()["joins"]
+    with ContinuousBatcher(engine, default_max_tokens=n_tok) as cb:
+        first_wave = [cb.submit(p, max_tokens=n_tok) for p in prompts[:3]]
+        # wait until the first wave is actually decoding ...
+        for r in first_wave:
+            next(r.stream(30))
+        # ... then join a probe mid-flight
+        probe = cb.submit(prompts[3], max_tokens=n_tok)
+        outs = [r.result(60) for r in first_wave] + [probe.result(60)]
+    for ref, out in zip(refs, outs):
+        np.testing.assert_array_equal(out, ref)
+    assert decode_metrics.snapshot()["joins"] > joins_before
+
+
+def test_sampling_reproducible_across_placement(params, engine):
+    """temperature>0 sampling keys fold (seed, position) — NOT the slot
+    or the step — so the same request resampled in a different batch
+    context yields the identical continuation."""
+    rng = np.random.RandomState(3)
+    p = rng.randint(1, CFG.vocab_size, size=6).astype(np.int32)
+    with ContinuousBatcher(engine, default_max_tokens=10) as cb:
+        solo_run = cb.submit(p, max_tokens=10, temperature=0.8,
+                             seed=42).result(60)
+        # same request again, this time racing three other streams
+        others = [cb.submit(rng.randint(1, CFG.vocab_size, size=4),
+                            max_tokens=12, temperature=0.5, seed=i)
+                  for i in range(3)]
+        busy_run = cb.submit(p, max_tokens=10, temperature=0.8,
+                             seed=42).result(60)
+        for o in others:
+            o.result(60)
+    np.testing.assert_array_equal(solo_run, busy_run)
+
+
+# -- EOS + slot recycling ---------------------------------------------------
+
+def test_eos_ends_early_and_recycles_slots(params, engine):
+    rng = np.random.RandomState(4)
+    p = rng.randint(1, CFG.vocab_size, size=5).astype(np.int32)
+    ref = _solo(params, p, 8)
+    eos = int(ref[3])
+    stop = int(np.argmax(ref == eos))        # first occurrence ends it
+    with ContinuousBatcher(engine, default_max_tokens=8) as cb:
+        out = cb.submit(p, max_tokens=20, eos_id=eos).result(60)
+        # stopped AT the first (included) eos token, well under budget
+        np.testing.assert_array_equal(out, ref[:stop + 1])
+        assert out[-1] == eos and len(out) < 20
+
+        # recycling: 3x more requests than slots all complete, and the
+        # engine ends fully drained
+        prompts = [rng.randint(1, CFG.vocab_size, size=4 + i % 5)
+                   for i in range(12)]
+        outs = [cb.submit(q.astype(np.int32), max_tokens=5)
+                for q in prompts]
+        for r in outs:
+            assert r.result(120).shape == (5,)
+    assert engine.n_active() == 0
+    assert all(b.free_slot() == 0 for b in engine._buckets.values())
+
+
+def test_request_streaming_matches_result(params, engine):
+    rng = np.random.RandomState(5)
+    p = rng.randint(1, CFG.vocab_size, size=4).astype(np.int32)
+    with ContinuousBatcher(engine, default_max_tokens=6) as cb:
+        r = cb.submit(p, max_tokens=6)
+        streamed = list(r.stream(30))
+        np.testing.assert_array_equal(streamed, r.result(1))
+        assert r.ttft_ms is not None and r.ttft_ms >= 0.0
+
+
+def test_oversize_prompt_rejected_synchronously(params, engine):
+    with ContinuousBatcher(engine) as cb:
+        with pytest.raises(ValueError, match="largest bucket"):
+            cb.submit(np.ones(60, np.int32), max_tokens=32)
+        with pytest.raises(ValueError, match="empty prompt"):
+            cb.submit(np.zeros(0, np.int32), max_tokens=4)
+
+
+# -- steady-state compile freedom -------------------------------------------
+
+def test_zero_steady_state_compiles(params, engine):
+    """After warmup, ANY mix of prompt lengths, joins, EOS exits and
+    slot reuse across both buckets dispatches only cached programs."""
+    decode_metrics.mark_compiles()
+    rng = np.random.RandomState(6)
+    with ContinuousBatcher(engine, default_max_tokens=6) as cb:
+        handles = [cb.submit(rng.randint(1, CFG.vocab_size,
+                                         size=rng.randint(2, 40)),
+                             max_tokens=int(rng.randint(3, 12)))
+                   for _ in range(10)]
+        for h in handles:
+            h.result(120)
+    assert decode_metrics.snapshot()["compile_delta_since_mark"] == 0
+
+
+def test_warmup_compile_count_bounded_by_buckets(params):
+    """A fresh engine geometry pre-traces exactly 2 executables per
+    bucket (prefill + step), then serves compile-free."""
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=16, label="decode-warmup-test")
+    stats = eng.warmup()
+    assert stats["buckets"] == 1
+    assert stats["compiles"] == 2
+    # warming again is free — both programs are cached
+    assert eng.warmup()["compiles"] == 0
+
+
+# -- router -----------------------------------------------------------------
+
+def test_router_least_depth_dispatch(params, engine):
+    """Two replicas: concurrent submissions spread by queue depth."""
+    eng2 = DecodeEngine(CFG, params, n_slots=4, buckets=(32, 64))
+    eng2.warmup()                            # cache-hit, no new compiles
+    b1 = ContinuousBatcher(engine, default_max_tokens=12)
+    b2 = ContinuousBatcher(eng2, default_max_tokens=12)
+    router = Router([b1, b2], max_queue_depth=8)
+    rng = np.random.RandomState(7)
+    with router:
+        h1 = router.submit(rng.randint(1, 64, size=4), max_tokens=12)
+        h2 = router.submit(rng.randint(1, 64, size=4), max_tokens=12)
+        depths = router.depths()
+        assert sorted(depths) == [1, 1] or sum(depths) < 2  # may finish
+        h1.result(60), h2.result(60)
+
+
+def test_router_load_shed(params, engine):
+    """Above the queue-depth bound every submit is shed with the typed
+    error (booked in decode_metrics), and in-flight work still
+    completes."""
+    b = ContinuousBatcher(engine, default_max_tokens=24)
+    router = Router([b], max_queue_depth=1)
+    shed_before = decode_metrics.snapshot()["requests_shed"]
+    rng = np.random.RandomState(8)
+    with router:
+        keep = router.submit(rng.randint(1, 64, size=4), max_tokens=24)
+        with pytest.raises(OverloadedError) as ei:
+            # depth >= 1 until `keep` finishes: decode of 24 tokens is
+            # far slower than this submit
+            router.submit(rng.randint(1, 64, size=4), max_tokens=4)
+        assert ei.value.bound == 1 and ei.value.replicas == 1
+        assert keep.result(60).shape == (24,)
+    assert decode_metrics.snapshot()["requests_shed"] == shed_before + 1
+
+
+def test_router_validation():
+    with pytest.raises(ValueError):
+        Router([], max_queue_depth=4)
+    with pytest.raises(ValueError):
+        Router.replicate(CFG, {}, 0)
+
+
+# -- concurrency ------------------------------------------------------------
+
+def test_many_concurrent_clients(params, engine):
+    """8 client threads x 2 requests against 4 slots: all complete,
+    all match solo refs (greedy f32), occupancy is booked."""
+    n_tok = 6
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, CFG.vocab_size, size=3 + i % 7)
+               .astype(np.int32) for i in range(16)]
+    refs = [_solo(params, p, n_tok) for p in prompts]
+    outs = [None] * 16
+    errs = []
+    with ContinuousBatcher(engine, default_max_tokens=n_tok) as cb:
+        def client(i):
+            try:
+                outs[i] = cb.submit(prompts[i], max_tokens=n_tok
+                                    ).result(120)
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+    assert not errs
+    for ref, out in zip(refs, outs):
+        np.testing.assert_array_equal(out, ref)
+    snap = decode_metrics.snapshot()
+    assert 0.0 < snap["slot_occupancy"] <= 1.0
+
+
+def test_close_drains_accepted_requests(params, engine):
+    rng = np.random.RandomState(10)
+    cb = ContinuousBatcher(engine, default_max_tokens=10)
+    h = cb.submit(rng.randint(1, 64, size=5), max_tokens=10)
+    cb.close()
+    assert h.result(1).shape == (10,)        # ran to completion
+    with pytest.raises(RuntimeError, match="closed"):
+        cb.submit(rng.randint(1, 64, size=5))

@@ -201,7 +201,16 @@ def test_worker_failure_requeues_job():
 def test_distributed_word2vec_e2e():
     """DistributedWord2VecTest parity: sharded sentence training through
     the runner produces usable vectors (similar words closer than
-    unrelated ones)."""
+    unrelated ones).
+
+    Each of the 4 shards holds only ~240 tokens (~1000 candidate
+    pairs), so the per-shard fit must take SMALL sequential steps to
+    train at all: at the old batch_size=256 a shard's epoch was ~4
+    mean-normalized updates and the averaged tables stayed at their
+    random init (related-pair similarity ~0.0004 — the long-standing
+    "latent" failure).  batch_size=32 x epochs=10 gives each shard
+    ~320 real updates, matching the reference performer's per-sentence
+    SGD granularity."""
     from deeplearning4j_tpu.nlp.distributed import (
         train_word2vec_distributed)
     from deeplearning4j_tpu.nlp.word2vec import Word2VecConfig
@@ -213,8 +222,8 @@ def test_distributed_word2vec_e2e():
                "the dog sat on the rug",
                "cats and dogs are pets"] * 30)
     wv = train_word2vec_distributed(
-        corpus, Word2VecConfig(vector_size=24, window=3, epochs=3,
-                               seed=11, batch_size=256),
+        corpus, Word2VecConfig(vector_size=24, window=3, epochs=10,
+                               seed=11, batch_size=32),
         n_workers=2, n_shards=4, timeout_s=240)
     assert wv.has_word("beach") and wv.has_word("cat")
     related = wv.similarity("sand", "sea")

@@ -266,12 +266,13 @@ def test_registry_snapshot_structure_and_deltas():
     assert snap["telemetry_enabled"] is False and snap["run_id"] is None
 
 
-def test_process_registry_has_all_four_families():
+def test_process_registry_has_all_counter_families():
     snap = registry.snapshot()
     assert set(registry.sources()) == {"compile", "resilience", "serving",
-                                       "dp"}
+                                       "decode", "dp"}
     assert "compile_count" in snap["counters"]["compile"]
     assert "requests" in snap["counters"]["serving"]
+    assert "tokens_out" in snap["counters"]["decode"]
     assert "dispatches" in snap["counters"]["dp"]
 
 

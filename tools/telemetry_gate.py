@@ -8,7 +8,13 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
 3. a tracer-ON fit must ALSO show ``compile_delta_since_mark == 0``
    (enabling spans changes no jitted program — the tracer is host-side
    by construction) and must produce a journal whose chrome-trace
-   conversion is valid Perfetto JSON with the fit span present.
+   conversion is valid Perfetto JSON with the fit span present;
+4. the same off/on zero-compile contract for the continuous-batching
+   decode loop (serving/decode.py): after ``DecodeEngine.warmup()``, a
+   concurrent request mix — joins, EOS recycling, varied prompt
+   lengths — must dispatch only cached programs with the tracer off AND
+   on (the decode path's prefill/dispatch spans and join/complete
+   events are host-side only).
 
 Run by ``tools/ci.sh`` before the test tiers; exits non-zero on any
 violation.  (jaxlint runs separately in ci.sh and must also stay clean —
@@ -45,6 +51,55 @@ def _net_and_data():
                            rng.randint(0, 3, 16)])
                for _ in range(3)]
     return MultiLayerNetwork(conf).init(seed=1), batches
+
+
+def _decode_requests(cb, np, n: int, seed: int) -> None:
+    rng = np.random.RandomState(seed)
+    handles = [cb.submit(rng.randint(1, 48, size=rng.randint(2, 12)),
+                         max_tokens=4 + i % 4)
+               for i in range(n)]
+    for h in handles:
+        h.result(120)
+
+
+def _decode_gate(registry, telemetry) -> int:
+    import numpy as np
+
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
+                                                   DecodeEngine)
+
+    cfg = gpt.gpt_tiny(vocab_size=48, max_len=32)
+    params = gpt.init_params(__import__("jax").random.key(0), cfg)
+    eng = DecodeEngine(cfg, params, n_slots=3, buckets=(16, 32),
+                       prefill_chunk=8)
+    eng.warmup()
+    with ContinuousBatcher(eng, default_max_tokens=4) as cb:
+        registry.mark()
+
+        # tracer OFF
+        assert not telemetry.enabled()
+        _decode_requests(cb, np, 6, seed=0)
+        delta_off = registry.compile_delta_since_mark()
+        if delta_off != 0:
+            print(f"[telemetry-gate] FAIL: tracer-off decode loop "
+                  f"compiled {delta_off} new program(s)")
+            return 1
+
+        # tracer ON
+        telemetry.enable("telemetry-gate-decode")
+        registry.mark()
+        _decode_requests(cb, np, 6, seed=1)
+        delta_on = registry.compile_delta_since_mark()
+        telemetry.disable()
+        if delta_on != 0:
+            print(f"[telemetry-gate] FAIL: tracer-on decode loop "
+                  f"compiled {delta_on} new program(s) — decode "
+                  "instrumentation leaked into a jitted region")
+            return 1
+    print(f"[telemetry-gate] ok: decode loop compile_delta "
+          f"off={delta_off} on={delta_on}")
+    return 0
 
 
 def main() -> int:
@@ -93,7 +148,7 @@ def main() -> int:
     telemetry.disable()
     print(f"[telemetry-gate] ok: compile_delta off={delta_off} "
           f"on={delta_on}, {len(records)} journal record(s)")
-    return 0
+    return _decode_gate(registry, telemetry)
 
 
 if __name__ == "__main__":
