@@ -65,6 +65,14 @@ def cmd_train(args) -> int:
     from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
     from deeplearning4j_tpu.runtime import telemetry
 
+    if not args.checkpoint_dir and (args.resume or args.sync_checkpoints):
+        # silently training from scratch here would overwrite --output
+        # — exactly the data loss --resume exists to avoid
+        raise SystemExit(
+            "--resume/--sync-checkpoints require --checkpoint-dir")
+    if args.checkpoint_dir and args.checkpoint_every <= 0:
+        raise SystemExit("--checkpoint-every must be a positive step "
+                         "count")
     tracer = None
     journal_dir = args.telemetry
     if journal_dir is True:                 # bare --telemetry flag
@@ -80,7 +88,59 @@ def cmd_train(args) -> int:
         net = MultiLayerNetwork(conf).init(seed=args.seed)
         net.set_listeners([ScoreIterationListener(args.log_every)])
         batches = (data.batch_by(args.batch) if args.batch > 0 else data)
-        net.fit(batches, num_epochs=args.epochs)
+        if args.checkpoint_dir:
+            # preemption-tolerant path: async snapshots + signal guard;
+            # SIGTERM mid-fit commits a final snapshot and returns here
+            # cleanly (exit 0) — rerun with --resume to continue
+            from deeplearning4j_tpu.runtime.resilience import (
+                ResilienceConfig, ResilientFit)
+            if conf.pretrain:
+                raise SystemExit(
+                    "--checkpoint-dir drives the backprop trainer; "
+                    "pretrain confs must use the plain train path")
+            # dir-state misuse fails BEFORE the finetune pass is spent,
+            # and as a one-line SystemExit like every sibling guard —
+            # not a raw traceback out of ResilientFit
+            from deeplearning4j_tpu.runtime.checkpoint import (
+                CheckpointManager)
+            latest = CheckpointManager(args.checkpoint_dir).latest_step()
+            if args.resume and latest is None:
+                # empty/mistyped dir (unmounted volume?): silently
+                # training from scratch would overwrite --output with a
+                # from-step-0 rerun — the data loss --resume exists to
+                # avoid
+                raise SystemExit(
+                    f"--resume: no checkpoints found in "
+                    f"{args.checkpoint_dir} — wrong path or unmounted "
+                    "volume? rerun without --resume for a fresh run")
+            if not args.resume and latest is not None:
+                raise SystemExit(
+                    f"--checkpoint-dir {args.checkpoint_dir} already "
+                    f"holds snapshots (latest step {latest}) — rerun "
+                    "with --resume to continue that run, or point at a "
+                    "fresh directory")
+            # net.fit's own stage prep (finetune pass + gated
+            # mesh="auto") so adding --checkpoint-dir never changes
+            # WHAT is trained; on a resume the restore overwrites the
+            # finetuned params — harmless
+            batch_list, mesh = net.prepare_resilient_fit(batches)
+            driver = ResilientFit(net, ResilienceConfig(
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                resume=args.resume, sync=args.sync_checkpoints),
+                mesh=mesh)
+            driver.fit(batch_list, num_epochs=args.epochs, seed=args.seed)
+            if driver.preempted:
+                print(f"preempted: final snapshot committed at step "
+                      f"{driver.manager.latest_step()} in "
+                      f"{args.checkpoint_dir} — rerun with --resume")
+                # the grace window is burning: skip the model write and
+                # the full-dataset evaluate — the committed snapshot IS
+                # this run's output, and a SIGKILL landing mid-write
+                # would leave a truncated --output worse than none
+                return 0
+        else:
+            net.fit(batches, num_epochs=args.epochs)
         with open(args.output, "wb") as fh:
             fh.write(net.to_bytes())
         ev = net.evaluate(data)
@@ -349,6 +409,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable the run tracer and write a JSONL journal "
                         "into DIR (bare --telemetry uses the gitignored "
                         "'.dl4j_telemetry', or $DL4J_TPU_TELEMETRY_DIR)")
+    t.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="train through the preemption-tolerant "
+                        "ResilientFit driver: async background snapshots "
+                        "into DIR, SIGTERM/SIGINT triggers a final "
+                        "committed snapshot + clean exit 0")
+    t.add_argument("--checkpoint-every", type=int, default=50,
+                   metavar="STEPS", help="snapshot cadence in steps")
+    t.add_argument("--resume", action="store_true",
+                   help="continue from the newest committed checkpoint "
+                        "in --checkpoint-dir (the restart half of the "
+                        "preemption drill)")
+    t.add_argument("--sync-checkpoints", action="store_true",
+                   help="escape hatch: block the training thread on "
+                        "every snapshot instead of the async writer")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("test", help="evaluate a saved model")

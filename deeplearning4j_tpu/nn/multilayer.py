@@ -457,7 +457,14 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.parallel.mesh import mesh_signature
 
         dp = mesh is not None or self.conf.grad_accum > 1
-        memo_key = ("dp", mesh_signature(mesh)) if dp else "legacy"
+        # the accum factor joins the memo key: ResilientFit's elastic
+        # recovery legitimately rebuilds on the same mesh signature with
+        # a different grad_accum (the one sanctioned conf mutation), and
+        # the engine key below would catch it while this per-net memo
+        # would not — a stale hit here trains with the wrong
+        # accumulation and breaks the effective-batch equivalence
+        memo_key = (("dp", mesh_signature(mesh),
+                     max(self.conf.grad_accum, 1)) if dp else "legacy")
         if memo_key not in self._bp_cache:
             if dp:
                 self._bp_cache[memo_key] = compile_cache.get_or_build(
@@ -873,9 +880,15 @@ class MultiLayerNetwork:
             it += num_epochs * len(batches)
         else:
             skips = []
+            stop = False
             for epoch in range(num_epochs):
+                if stop:
+                    break
                 with telemetry.span("multilayer.epoch", epoch=epoch):
                     for batch in batches:
+                        if self._preempt_stop("fit_backprop"):
+                            stop = True
+                            break
                         params, ustate, it = self._step_and_notify(
                             train_step, params, ustate, batch, run_key, it,
                             skips)
@@ -962,10 +975,16 @@ class MultiLayerNetwork:
             it += num_epochs * len(batches)
         else:
             skips = []
+            stop = False
             for epoch in range(num_epochs):
+                if stop:
+                    break
                 with telemetry.span("multilayer.epoch", epoch=epoch,
                                     data_degree=ndp):
                     for b, target in zip(batches, pad_to):
+                        if self._preempt_stop("fit_backprop_dp"):
+                            stop = True
+                            break
                         dp_batch = (self._pad_rows(b.features, target),
                                     self._pad_rows(b.labels, target),
                                     jnp.int32(b.features.shape[0]))
@@ -1016,6 +1035,20 @@ class MultiLayerNetwork:
             hook = getattr(ls, "on_fit_start", None)
             if callable(hook):
                 hook(self)
+
+    @staticmethod
+    def _preempt_stop(where: str) -> bool:
+        """Step-boundary preemption check for the STREAMING fit loops:
+        True when an installed ``resilience.PreemptionGuard`` has seen a
+        preemption signal — the loop finishes cleanly with the params
+        trained so far (checkpoint policy belongs to ``ResilientFit``,
+        which owns the final-snapshot half of the drill).  One global
+        read when no guard is installed; the single-dispatch scanned
+        paths have no step boundary to stop at and run to completion."""
+        if resilience.preemption_requested():
+            telemetry.event("multilayer.preempt_stop", where=where)
+            return True
+        return False
 
     def fit_iterator(self, it, num_epochs: int = 1, seed: int = 2,
                      mesh="auto", prefetch_depth: int = 2) -> None:
@@ -1083,12 +1116,18 @@ class MultiLayerNetwork:
                     pad_rows_to=chunk)
         step = 0
         skips = []
+        stop = False
         with telemetry.span("multilayer.fit", path="iterator",
                             epochs=num_epochs, sharded=rmesh is not None):
             for epoch in range(num_epochs):
+                if stop:
+                    break
                 with telemetry.span("multilayer.epoch", epoch=epoch):
                     src.reset()
                     while src.has_next():
+                        if self._preempt_stop("fit_iterator"):
+                            stop = True
+                            break
                         batch = src.next()
                         if dp_mode:
                             n_valid = getattr(batch, "n_valid", None)
@@ -1125,6 +1164,24 @@ class MultiLayerNetwork:
         self.finetune(merged)
         if self.conf.backprop:
             self.fit_backprop(batches, num_epochs=num_epochs)
+
+    def prepare_resilient_fit(self, data: Union[DataSet, Sequence[DataSet]]
+                              ) -> tuple:
+        """``fit()``'s front half for EXTERNAL training drivers
+        (``cli train --checkpoint-dir`` -> ``runtime.resilience
+        .ResilientFit``): the same finetune pass on the merged batches
+        and the same gated ``mesh="auto"`` policy ``fit_backprop``
+        applies, returned as ``(batch_list, mesh)`` for the driver's
+        constructor.  One source of truth — a driver-run fit must never
+        train something different from ``net.fit`` just because
+        checkpointing was turned on.  Pretrain confs are the caller's
+        problem to refuse (the driver only replays the backprop step)."""
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        merged = DataSet.merge(batches) if len(batches) > 1 else batches[0]
+        self.finetune(merged)
+        mesh = self._resolve_fit_mesh(
+            "auto", min(b.features.shape[0] for b in batches))
+        return batches, mesh
 
     # -- evaluation helper -------------------------------------------------
     def evaluate(self, data: DataSet):

@@ -30,10 +30,55 @@ from typing import Callable, Optional
 
 from deeplearning4j_tpu.parallel.coordinator import Job
 from deeplearning4j_tpu.parallel import scaleout as so
+# DeviceLossError is DEFINED in runtime/resilience.py (the driver that
+# catches it cannot import this module — chaos -> scaleout -> resilience
+# would cycle) and re-exported here where the injectors that raise it
+# live.
+from deeplearning4j_tpu.runtime.resilience import DeviceLossError  # noqa: F401
 
 
 class InjectedFault(RuntimeError):
     """Raised by ChaosPerformer for an injected crash."""
+
+
+class DeviceLossChaos:
+    """Step-boundary device-loss injector for ``ResilientFit``'s
+    ``fault_hook``: raises :class:`DeviceLossError` for ``lost_ids``
+    the first time the step counter reaches ``at_step`` (exactly once —
+    the recovery path re-runs the boundary check after re-meshing, and
+    a fault that re-fires forever would starve the resume instead of
+    testing it)."""
+
+    def __init__(self, at_step: int, lost_ids):
+        self.at_step = at_step
+        self.lost_ids = tuple(int(i) for i in lost_ids)
+        self.fired = False
+
+    def __call__(self, step: int) -> None:
+        if not self.fired and step >= self.at_step:
+            self.fired = True
+            raise DeviceLossError(
+                self.lost_ids,
+                f"injected device loss at step {step}: ids "
+                f"{sorted(self.lost_ids)}")
+
+
+class PreemptionChaos:
+    """Step-boundary preemption drill for ``ResilientFit``'s
+    ``fault_hook``: flags the driver's PreemptionGuard at ``at_step`` —
+    the signal-free way to exercise the final-snapshot-and-clean-exit
+    path in benches and CI gates (the SIGTERM-driven path is tested via
+    subprocess)."""
+
+    def __init__(self, at_step: int, guard):
+        self.at_step = at_step
+        self.guard = guard
+        self.fired = False
+
+    def __call__(self, step: int) -> None:
+        if not self.fired and step >= self.at_step:
+            self.fired = True
+            self.guard.request()
 
 
 def _hash01(seed: int, n: int) -> float:

@@ -215,13 +215,24 @@ def mesh_signature(mesh: Optional[Mesh]):
 _AUTO_MESH: Optional[Tuple[Tuple[int, ...], Mesh]] = None
 
 
-def auto_data_mesh() -> Optional[Mesh]:
+def auto_data_mesh(devices: Optional[Sequence[jax.Device]] = None
+                   ) -> Optional[Mesh]:
     """The default-fit mesh: every visible device on the ``data`` axis.
     Returns None on a single device (nothing to shard over) — callers
     fall back to the single-device path.  This is the auto-detection
     behind ``MultiLayerNetwork.fit_backprop(mesh="auto")``; pass an
-    explicit ``make_mesh(...)`` to override per call."""
+    explicit ``make_mesh(...)`` to override per call.
+
+    An explicit ``devices`` list (the elastic-resume path: the
+    SURVIVORS of a device loss) bypasses the process-wide memo — the
+    memo caches the healthy-fleet answer and must not be poisoned by a
+    degraded run's subset."""
     global _AUTO_MESH
+    if devices is not None:
+        devices = list(devices)
+        if len(devices) < 2:
+            return None
+        return make_mesh(MeshSpec(data=-1), devices=devices)
     devices = jax.devices()
     if len(devices) < 2:
         return None
@@ -230,3 +241,49 @@ def auto_data_mesh() -> Optional[Mesh]:
         _AUTO_MESH = (dev_ids, make_mesh(MeshSpec(data=-1),
                                          devices=devices))
     return _AUTO_MESH[1]
+
+
+# -- elastic re-meshing (device loss / preemption survival) -----------------
+
+def surviving_devices(mesh: Mesh, lost_ids) -> list:
+    """The mesh's devices minus the lost ones, in mesh order."""
+    lost = set(int(i) for i in lost_ids)
+    return [d for d in mesh.devices.flat if int(d.id) not in lost]
+
+
+def elastic_remesh(mesh: Mesh, lost_ids,
+                   grad_accum: int = 1) -> Tuple[Optional[Mesh], int]:
+    """Rebuild a DATA mesh over the survivors of a device loss while
+    PRESERVING the effective batch: returns ``(new_mesh, new_accum)``
+    with ``new_degree * new_accum == old_degree * grad_accum`` — the
+    PR 5 sum-loss formulation makes the re-meshed run BIT-identical to
+    the uninterrupted one at equal effective batch, so "same run,
+    smaller mesh" is an equivalence, not an approximation.
+
+    The new data degree is the LARGEST survivor count dividing the old
+    effective factor (losing 1 of 4 devices continues on 2 with
+    accum x2 — idle-ing one healthy device is cheaper than changing
+    the numerics).  ``new_mesh`` is None when only one device survives
+    or only degree 1 divides: the caller continues single-device with
+    ``new_accum = old_degree * grad_accum``.  Only pure data meshes are
+    elastic — model/pipe/seq/expert-sharded state cannot be re-laid-out
+    by a host-side driver and raises."""
+    for axis in (MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS):
+        if axis in mesh.shape and mesh.shape[axis] > 1:
+            raise ValueError(
+                f"elastic_remesh only supports pure data meshes; this "
+                f"mesh has {axis}={mesh.shape[axis]} (re-sharding "
+                f"model-parallel state needs a resharding restore, see "
+                f"load_pytree_sharded)")
+    survivors = surviving_devices(mesh, lost_ids)
+    if not survivors:
+        raise ValueError(
+            f"device loss {sorted(set(int(i) for i in lost_ids))} leaves "
+            "no survivors in this mesh — nothing to resume on")
+    eff = mesh.shape[DATA_AXIS] * max(grad_accum, 1)
+    degree = next(n for n in range(len(survivors), 0, -1) if eff % n == 0)
+    new_accum = eff // degree
+    if degree < 2:
+        return None, new_accum
+    return (make_mesh(MeshSpec(data=degree), devices=survivors[:degree]),
+            new_accum)

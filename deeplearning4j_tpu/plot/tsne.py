@@ -33,7 +33,13 @@ Array = jax.Array
 class TsneConfig:
     n_components: int = 2
     perplexity: float = 30.0
-    learning_rate: float = 200.0
+    #: "auto" = max(N / early_exaggeration, 50) — the Belkina et al.
+    #: (2019) heuristic sklearn adopted as its default.  A fixed lr of
+    #: 200 is far too hot for small N: gradient magnitudes scale with
+    #: P ~ 1/N, so small embeddings bounce around the gain schedule and
+    #: never tighten their clusters (the exact-tsne blob test failed on
+    #: exactly this).  A float keeps the old fixed-rate behavior.
+    learning_rate: "float | str" = "auto"
     max_iter: int = 500
     early_exaggeration: float = 12.0
     exaggeration_iters: int = 100
@@ -42,6 +48,18 @@ class TsneConfig:
     momentum_switch_iter: int = 250   # Tsne.java switchMomentumIteration
     theta: float = 0.5                # Barnes-Hut accuracy
     seed: int = 0
+
+
+def _resolve_lr(cfg: TsneConfig, n: int) -> float:
+    """Concrete learning rate for an N-point embedding (see
+    ``TsneConfig.learning_rate``)."""
+    if isinstance(cfg.learning_rate, str):
+        if cfg.learning_rate != "auto":
+            raise ValueError(
+                f"learning_rate must be a float or 'auto', got "
+                f"{cfg.learning_rate!r}")
+        return max(n / cfg.early_exaggeration, 50.0)
+    return float(cfg.learning_rate)
 
 
 def _binary_search_betas(d2: np.ndarray, perplexity: float,
@@ -140,7 +158,7 @@ class Tsne:
             key, (x.shape[0], cfg.n_components), jnp.float32)
         y, kl = _exact_loop(
             p, y0, cfg.max_iter, cfg.exaggeration_iters,
-            cfg.momentum_switch_iter, cfg.learning_rate,
+            cfg.momentum_switch_iter, _resolve_lr(cfg, x.shape[0]),
             cfg.early_exaggeration, cfg.momentum_initial,
             cfg.momentum_final)
         self.kl_ = float(kl)
@@ -166,6 +184,7 @@ class BarnesHutTsne:
         vals = np.take_along_axis(p_full, cols, axis=1)
         vals /= max(vals.sum(), 1e-12)
 
+        lr = _resolve_lr(cfg, n)
         rng = np.random.RandomState(cfg.seed)
         y = 1e-4 * rng.randn(n, cfg.n_components)
         vel = np.zeros_like(y)
@@ -198,7 +217,7 @@ class BarnesHutTsne:
             same = np.sign(g) == np.sign(vel)
             gains = np.clip(np.where(same, gains * 0.8, gains + 0.2),
                             0.01, None)
-            vel = mom * vel - cfg.learning_rate * gains * g
+            vel = mom * vel - lr * gains * g
             y = y + vel
             y -= y.mean(axis=0, keepdims=True)
         return y

@@ -20,20 +20,75 @@ params, BERT TrainState, optax states — any pytree.
 from __future__ import annotations
 
 import glob
-import io
 import json
+import logging
 import os
+import queue
 import re
+import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+log = logging.getLogger(__name__)
+
 PyTree = Any
 
 _SEP = "/"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed checksum verification (or its files are
+    truncated/unreadable).  ``CheckpointManager.restore(step=None)``
+    catches this and falls back to the previous good step; an explicit
+    ``step=`` request surfaces it to the caller."""
+
+
+class StructureMismatchError(ValueError):
+    """The ``like`` template's flatten order doesn't match the saved
+    paths — a CALLER bug (renamed layer, wrong conf), not disk
+    corruption.  ``restore()``'s fallback walk re-raises it immediately
+    instead of "failing" every step in the directory."""
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
+    """(crc32, size_bytes) of a file, streamed."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+            size += len(buf)
+    return crc & 0xFFFFFFFF, size
+
+
+def _replace_with_fsync(tmp: str, dst: str) -> None:
+    """fsync(tmp), atomically rename it into place, then fsync the
+    parent DIRECTORY.  The file fsync is the crash-safety half
+    ``os.replace`` alone lacks (a rename can hit the journal before
+    the data blocks do, leaving a correctly-named but truncated file
+    after power loss); the directory fsync makes the rename ITSELF
+    durable — the rename is the commit, and without it a power loss
+    right after save() returns can lose the directory entry for a
+    snapshot the driver already reported committed."""
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst)
+    dfd = os.open(os.path.dirname(os.path.abspath(dst)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def _flatten_with_paths(tree: PyTree) -> List[Tuple[str, Any]]:
@@ -54,25 +109,49 @@ def _flatten_with_paths(tree: PyTree) -> List[Tuple[str, Any]]:
     return out
 
 
-def save_pytree(path: str, tree: PyTree, meta: Optional[Dict] = None) -> None:
-    """Write ``path`` (.npz) + ``path + '.json'`` (paths/meta)."""
+def save_pytree(path: str, tree: PyTree,
+                meta: Optional[Dict] = None) -> Dict[str, Dict]:
+    """Write ``path`` (.npz) + ``path + '.json'`` (paths/meta).
+
+    Both files go through tmp-file + fsync + ``os.replace``, sidecar
+    FIRST and the ``.npz`` LAST — the step becomes visible (globs key on
+    the ``.npz``) only once every byte of both files is durably on
+    disk, so a crash at any point leaves either the complete previous
+    state or an invisible partial one, never a truncated checkpoint a
+    restore would happily load.  Returns ``{filename: {"crc32", "bytes"}}``
+    for the two files — the manifest input ``CheckpointManager`` commits
+    alongside."""
     items = _flatten_with_paths(tree)
     arrays = {f"a{i}": np.asarray(jax.device_get(leaf))
               for i, (_, leaf) in enumerate(items)}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-    os.replace(tmp, path)
+
+    def commit(write_fn, dst: str) -> Dict[str, int]:
+        # stream into the tmp file, then crc it with one sequential
+        # re-read before the replace: np.savez's zipfile seeks back
+        # into the archive while writing, so a crc cannot ride along
+        # the stream — and buffering the whole serialized archive in
+        # memory instead would add a checkpoint-sized allocation per
+        # save (x max_in_flight on the async writer), exactly the host
+        # RAM the pod-scale path cannot spare.  The just-written bytes
+        # are page-cache-warm, so the re-read is cheap.
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            write_fn(f)
+        crc, size = _crc32_file(tmp)
+        _replace_with_fsync(tmp, dst)
+        return {"crc32": crc, "bytes": size}
+
     sidecar = {
         "paths": [p for p, _ in items],
         "meta": meta or {},
         "format": 1,
     }
-    side_tmp = path + ".json.tmp"
-    with open(side_tmp, "w") as f:
-        json.dump(sidecar, f, indent=1)
-    os.replace(side_tmp, path + ".json")
+    side_json = json.dumps(sidecar, indent=1).encode()
+    side_entry = commit(lambda f: f.write(side_json), path + ".json")
+    npz_entry = commit(lambda f: np.savez(f, **arrays), path)
+    return {os.path.basename(path): npz_entry,
+            os.path.basename(path) + ".json": side_entry}
 
 
 def load_pytree(path: str, like: Optional[PyTree] = None
@@ -88,7 +167,7 @@ def load_pytree(path: str, like: Optional[PyTree] = None
     if like is not None:
         tpl_items = _flatten_with_paths(like)
         if [p for p, _ in tpl_items] != sidecar["paths"]:
-            raise ValueError(
+            raise StructureMismatchError(
                 "checkpoint structure mismatch:\n saved: "
                 f"{sidecar['paths'][:5]}...\n template: "
                 f"{[p for p, _ in tpl_items][:5]}...")
@@ -264,7 +343,7 @@ def load_pytree_sharded(path: str, like: Optional[PyTree] = None
 
     tpl_items = _flatten_with_paths(like)
     if [p for p, _ in tpl_items] != paths:
-        raise ValueError(
+        raise StructureMismatchError(
             "checkpoint structure mismatch:\n saved: "
             f"{paths[:5]}...\n template: "
             f"{[p for p, _ in tpl_items][:5]}...")
@@ -289,7 +368,18 @@ def load_pytree_sharded(path: str, like: Optional[PyTree] = None
 
 class CheckpointManager:
     """Rolling checkpoints: ``<dir>/ckpt_<step>.npz`` keeping the newest
-    ``max_to_keep`` (ModelSavingActor-per-round + retention parity)."""
+    ``max_to_keep`` (ModelSavingActor-per-round + retention parity).
+
+    Crash-safe commit protocol: the ``.npz``/sidecar pair lands via
+    tmp-file + fsync + ``os.replace`` (``save_pytree``), then a
+    ``ckpt_<step>.npz.manifest.json`` holding a per-file crc32 table is
+    replaced into place LAST — the manifest is the commit marker.
+    ``restore()`` (no explicit step) verifies the newest step's
+    checksums and silently falls back to the previous good step when
+    the newest is corrupt or uncommitted (a kill mid-save must cost one
+    checkpoint cadence, never the run); ``restore(step=K)`` verifies
+    and RAISES :class:`CorruptCheckpointError` instead — the caller
+    asked for that exact state."""
 
     _PAT = re.compile(r"ckpt_(\d+)\.npz$")
 
@@ -297,9 +387,26 @@ class CheckpointManager:
         self.directory = directory
         self.max_to_keep = max_to_keep
         os.makedirs(directory, exist_ok=True)
+        # crash recovery: a kill mid-save leaves ckpt_N.*.tmp behind,
+        # and if step N is never saved again nothing else removes it —
+        # in the preemption-heavy regime repeated kills would
+        # accumulate checkpoint-sized orphans until the volume fills.
+        # Manager construction (process start) is before any writer of
+        # OURS runs, and the fresh-run/populated-dir refusal plus the
+        # step-keyed file names make a concurrent foreign writer a
+        # non-supported layout anyway.
+        for f in glob.glob(os.path.join(directory, "ckpt_*.tmp")):
+            try:
+                os.remove(f)
+                log.info("swept orphaned checkpoint tmp file %s", f)
+            except OSError:
+                pass
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt_{step}.npz")
+
+    def _manifest_path(self, step: int) -> str:
+        return self._path(step) + ".manifest.json"
 
     def all_steps(self) -> List[int]:
         steps = []
@@ -313,30 +420,329 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, tree: PyTree,
-             meta: Optional[Dict] = None) -> str:
+    def save(self, step: int, tree: PyTree, meta: Optional[Dict] = None,
+             *, _t_req: Optional[float] = None,
+             _was_async: bool = False) -> str:
+        """Save + commit (manifest included).  The async path
+        (:class:`AsyncCheckpointer`) routes through here on its writer
+        thread, so there is exactly ONE commit protocol; the private
+        kwargs carry its request timestamp for write-behind-lag
+        accounting."""
+        from deeplearning4j_tpu.runtime.metrics import checkpoint_metrics
+
+        t0 = time.perf_counter()
         meta = dict(meta or {})
         meta.update({"step": step, "time": time.time()})
         path = self._path(step)
-        save_pytree(path, tree, meta)
+        files = save_pytree(path, tree, meta)
+        manifest = {"format": 1, "step": step, "files": files}
+        man_tmp = self._manifest_path(step) + ".tmp"
+        with open(man_tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        _replace_with_fsync(man_tmp, self._manifest_path(step))
         self._gc()
+        now = time.perf_counter()
+        if not _was_async:
+            checkpoint_metrics.note("saves_sync")
+        checkpoint_metrics.note_committed(
+            sum(v["bytes"] for v in files.values()),
+            (now - t0) * 1e3,
+            (now - (_t_req if _t_req is not None else t0)) * 1e3,
+            was_async=_was_async)
         return path
+
+    def verify(self, step: int) -> None:
+        """Raise :class:`CorruptCheckpointError` unless ``step``'s files
+        match its committed manifest.  A missing manifest on an
+        EXISTING ``.npz`` means the commit never completed (crash
+        mid-save) — equally refusable.  Pre-manifest legacy checkpoints
+        (written before this protocol) are indistinguishable from the
+        crashed case by design: durability beats convenience here, and
+        ``load_pytree`` still opens them directly if a caller must."""
+        from deeplearning4j_tpu.runtime.metrics import checkpoint_metrics
+
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            raise CorruptCheckpointError(
+                f"checkpoint step {step} in {self.directory} has no "
+                "manifest — uncommitted (crash mid-save?) or pre-manifest")
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            for fname, want in manifest["files"].items():
+                crc, size = _crc32_file(
+                    os.path.join(self.directory, fname))
+                if crc != want["crc32"] or size != want["bytes"]:
+                    raise CorruptCheckpointError(
+                        f"checkpoint file {fname} fails its manifest "
+                        f"checksum (got crc32={crc}/{size}B, manifest "
+                        f"says {want['crc32']}/{want['bytes']}B)")
+        except CorruptCheckpointError:
+            checkpoint_metrics.note("checksum_failures")
+            raise
+        except Exception as e:   # unreadable manifest / missing file
+            checkpoint_metrics.note("checksum_failures")
+            raise CorruptCheckpointError(
+                f"checkpoint step {step} unverifiable: "
+                f"{type(e).__name__}: {e}") from e
 
     def restore(self, step: Optional[int] = None,
                 like: Optional[PyTree] = None) -> Tuple[PyTree, Dict]:
-        step = self.latest_step() if step is None else step
-        if step is None:
+        from deeplearning4j_tpu.runtime.metrics import checkpoint_metrics
+
+        if step is not None:
+            if os.path.exists(self._manifest_path(step)):
+                self.verify(step)
+            # legacy pre-manifest checkpoint: load directly (load errors
+            # surface as-is — an explicit step never falls back)
+            return load_pytree(self._path(step), like)
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        return load_pytree(self._path(step), like)
+        # COMMITTED (manifest-bearing) steps outrank manifest-less ones:
+        # a missing manifest on the newest step is the crash-mid-save
+        # signature, and its data must not shadow an older verified
+        # state.  Manifest-less steps still restore when nothing
+        # committed exists (pre-manifest legacy directories).
+        desc = steps[::-1]
+        committed = [s for s in desc
+                     if os.path.exists(self._manifest_path(s))]
+        legacy = [s for s in desc
+                  if not os.path.exists(self._manifest_path(s))]
+        last_err: Optional[Exception] = None
+        for s in committed + legacy:
+            try:
+                if os.path.exists(self._manifest_path(s)):
+                    self.verify(s)
+                out = load_pytree(self._path(s), like)
+                if s != desc[0]:
+                    checkpoint_metrics.note("restore_fallbacks")
+                    log.warning(
+                        "restored checkpoint step %d (newer step(s) "
+                        "%s corrupt or uncommitted) in %s", s,
+                        [x for x in desc if x > s], self.directory)
+                return out
+            except Exception as e:  # noqa: BLE001 — corrupt files throw
+                #                     anything (zip, json, ValueError)
+                if isinstance(e, StructureMismatchError):
+                    # wrong `like` template (a caller bug, e.g. a
+                    # renamed layer): every step on disk would fail
+                    # identically — surface load_pytree's descriptive
+                    # error instead of walking the whole directory and
+                    # mislabeling it disk corruption
+                    raise
+                last_err = e
+                log.warning("checkpoint step %d unrestorable (%s: %s); "
+                            "falling back", s, type(e).__name__, e)
+        raise CorruptCheckpointError(
+            f"no restorable checkpoint in {self.directory} "
+            f"(tried steps {desc})") from last_err
 
     def _gc(self) -> None:
+        """Retention sweep.  Tolerates concurrently-deleted files — a
+        second process (or the async writer racing a final sync save)
+        may have removed a step between the glob and the unlink."""
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep] if self.max_to_keep > 0 else []:
-            for suffix in ("", ".json"):
+            for suffix in (".manifest.json", ".json", ""):
                 try:
                     os.remove(self._path(s) + suffix)
                 except OSError:
                     pass
+
+
+class SnapshotHandle:
+    """Future-like handle for one in-flight async snapshot."""
+
+    def __init__(self, step: int):
+        self.step = step
+        self.path: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        """Block until committed; returns the checkpoint path or raises
+        the writer-side error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"snapshot for step {self.step} not committed within "
+                f"{timeout}s")
+        if self.error is not None:
+            raise self.error
+        assert self.path is not None
+        return self.path
+
+
+class AsyncCheckpointer:
+    """Background snapshots: fork the device->host copy off the training
+    step, serialize + fsync + commit on a writer thread.
+
+    The training thread pays only :meth:`save`'s staging cost — a
+    device-side ``jnp.copy`` per leaf (donation safety: the NEXT step
+    donates the live buffers, so the snapshot must own independent
+    ones; the copy is submitted async and overlaps compute) plus a
+    ``copy_to_host_async`` hint so the D2H transfer runs behind the
+    step too.  The blocking materialization, ``np.savez``, fsync, and
+    manifest commit all happen on the writer thread through
+    ``CheckpointManager.save`` — ONE commit protocol for sync and
+    async paths.
+
+    In-flight snapshots are bounded by ``max_in_flight``: a save
+    request finding the bound exhausted BLOCKS (backpressure — the
+    training loop stalls rather than queueing unbounded device copies;
+    ``checkpoint_metrics.backpressure_waits`` counts it).  Writer-side
+    failures are kept on the per-snapshot handle AND re-raised by the
+    next :meth:`wait_until_finished` — a run whose checkpoints silently
+    stopped committing has no preemption story left, so the driver must
+    hear about it."""
+
+    def __init__(self, manager: CheckpointManager, max_in_flight: int = 2):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        self.manager = manager
+        self.max_in_flight = max_in_flight
+        self._sem = threading.BoundedSemaphore(max_in_flight)
+        self._q: "queue.Queue" = queue.Queue()
+        self._pending: List[SnapshotHandle] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- staging (training thread) ------------------------------------------
+    @staticmethod
+    def _stage(tree: PyTree) -> Tuple[PyTree, int]:
+        """Decouple the snapshot from live buffers: device arrays get an
+        independent device-side copy (+ async D2H start), host arrays a
+        host copy.  Returns (staged_tree, nbytes)."""
+        nbytes = [0]
+
+        def one(leaf):
+            if isinstance(leaf, jax.Array):
+                c = jnp.copy(leaf)
+                try:
+                    c.copy_to_host_async()
+                except Exception:   # noqa: BLE001 — backend-optional hint
+                    pass
+                nbytes[0] += c.size * c.dtype.itemsize
+                return c
+            if isinstance(leaf, np.ndarray):
+                c = np.array(leaf)
+                nbytes[0] += c.nbytes
+                return c
+            return leaf
+        return jax.tree.map(one, tree), nbytes[0]
+
+    def save(self, step: int, tree: PyTree,
+             meta: Optional[Dict] = None) -> SnapshotHandle:
+        from deeplearning4j_tpu.runtime.metrics import checkpoint_metrics
+
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        t_req = time.perf_counter()
+        if not self._sem.acquire(blocking=False):
+            checkpoint_metrics.note("backpressure_waits")
+            self._sem.acquire()
+        try:
+            staged, nbytes = self._stage(tree)
+        except BaseException:
+            # a failed staging copy (e.g. device OOM) never reaches the
+            # writer's release — give the permit back or every later
+            # save() deadlocks once max_in_flight such failures accrue
+            self._sem.release()
+            raise
+        checkpoint_metrics.note_staged(
+            nbytes, (time.perf_counter() - t_req) * 1e3)
+        handle = SnapshotHandle(step)
+        with self._lock:
+            self._pending.append(handle)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._writer, name="ckpt-writer", daemon=True)
+                self._thread.start()
+        self._q.put((handle, staged, meta, t_req))
+        return handle
+
+    # -- writer thread ------------------------------------------------------
+    def _writer(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            handle, staged, meta, t_req = job
+            try:
+                handle.path = self.manager.save(
+                    handle.step, staged, meta,
+                    _t_req=t_req, _was_async=True)
+            except BaseException as e:  # noqa: BLE001 — kept on handle
+                from deeplearning4j_tpu.runtime.metrics import (
+                    checkpoint_metrics)
+                handle.error = e
+                # the failed snapshot is no longer pending — only
+                # note_committed decrements the gauge otherwise
+                checkpoint_metrics.note_commit_failed()
+                log.error("async checkpoint for step %d failed: %s: %s",
+                          handle.step, type(e).__name__, e)
+            finally:
+                del staged
+                self._sem.release()
+                handle._done.set()
+
+    # -- synchronization ----------------------------------------------------
+    def wait_until_finished(self, timeout: Optional[float] = None) -> None:
+        """Block until every requested snapshot is committed; raises the
+        first writer-side error seen (each error raises once).
+        ``timeout`` is an OVERALL deadline across all pending snapshots —
+        a preemption-grace-window caller sizing it to the window must
+        not overrun by a factor of ``max_in_flight``."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._lock:
+            pending, self._pending = self._pending, []
+        err: Optional[BaseException] = None
+        for h in pending:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not h._done.wait(remaining):
+                with self._lock:
+                    # re-queue the unfinished AND the errored handles —
+                    # raising TimeoutError here must not swallow a
+                    # writer error already seen; it raises next call
+                    self._pending.extend(
+                        x for x in pending
+                        if not x.done() or x.error is not None)
+                raise TimeoutError(
+                    f"snapshot for step {h.step} not committed within "
+                    f"{timeout}s")
+            if err is None and h.error is not None:
+                err = h.error
+        if err is not None:
+            raise err
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain and stop the writer thread (idempotent).  The writer
+        stops even when the drain raises (a failed commit, a timeout) —
+        the error propagates, but an abandoned checkpointer must not
+        leak a thread parked on its queue (plus every staged pytree
+        still queued behind it)."""
+        if self._closed:
+            return
+        try:
+            self.wait_until_finished(timeout)
+        finally:
+            self._closed = True
+            if self._thread is not None:
+                self._q.put(None)
+                self._thread.join(timeout)
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 class ModelSaver:

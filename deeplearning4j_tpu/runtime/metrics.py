@@ -442,6 +442,126 @@ class DataParallelMetrics:
 #: process-wide singleton the sharded fit paths + ingestion stage report into
 dp_metrics = DataParallelMetrics()
 
+
+class CheckpointMetrics:
+    """Process-wide counters for the async/elastic checkpoint layer
+    (runtime/checkpoint.py ``AsyncCheckpointer`` + ``CheckpointManager``
+    and the preemption/elastic machinery in runtime/resilience.py):
+
+    - ``saves_async`` / ``saves_sync``: snapshots requested through the
+      background writer vs written synchronously on the caller's thread;
+    - ``snapshots_committed``: checkpoints whose manifest hit disk — the
+      crash-safe commit point (``bytes_written`` / ``write_ms`` are the
+      writer-side serialization+fsync cost, off the training thread);
+    - ``in_flight`` / ``max_in_flight``: snapshots staged but not yet
+      committed (live gauge + high-water) — bounded by the
+      AsyncCheckpointer's backpressure semaphore;
+    - ``bytes_staged`` / ``stage_ms``: device->host snapshot forking cost
+      the TRAINING thread actually pays (device-side copy + async D2H
+      submission; the blocking materialization happens on the writer);
+    - ``write_behind_lag_ms``: request-to-commit latency of the most
+      recent committed snapshot (how far the disk state trails the run);
+    - ``backpressure_waits``: save requests that found ``max_in_flight``
+      snapshots pending and had to block;
+    - ``checksum_failures`` / ``restore_fallbacks``: manifest
+      verification failures and restores that fell back to an older
+      committed step because the newest was corrupt/uncommitted;
+    - ``preemptions_requested`` / ``preemption_snapshots``: SIGTERM/
+      SIGINT drills observed by a PreemptionGuard and the final
+      boundary snapshots they produced;
+    - ``device_losses`` / ``elastic_resumes``: device-loss faults seen
+      and successful re-mesh-and-restore recoveries.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.saves_async = 0
+            self.saves_sync = 0
+            self.snapshots_committed = 0
+            self.bytes_written = 0
+            self.write_ms = 0.0
+            self.in_flight = 0
+            self.max_in_flight = 0
+            self.bytes_staged = 0
+            self.stage_ms = 0.0
+            self.write_behind_lag_ms = 0.0
+            self.backpressure_waits = 0
+            self.checksum_failures = 0
+            self.restore_fallbacks = 0
+            self.preemptions_requested = 0
+            self.preemption_snapshots = 0
+            self.device_losses = 0
+            self.elastic_resumes = 0
+
+    def note_staged(self, nbytes: int, ms: float) -> None:
+        """Async staging cost (training-thread side).  Sync saves never
+        stage — ``CheckpointManager.save`` books them directly via
+        ``note("saves_sync")`` + :meth:`note_committed`."""
+        with self._lock:
+            self.bytes_staged += int(nbytes)
+            self.stage_ms += ms
+            self.saves_async += 1
+            self.in_flight += 1
+            self.max_in_flight = max(self.max_in_flight, self.in_flight)
+
+    def note_commit_failed(self) -> None:
+        """An async snapshot's writer-side save raised: it is no longer
+        pending, so the in-flight gauge must come down even though no
+        commit happened."""
+        with self._lock:
+            self.in_flight = max(0, self.in_flight - 1)
+
+    def note_committed(self, nbytes: int, write_ms: float,
+                       lag_ms: float, *, was_async: bool) -> None:
+        with self._lock:
+            self.snapshots_committed += 1
+            self.bytes_written += int(nbytes)
+            self.write_ms += write_ms
+            self.write_behind_lag_ms = round(lag_ms, 3)
+            if was_async:
+                self.in_flight = max(0, self.in_flight - 1)
+
+    def note(self, key: str, by: int = 1) -> None:
+        """Bump a plain counter field by name (backpressure_waits,
+        checksum_failures, restore_fallbacks, preemptions_requested,
+        preemption_snapshots, device_losses, elastic_resumes)."""
+        with self._lock:
+            setattr(self, key, getattr(self, key) + by)
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return getattr(self, key)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "saves_async": self.saves_async,
+                "saves_sync": self.saves_sync,
+                "snapshots_committed": self.snapshots_committed,
+                "bytes_written": self.bytes_written,
+                "write_ms": round(self.write_ms, 3),
+                "in_flight": self.in_flight,
+                "max_in_flight": self.max_in_flight,
+                "bytes_staged": self.bytes_staged,
+                "stage_ms": round(self.stage_ms, 3),
+                "write_behind_lag_ms": self.write_behind_lag_ms,
+                "backpressure_waits": self.backpressure_waits,
+                "checksum_failures": self.checksum_failures,
+                "restore_fallbacks": self.restore_fallbacks,
+                "preemptions_requested": self.preemptions_requested,
+                "preemption_snapshots": self.preemption_snapshots,
+                "device_losses": self.device_losses,
+                "elastic_resumes": self.elastic_resumes,
+            }
+
+
+#: process-wide singleton the checkpoint/preemption/elastic layer reports into
+checkpoint_metrics = CheckpointMetrics()
+
 def device_memory_stats() -> Dict[str, Any]:
     """Per-device HBM usage where the backend reports it.
 

@@ -40,9 +40,12 @@ fault-handling evidence.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
+import signal
 import statistics
+import threading
 import time
 from typing import Any, Deque, List, Optional, Sequence
 
@@ -51,12 +54,196 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.runtime import compile_cache, telemetry
-from deeplearning4j_tpu.runtime.checkpoint import CheckpointManager
-from deeplearning4j_tpu.runtime.metrics import resilience_metrics
+from deeplearning4j_tpu.runtime.checkpoint import (AsyncCheckpointer,
+                                                   CheckpointManager)
+from deeplearning4j_tpu.runtime.metrics import (checkpoint_metrics,
+                                                resilience_metrics)
 
 log = logging.getLogger(__name__)
 
 PyTree = Any
+
+
+class DeviceLossError(RuntimeError):
+    """A device (or slice) dropped out of the mesh mid-run.  Defined
+    here (not in ``parallel/chaos.py``, which re-exports it) so the
+    driver can catch it without importing the chaos/scaleout stack —
+    that import path leads back into this module.  ``lost_ids`` names
+    the failed devices; ``ResilientFit`` re-meshes over the survivors
+    (``parallel.mesh.elastic_remesh``) and resumes from the last
+    committed snapshot."""
+
+    def __init__(self, lost_ids, message: Optional[str] = None):
+        self.lost_ids = tuple(int(i) for i in lost_ids)
+        super().__init__(
+            message or f"device loss: ids {sorted(self.lost_ids)}")
+
+
+# ---------------------------------------------------------------------------
+# Preemption guard (SIGTERM/SIGINT -> final snapshot at a step boundary)
+# ---------------------------------------------------------------------------
+
+_GUARD_LOCK = threading.Lock()
+_ACTIVE_GUARD: Optional["PreemptionGuard"] = None
+
+
+def preemption_requested() -> bool:
+    """One-global-read check the streaming fit loops poll at every step
+    boundary: True when an installed :class:`PreemptionGuard` has seen
+    a preemption signal (or a programmatic :meth:`PreemptionGuard
+    .request`).  False when no guard is installed — plain fits keep
+    their exact semantics."""
+    g = _ACTIVE_GUARD
+    return g is not None and g.requested()
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT-driven preemption flag.
+
+    Cloud preemption is a NOTICE, not a kill: the maintenance event
+    delivers a signal and a grace window (arXiv 2605.25645's operating
+    regime).  The handler only sets a flag — async-signal-safe by
+    construction — and the training driver acts on it at the next STEP
+    BOUNDARY: drain in-flight snapshots, write one final synchronous
+    checkpoint, and return cleanly so the process exits 0 and a fresh
+    process resumes with ``ResilienceConfig(resume=True)``.
+
+    Use as a context manager (``ResilientFit.fit`` installs one around
+    the loop when none is passed in).  Previous handlers are restored
+    on exit; installation from a non-main thread — where Python forbids
+    ``signal.signal`` — degrades to the programmatic :meth:`request`
+    path instead of failing the fit.  A SECOND delivery of a guarded
+    signal while the flag is already set restores the previous handler
+    and re-raises — the graceful path is evidently stuck, and the run
+    must stay killable without resorting to SIGKILL."""
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,
+                                                 signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._requested = threading.Event()
+        self._old: dict = {}
+        self._installed = False
+        self._prev_active: Optional["PreemptionGuard"] = None
+        self._depth = 0
+        self._booked = False
+        self._book_lock = threading.Lock()
+
+    def request(self) -> None:
+        """Flag a preemption (the handler's body; also the programmatic
+        drill hook ``parallel.chaos.PreemptionChaos`` uses).
+
+        The ONLY effect here is ``Event.set()``.  Metric/telemetry/log
+        booking is deferred to :meth:`requested` because this body runs
+        inside the SIGTERM/SIGINT handler: the metrics registry, the
+        tracer, and the logging module all take non-reentrant locks, and
+        the signal can land while the interrupted thread already holds
+        one (e.g. mid ``note_staged``) — re-acquiring it from the
+        handler would deadlock the process inside its grace window."""
+        self._requested.set()
+
+    def requested(self) -> bool:
+        r = self._requested.is_set()
+        if r and not self._booked:
+            # first observation, regular thread context — locks are
+            # safe here, and every consumer (the fit loops, the module
+            # check) routes through this method
+            with self._book_lock:
+                if not self._booked:
+                    self._booked = True
+                    checkpoint_metrics.note("preemptions_requested")
+                    telemetry.event("resilience.preemption_requested")
+                    log.warning("preemption requested — will snapshot "
+                                "and stop at the next step boundary")
+        return r
+
+    def _handler(self, signum, frame) -> None:
+        if self._requested.is_set():
+            # second delivery: the graceful exit is evidently stuck
+            # (wedged writer drain, hung dispatch) — hand the signal
+            # back so the process stays killable instead of swallowing
+            # every further Ctrl-C/SIGTERM behind the already-set flag.
+            # Restoring the pre-guard handler and re-raising gives the
+            # default action (SIGTERM kills, SIGINT raises
+            # KeyboardInterrupt).  No locks here: handler context.
+            prev = self._old.get(signum)
+            try:
+                signal.signal(signum, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, TypeError):
+                signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self.request()
+
+    def __enter__(self) -> "PreemptionGuard":
+        global _ACTIVE_GUARD
+        with _GUARD_LOCK:
+            self._depth += 1
+            if self._depth > 1 and self._installed:
+                # reentrant install: a caller-held guard handed back in
+                # (ResilientFit.fit wraps its loop in `with guard:`
+                # unconditionally).  Already live — re-registering would
+                # capture OUR handler as the "previous" one and lose the
+                # process originals on the way out.
+                return self
+            # depth > 1 but NOT installed: a shared guard first entered
+            # from a worker thread (where signal.signal is forbidden)
+            # degraded to programmatic-only — this entry may be the
+            # first on the MAIN thread, i.e. the first that can
+            # actually own the handlers.  Fall through and try again
+            # rather than silently leaving this fit unguarded.
+        with _GUARD_LOCK:
+            if not self._installed:
+                try:
+                    for s in self.signals:
+                        self._old[s] = signal.signal(s, self._handler)
+                    self._installed = True
+                except ValueError:
+                    # non-main thread: signal delivery can't reach us;
+                    # the request() path still works
+                    self._old = {}
+                    self._installed = False
+            if _ACTIVE_GUARD is not self:
+                self._prev_active = _ACTIVE_GUARD
+                _ACTIVE_GUARD = self
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        global _ACTIVE_GUARD
+        with _GUARD_LOCK:
+            self._depth -= 1
+            if self._depth > 0:
+                return False    # outermost enter owns the teardown
+        if self._installed:
+            for s, h in self._old.items():
+                try:
+                    signal.signal(s, h)
+                except ValueError:
+                    # final exit on a non-main thread (overlapped
+                    # shared-guard usage where the main thread
+                    # installed): Python forbids restoring from here —
+                    # the handlers stay until the process exits, a
+                    # strictly safer leak than an unguarded fit
+                    pass
+            self._old = {}
+            self._installed = False
+        with _GUARD_LOCK:
+            if _ACTIVE_GUARD is self:
+                _ACTIVE_GUARD = self._prev_active
+            else:
+                # non-LIFO overlap (two concurrent fits on different
+                # threads, each with its own guard): blindly restoring
+                # our predecessor would hide the still-live newer guard
+                # — or resurrect a dead one whose set flag silently
+                # stops every later fit at batch 0.  Splice self out of
+                # the chain instead.
+                g = _ACTIVE_GUARD
+                while g is not None and g._prev_active is not self:
+                    g = g._prev_active
+                if g is not None:
+                    g._prev_active = self._prev_active
+            self._prev_active = None
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +422,14 @@ class ResilienceConfig:
     invocation runs before checkpointing and returning (bounded-slice
     training for preemptible capacity).  ``shuffle`` derives a
     deterministic per-epoch batch order from the run key — which the
-    rollback path re-folds, so a retry sees different batch order."""
+    rollback path re-folds, so a retry sees different batch order.
+
+    Cadence snapshots are ASYNC by default (``checkpoint.
+    AsyncCheckpointer``: device->host copy forked off the step,
+    serialization + commit on a writer thread, at most
+    ``max_in_flight`` snapshots pending with backpressure);
+    ``sync=True`` is the escape hatch back to blocking on-thread saves
+    (MIGRATION.md)."""
 
     checkpoint_dir: str
     checkpoint_every: int = 50
@@ -249,6 +443,21 @@ class ResilienceConfig:
     resume: bool = False
     max_steps: Optional[int] = None
     shuffle: bool = True
+    sync: bool = False
+    max_in_flight: int = 2
+
+    def __post_init__(self) -> None:
+        # fail at construction, not one `step % checkpoint_every` into
+        # a paid-for fit; 0 is a natural misspelling of "no cadence
+        # snapshots", which isn't a mode the driver offers (the
+        # rollback/resume machinery needs at least the cadence saves)
+        if self.checkpoint_every <= 0:
+            raise ValueError(
+                f"checkpoint_every must be a positive step count, "
+                f"got {self.checkpoint_every}")
+        if self.max_in_flight < 1:
+            raise ValueError(
+                f"max_in_flight must be >= 1, got {self.max_in_flight}")
 
 
 class ResilientFit:
@@ -272,22 +481,96 @@ class ResilientFit:
     diverge — checkpoints, rollback, and resume are unchanged host
     policy on top (resume is step-for-step equivalent to an
     uninterrupted sharded run; tested).  Default None keeps the
-    single-device step byte-for-byte as before."""
+    single-device step byte-for-byte as before.
+
+    Robustness upgrades (ROADMAP item 4):
+
+    - cadence snapshots run through an :class:`AsyncCheckpointer` by
+      default (``config.sync=True`` opts out) — the step never waits
+      for host I/O, in-flight snapshots are bounded, and every commit
+      is crash-safe (manifest protocol);
+    - a :class:`PreemptionGuard` is installed for the duration of the
+      fit (pass ``preemption_guard=`` to share one across drivers):
+      on SIGTERM/SIGINT the loop stops at the next step boundary,
+      drains in-flight snapshots, writes one final SYNC snapshot, and
+      returns cleanly with ``self.preempted = True``;
+    - a :class:`DeviceLossError` — raised by an injected
+      ``fault_hook(step)`` (``parallel.chaos.DeviceLossChaos``) or by
+      caller code that translates a platform-specific backend failure
+      into one (the repo ships no such translation; identifying the
+      lost device ids is runtime-specific) — triggers ELASTIC resume:
+      re-mesh over the surviving devices
+      with ``grad_accum`` scaled to preserve the effective batch
+      (``parallel.mesh.elastic_remesh`` — bit-exact vs the
+      uninterrupted run), restore the last committed snapshot, and
+      continue."""
 
     def __init__(self, net, config: ResilienceConfig,
                  detector: Optional[LossSpikeDetector] = None,
-                 mesh=None):
+                 mesh=None, fault_hook=None,
+                 preemption_guard: Optional[PreemptionGuard] = None):
         self.net = net
         self.mesh = mesh
         self.config = config
+        self.fault_hook = fault_hook
+        self.preemption_guard = preemption_guard
         self.manager = CheckpointManager(config.checkpoint_dir,
                                          max_to_keep=config.max_to_keep)
+        self.async_ckpt = None if config.sync else AsyncCheckpointer(
+            self.manager, max_in_flight=config.max_in_flight)
         self.detector = detector or LossSpikeDetector(
             window=config.spike_window, factor=config.spike_factor,
             patience=config.patience, min_history=config.min_history)
-        #: filled by fit(): total steps run, rollbacks performed
+        #: filled by fit(): total steps run, rollbacks performed,
+        #: preemption flag, elastic re-mesh count
         self.steps_run = 0
         self.rollbacks = 0
+        self.preempted = False
+        self.remeshes = 0
+        #: driver-scoped grad_accum override set by elastic resume —
+        #: the user's conf object is never left mutated
+        self.elastic_accum: Optional[int] = None
+
+    def _recycle_writer(self, suppress_errors: bool) -> None:
+        """close() the async checkpointer — drain (committing every
+        queued snapshot) and stop the writer thread — then stand up a
+        fresh one so a later ``fit(resume=True)`` on this driver works.
+        ``suppress_errors`` is the error-exit mode: an exception is
+        already propagating out of fit(), so a drain failure here must
+        be logged, never raised over the original error."""
+        if self.async_ckpt is None:
+            return
+        try:
+            self.async_ckpt.close()
+        except Exception:
+            if not suppress_errors:
+                raise
+            log.exception("checkpoint writer shutdown failed while "
+                          "handling a fit error")
+        finally:
+            self.async_ckpt = AsyncCheckpointer(
+                self.manager, max_in_flight=self.config.max_in_flight)
+
+    @contextlib.contextmanager
+    def _writer_guard(self):
+        """Error exits out of the fit loop (RetryBudgetExceeded, a
+        poisoned restore, a single-device device loss, ...) must not
+        strand queued async snapshots uncommitted or leak the writer
+        thread parked on its queue — MIGRATION.md promises every
+        requested snapshot is committed before fit returns, raised or
+        not."""
+        try:
+            yield
+        except BaseException:
+            self._recycle_writer(suppress_errors=True)
+            raise
+
+    def _drain(self) -> None:
+        """Wait for every in-flight async snapshot to COMMIT — the
+        precondition for any restore (rollback, elastic resume) and for
+        the final/preemption snapshot's ordering guarantee."""
+        if self.async_ckpt is not None:
+            self.async_ckpt.wait_until_finished()
 
     @staticmethod
     def _check_restored(params: PyTree, at_step) -> None:
@@ -321,10 +604,112 @@ class ResilientFit:
                                 for i in jax.random.permutation(k, n_batches)]
         return self._order_memo
 
+    # -- machinery ---------------------------------------------------------
+    def _build_dispatch(self, net):
+        """(dispatch, updaters) for the CURRENT ``self.mesh`` and
+        effective grad_accum — rebuilt by the elastic-resume path after
+        a re-mesh (new mesh signature + conf JSON = a fresh engine
+        entry, never a cross-mesh cache hit).  The driver's
+        ``elastic_accum`` override applies only for the build's
+        duration: the accum is baked into the compiled step via the
+        conf, but the USER's configuration object is never left
+        mutated — a later independent fit on a healed fleet must see
+        the accum the user set, not the recovery's."""
+        orig_accum = net.conf.grad_accum
+        if self.elastic_accum is not None:
+            net.conf.grad_accum = self.elastic_accum
+        try:
+            train_step, _, updaters = net._backprop_machinery(self.mesh)
+            # DP-mode steps take (x, y, n_valid) with zero-padded rows
+            # masked out of loss/grad (parallel/mesh padding contract)
+            dp_mode = getattr(train_step, "takes_n_valid", False)
+            pad_chunk = net._pad_chunk(
+                self.mesh, max(net.conf.grad_accum, 1)) if dp_mode else 1
+        finally:
+            net.conf.grad_accum = orig_accum
+
+        def dispatch(params, ustate, batch, key, at_step):
+            if not dp_mode:
+                return train_step(params, ustate, batch.features,
+                                  batch.labels, key, at_step)
+            b = batch.features.shape[0]
+            target = -(-b // pad_chunk) * pad_chunk
+            net._check_bn_padding(target != b)
+            return train_step(
+                params, ustate,
+                (net._pad_rows(batch.features, target),
+                 net._pad_rows(batch.labels, target), jnp.int32(b)),
+                key, at_step)
+
+        return dispatch, updaters
+
+    def _restore_latest(self, net, updaters):
+        """Restore the newest COMMITTED checkpoint (corrupt/uncommitted
+        steps fall back to the previous good one — CheckpointManager's
+        manifest protocol) against fresh templates."""
+        tpl_p = jax.tree.map(jnp.copy, net._require_params())
+        tpl_u = [u.init(p) for u, p in zip(updaters, tpl_p)]
+        (params, ustate), meta = self.manager.restore(like=(tpl_p, tpl_u))
+        self._check_restored(params, meta.get("step"))
+        return params, ustate, meta
+
+    def _elastic_resume(self, err: DeviceLossError, net):
+        """Device loss -> re-mesh over survivors (effective batch
+        preserved via grad_accum scaling) -> restore last committed
+        snapshot.  Returns (dispatch, updaters, params, ustate, step).
+        Single-device runs have nothing to shrink onto — the loss
+        re-raises."""
+        from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+        checkpoint_metrics.note("device_losses")
+        if self.mesh is None:
+            raise err
+        members = {int(d.id) for d in self.mesh.devices.flat}
+        if not members & {int(i) for i in err.lost_ids}:
+            # stale/foreign ids (a detector re-reporting an already-
+            # evicted device): "recovering" would rebuild an identical
+            # mesh and retry the same step forever.  Each genuine loss
+            # strictly shrinks the mesh, so this check also bounds the
+            # recovery loop by the initial device count.
+            log.error(
+                "device loss reports ids %s, none of which are in the "
+                "current mesh %s — stale detector? re-raising",
+                sorted(set(int(i) for i in err.lost_ids)),
+                sorted(members))
+            raise err
+        old_degree = int(self.mesh.shape[mesh_lib.DATA_AXIS])
+        old_accum = max(self.elastic_accum or net.conf.grad_accum, 1)
+        new_mesh, new_accum = mesh_lib.elastic_remesh(
+            self.mesh, err.lost_ids, old_accum)
+        new_degree = (int(new_mesh.shape[mesh_lib.DATA_AXIS])
+                      if new_mesh is not None else 1)
+        log.warning(
+            "device loss (ids %s): re-meshing %d->%d data shards, "
+            "grad_accum %d->%d (effective batch preserved); restoring "
+            "last committed snapshot", sorted(set(err.lost_ids)),
+            old_degree, new_degree, old_accum, new_accum)
+        telemetry.event("resilience.device_loss",
+                        lost=sorted(set(err.lost_ids)),
+                        old_degree=old_degree, new_degree=new_degree,
+                        new_accum=new_accum)
+        self._drain()   # the restore below must see every commit
+        self.mesh = new_mesh
+        self.elastic_accum = new_accum
+        dispatch, updaters = self._build_dispatch(net)
+        with telemetry.span("resilience.restore", elastic=True):
+            params, ustate, meta = self._restore_latest(net, updaters)
+        self.detector.reset()
+        self.remeshes += 1
+        checkpoint_metrics.note("elastic_resumes")
+        telemetry.event("resilience.elastic_resume",
+                        step=int(meta["step"]), new_degree=new_degree)
+        return dispatch, updaters, params, ustate, int(meta["step"])
+
     # -- driver ------------------------------------------------------------
     def fit(self, data, num_epochs: int = 1, seed: int = 2):
-        """Train to completion (or ``max_steps``), healing as it goes.
-        Returns the network with trained params set."""
+        """Train to completion (or ``max_steps``, or a preemption
+        notice), healing as it goes.  Returns the network with trained
+        params set; ``self.preempted`` reports a preemption stop."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
         cfg = self.config
@@ -349,113 +734,175 @@ class ResilientFit:
         # buffers; copy once at this API boundary (same contract as
         # fit_backprop)
         params = jax.tree.map(jnp.copy, net._require_params())
-        train_step, _, updaters = net._backprop_machinery(self.mesh)
+        dispatch, updaters = self._build_dispatch(net)
         ustate = [u.init(p) for u, p in zip(updaters, params)]
         run_key = jax.random.key(seed)
-        # DP-mode steps take (x, y, n_valid) with zero-padded rows
-        # masked out of loss/grad (parallel/mesh padding contract)
-        dp_mode = getattr(train_step, "takes_n_valid", False)
-        pad_chunk = net._pad_chunk(self.mesh, max(net.conf.grad_accum, 1)) \
-            if dp_mode else 1
-
-        def dispatch(params, ustate, batch, key, at_step):
-            if not dp_mode:
-                return train_step(params, ustate, batch.features,
-                                  batch.labels, key, at_step)
-            b = batch.features.shape[0]
-            target = -(-b // pad_chunk) * pad_chunk
-            net._check_bn_padding(target != b)
-            return train_step(
-                params, ustate,
-                (net._pad_rows(batch.features, target),
-                 net._pad_rows(batch.labels, target), jnp.int32(b)),
-                key, at_step)
 
         step = 0
         rollbacks = 0
+        self.preempted = False
+        restored = False
         if cfg.resume:
             latest = self.manager.latest_step()
+            if latest is None:
+                # library callers keep the resume-or-fresh pattern, but
+                # loudly: an empty dir on a restart usually means an
+                # unmounted volume or a mistyped path
+                log.warning(
+                    "resume=True but no checkpoints in %s — starting "
+                    "from scratch (wrong path or unmounted volume?)",
+                    cfg.checkpoint_dir)
             if latest is not None:
-                (params, ustate), meta = self.manager.restore(
-                    like=(params, ustate))
-                self._check_restored(params, latest)
+                params, ustate, meta = self._restore_latest(net, updaters)
                 step = int(meta["step"])
                 rollbacks = int(meta.get("rollbacks", 0))
+                restored = True
                 telemetry.event("resilience.resume", step=step,
                                 rollbacks=rollbacks)
                 log.info("resumed from checkpoint at step %d "
                          "(rollbacks=%d)", step, rollbacks)
 
-        def save(at_step: int) -> None:
-            with telemetry.span("resilience.checkpoint", step=at_step):
-                self.manager.save(at_step, (params, ustate),
-                                  meta={"rollbacks": rollbacks})
+        def save(at_step: int, sync: bool = False) -> None:
+            """Cadence snapshot: async by default (the step never waits
+            for serialization/fsync), synchronous for the preemption/
+            bounded-slice final snapshot where the commit must be on
+            disk before fit returns anyway."""
+            if self.async_ckpt is None or sync:
+                with telemetry.span("resilience.checkpoint",
+                                    step=at_step, mode="sync"):
+                    self.manager.save(at_step, (params, ustate),
+                                      meta={"rollbacks": rollbacks})
+            else:
+                with telemetry.span("resilience.checkpoint",
+                                    step=at_step, mode="async"):
+                    self.async_ckpt.save(at_step, (params, ustate),
+                                         meta={"rollbacks": rollbacks})
             resilience_metrics.note("checkpoints_saved")
 
-        if self.manager.latest_step() is None:
-            save(step)  # rollback target exists before the first cadence
+        if not restored:
+            existing = self.manager.all_steps()
+            if existing:
+                # a fresh run CANNOT share a dir with another run's
+                # snapshots — another process's, or a previous
+                # non-resumed fit() of this very driver: retention GC
+                # keys on step number, so this run's low-numbered saves
+                # (including its rollback target and any preemption
+                # snapshot) would be swept the moment they land next to
+                # higher foreign steps — and a later --resume (or a
+                # newest-committed rollback restore) would silently
+                # adopt the stale params.  Refuse up front instead.
+                raise ValueError(
+                    f"checkpoint_dir {cfg.checkpoint_dir!r} already "
+                    f"holds snapshots (steps {existing}); pass "
+                    "resume=True to continue that run, or point at a "
+                    "fresh directory")
+            # THIS run's rollback target exists before the first cadence
+            save(step)
 
-        last_good = self.manager.latest_step()
+        # the step of the newest snapshot we REQUESTED (the initial
+        # save above or the resume point) — tracked as an int, not read
+        # back from disk, because an async save may not have committed
+        # yet; every restore drains first
+        last_good = step
         skips: List[jax.Array] = []
         steps_this_call = 0
+        guard = self.preemption_guard or PreemptionGuard()
 
-        while step < total_steps:
-            if cfg.max_steps is not None \
-                    and steps_this_call >= cfg.max_steps:
-                save(step)   # bounded slice: persist exactly where we stop
-                break
-            epoch, pos = divmod(step, n_batches)
-            order = self._epoch_order(run_key, seed, rollbacks, epoch,
-                                      n_batches)
-            batch = batches[order[pos]]
-            # re-folded key: rollback bumps `rollbacks`, giving the retry
-            # a fresh noise stream on top of the reshuffled batch order
-            eff_key = jax.random.fold_in(run_key, rollbacks)
-            params, ustate, score, skipped = dispatch(
-                params, ustate, batch, eff_key, step)
-            skips.append(skipped)
-            loss = float(score)
-            steps_this_call += 1
-            if net.listeners:
-                for ls in net.listeners:
-                    ls.iteration_done(net, step, loss)
-            if self.detector.observe(loss):
-                if rollbacks >= cfg.max_rollbacks:
-                    resilience_metrics.note("retry_budget_exceeded")
-                    telemetry.event("resilience.retry_budget_exceeded",
-                                    step=step, rollbacks=rollbacks)
-                    raise RetryBudgetExceeded(
-                        f"loss anomaly survived {cfg.max_rollbacks} "
-                        f"rollbacks (last-good step {last_good}); "
-                        "refusing to burn more compute")
-                rollbacks += 1
-                resilience_metrics.note("rollbacks")
-                telemetry.event("resilience.rollback", step=step,
-                                to_step=int(last_good),
-                                rollbacks=rollbacks)
-                delay = cfg.backoff_s * (2 ** (rollbacks - 1))
-                log.warning(
-                    "sustained loss anomaly at step %d; rolling back to "
-                    "step %s (rollback %d/%d, backoff %.2fs)", step,
-                    last_good, rollbacks, cfg.max_rollbacks, delay)
-                if delay > 0:
-                    time.sleep(delay)
-                with telemetry.span("resilience.restore",
-                                    step=int(last_good)):
-                    (params, ustate), meta = self.manager.restore(
-                        step=last_good,
-                        like=(jax.tree.map(jnp.copy,
-                                           net._require_params()),
-                              [u.init(p) for u, p in
-                               zip(updaters, net._require_params())]))
-                    self._check_restored(params, last_good)
-                step = int(last_good)
-                self.detector.reset()
-                continue
-            step += 1
-            if step % cfg.checkpoint_every == 0 and step < total_steps:
-                save(step)
-                last_good = step
+        with self._writer_guard(), guard:
+            while step < total_steps:
+                if guard.requested():
+                    # preemption notice: drain in-flight snapshots, one
+                    # final SYNC snapshot at this boundary, clean return
+                    self._drain()
+                    save(step, sync=True)
+                    checkpoint_metrics.note("preemption_snapshots")
+                    telemetry.event("resilience.preempted", step=step)
+                    log.warning("preempted at step %d: final snapshot "
+                                "committed, exiting cleanly", step)
+                    self.preempted = True
+                    break
+                if cfg.max_steps is not None \
+                        and steps_this_call >= cfg.max_steps:
+                    # bounded slice: persist exactly where we stop
+                    self._drain()
+                    save(step, sync=True)
+                    break
+                epoch, pos = divmod(step, n_batches)
+                order = self._epoch_order(run_key, seed, rollbacks, epoch,
+                                          n_batches)
+                batch = batches[order[pos]]
+                # re-folded key: rollback bumps `rollbacks`, giving the
+                # retry a fresh noise stream on top of the reshuffled
+                # batch order
+                eff_key = jax.random.fold_in(run_key, rollbacks)
+                try:
+                    if self.fault_hook is not None:
+                        self.fault_hook(step)
+                    params, ustate, score, skipped = dispatch(
+                        params, ustate, batch, eff_key, step)
+                except DeviceLossError as e:
+                    dispatch, updaters, params, ustate, step = \
+                        self._elastic_resume(e, net)
+                    # the restore may have fallen back below the newest
+                    # requested save (corrupt-latest case) — re-anchor
+                    # the rollback target to what is actually good
+                    last_good = step
+                    # skip flags booked so far live on the LOST mesh —
+                    # pull them to host now (one sync per loss event)
+                    # so the end-of-fit stack doesn't mix shardings
+                    skips = [np.asarray(jax.device_get(s)) for s in skips]
+                    continue
+                skips.append(skipped)
+                loss = float(score)
+                steps_this_call += 1
+                if net.listeners:
+                    for ls in net.listeners:
+                        ls.iteration_done(net, step, loss)
+                if self.detector.observe(loss):
+                    if rollbacks >= cfg.max_rollbacks:
+                        resilience_metrics.note("retry_budget_exceeded")
+                        telemetry.event(
+                            "resilience.retry_budget_exceeded",
+                            step=step, rollbacks=rollbacks)
+                        raise RetryBudgetExceeded(
+                            f"loss anomaly survived {cfg.max_rollbacks} "
+                            f"rollbacks (last-good step {last_good}); "
+                            "refusing to burn more compute")
+                    rollbacks += 1
+                    resilience_metrics.note("rollbacks")
+                    telemetry.event("resilience.rollback", step=step,
+                                    to_step=int(last_good),
+                                    rollbacks=rollbacks)
+                    delay = cfg.backoff_s * (2 ** (rollbacks - 1))
+                    log.warning(
+                        "sustained loss anomaly at step %d; rolling back "
+                        "to step %s (rollback %d/%d, backoff %.2fs)",
+                        step, last_good, rollbacks, cfg.max_rollbacks,
+                        delay)
+                    if delay > 0:
+                        time.sleep(delay)
+                    self._drain()   # the rollback target must be on disk
+                    # newest-committed restore, NOT restore(step=
+                    # last_good): the explicit-step form never falls
+                    # back, so a bit-rotted last_good would kill the
+                    # run despite older verified snapshots.  After the
+                    # drain the newest committed step IS last_good on
+                    # the happy path; on corruption the manifest
+                    # protocol walks back to the previous good one — a
+                    # corrupt checkpoint costs one cadence, never the
+                    # run.
+                    with telemetry.span("resilience.restore",
+                                        step=int(last_good)):
+                        params, ustate, meta = self._restore_latest(
+                            net, updaters)
+                    step = int(meta["step"])
+                    last_good = step
+                    self.detector.reset()
+                    continue
+                step += 1
+                if step % cfg.checkpoint_every == 0 and step < total_steps:
+                    save(step)
+                    last_good = step
 
         n_skipped = note_skips(skips, where="resilient-fit")
         if n_skipped and hasattr(net, "guard_skips"):
@@ -464,5 +911,21 @@ class ResilientFit:
             net.guard_skips += n_skipped
         self.steps_run = steps_this_call
         self.rollbacks = rollbacks
+        # trained params belong to the caller REGARDLESS of checkpoint-
+        # writer health: assign before the final drain so a failed
+        # background commit surfaces its error without discarding the
+        # completed training
         net.params = params
+        # every async snapshot committed before fit returns — a caller
+        # reading manager.latest_step() (or getting killed next) must
+        # see the disk state the counters claim.  close() drains AND
+        # stops the writer thread (which would otherwise idle for the
+        # life of the process, one per driver); a fresh checkpointer
+        # takes its place so fit() can run again on this driver.  A
+        # re-fit must pass resume=True (continuing from the final
+        # snapshot): a non-resume refit over the now-populated dir is
+        # refused above — this driver's own stale snapshots are exactly
+        # as hazardous to the step-keyed GC and to newest-committed
+        # restores as a foreign run's.
+        self._recycle_writer(suppress_errors=False)
         return net

@@ -49,7 +49,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+from deeplearning4j_tpu.runtime.metrics import (checkpoint_metrics,
+                                                compile_metrics,
                                                 decode_metrics,
                                                 device_memory_stats,
                                                 dp_metrics,
@@ -504,6 +505,7 @@ registry.register("resilience", resilience_metrics)
 registry.register("serving", serving_metrics)
 registry.register("decode", decode_metrics)
 registry.register("dp", dp_metrics)
+registry.register("checkpoint", checkpoint_metrics)
 
 
 # ---------------------------------------------------------------------------

@@ -135,15 +135,10 @@ def test_profiler_helpers(tmp_path):
 
 
 def test_orbax_manager_roundtrip(tmp_path):
-    import jax.numpy as jnp
-    import numpy as np
-    pytest = __import__("pytest")
-    try:
-        from deeplearning4j_tpu.runtime.checkpoint import (
-            OrbaxCheckpointManager)
-        mgr = OrbaxCheckpointManager(str(tmp_path / "orbax"), max_to_keep=2)
-    except ImportError:
-        pytest.skip("orbax unavailable")
+    pytest.importorskip("orbax.checkpoint")
+    from deeplearning4j_tpu.runtime.checkpoint import (
+        OrbaxCheckpointManager)
+    mgr = OrbaxCheckpointManager(str(tmp_path / "orbax"), max_to_keep=2)
     tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)}
     for step in (1, 2, 3):
         mgr.save(step, jax.tree.map(lambda x, s=step: x + s, tree))
@@ -155,7 +150,52 @@ def test_orbax_manager_roundtrip(tmp_path):
     mgr.close()
 
 
-import jax  # noqa: E402  (used by the orbax test's tree.map)
+def test_orbax_manager_meta_roundtrip(tmp_path):
+    """The (tree, meta) surface contract: meta saved through the
+    Composite comes back from restore (not silently dropped)."""
+    pytest.importorskip("orbax.checkpoint")
+    from deeplearning4j_tpu.runtime.checkpoint import (
+        OrbaxCheckpointManager)
+    mgr = OrbaxCheckpointManager(str(tmp_path / "orbax_meta"))
+    tree = {"w": jnp.arange(4.0)}
+    mgr.save(1, tree, meta={"rollbacks": 2, "note": "x"})
+    got, meta = mgr.restore(like=tree)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(4.0))
+    assert meta["rollbacks"] == 2 and meta["note"] == "x"
+    mgr.close()
+
+
+def test_orbax_manager_raises_importerror_when_unavailable(tmp_path,
+                                                           monkeypatch):
+    """The documented contract: ``OrbaxCheckpointManager`` raises
+    ImportError at construction when orbax is missing — falling back is
+    the CALLER's choice, never a silent degradation.  Simulated by
+    poisoning the module cache (works whether or not orbax is
+    installed: a None sys.modules entry makes the import raise)."""
+    import sys
+    from deeplearning4j_tpu.runtime.checkpoint import (
+        OrbaxCheckpointManager)
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    with pytest.raises(ImportError):
+        OrbaxCheckpointManager(str(tmp_path / "none"))
+
+
+def test_load_pytree_structure_mismatch_raises(tmp_path):
+    """A template whose flatten paths differ from the saved ones must
+    raise the descriptive structure-mismatch ValueError, not silently
+    reorder leaves into the wrong slots."""
+    p = str(tmp_path / "t.npz")
+    ckpt.save_pytree(p, _tree())
+    wrong_keys = {"layerX": {"W": jnp.zeros((2, 3)), "b": jnp.zeros(3)},
+                  "step": jnp.asarray(0, jnp.int32)}
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ckpt.load_pytree(p, like=wrong_keys)
+    # same leaf COUNT, different paths: still a mismatch
+    flat_tpl = {"a": jnp.zeros((2, 3)), "b": jnp.zeros(3),
+                "c": jnp.asarray(0, jnp.int32)}
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ckpt.load_pytree(p, like=flat_tpl)
 
 
 def test_sharded_moe_state_orbax_resume(tmp_path):

@@ -32,6 +32,13 @@ python -m tools.jaxlint deeplearning4j_tpu bench.py tools || exit 1
 echo "[ci] telemetry overhead gate"
 JAX_PLATFORMS=cpu python -m tools.telemetry_gate || exit 1
 
+# Preemption drill: SIGTERM against a live ResilientFit subprocess must
+# produce a committed (manifest-verified) final snapshot, a clean exit
+# 0, and a resumable checkpoint dir — the fault-tolerance contract
+# ROADMAP item 4 exists for.  Seconds on CPU.
+echo "[ci] preemption drill"
+JAX_PLATFORMS=cpu python -m tools.preemption_drill || exit 1
+
 if [ "${1:-}" = "--slow" ]; then
   python -m pytest tests/ -q
 else

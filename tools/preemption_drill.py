@@ -1,0 +1,175 @@
+"""Preemption-drill smoke gate (tools/ci.sh).
+
+Machine-checks the PR 8 preemption contract end to end, with a REAL
+signal against a REAL process:
+
+1. spawn a subprocess training a small net through ``ResilientFit``
+   (async snapshots, PreemptionGuard installed — the default);
+2. once the child reports training steps, deliver SIGTERM;
+3. the child must write a final committed snapshot at the next step
+   boundary and exit 0 (clean preemption, not a crash);
+4. this process then resumes from the child's checkpoint directory
+   with ``ResilienceConfig(resume=True)`` and must run to completion
+   from the preempted step.
+
+Exits non-zero on any violation.  Seconds on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(8).lr(0.05).num_iterations(1).activation("tanh")
+            .list(2).hidden_layer_sizes(16)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    rng = np.random.RandomState(0)
+    batches = [DataSet(jnp.asarray(rng.randn(32, 8).astype(np.float32)),
+                       jnp.asarray(np.eye(3, dtype=np.float32)[
+                           rng.randint(0, 3, 32)]))
+               for _ in range(8)]
+    net = MultiLayerNetwork(conf).init(seed=1)
+
+    class Beacon:
+        def iteration_done(self, model, it, score):
+            print("DRILL_STEP", it, flush=True)
+    net.set_listeners([Beacon()])
+
+    driver = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir={ckdir!r}, checkpoint_every=4))
+    driver.fit(batches, num_epochs=200, seed=3)
+    print("DRILL_EXIT preempted=%s step=%s" % (
+        driver.preempted, driver.manager.latest_step()), flush=True)
+""")
+
+
+def main() -> int:
+    import queue
+    import threading
+
+    with tempfile.TemporaryDirectory() as d:
+        ckdir = os.path.join(d, "ckpts")
+        # stderr goes to a FILE: a PIPE nobody drains while we wait on
+        # stdout can fill and deadlock a chatty/warning-heavy child
+        err_path = os.path.join(d, "worker.stderr")
+        with open(err_path, "w") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 WORKER.format(repo=REPO, ckdir=ckdir)],
+                stdout=subprocess.PIPE, stderr=err_f, text=True)
+
+        # wait until the child is demonstrably mid-training — stdout is
+        # read on a helper thread so the deadline is REAL (a blocking
+        # readline would only check the clock after a line arrives,
+        # i.e. never, if the child hangs before its first print)
+        lines: "queue.Queue" = queue.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True).start()
+        deadline = time.time() + 120
+        seen_step = False
+        while time.time() < deadline:
+            try:
+                if lines.get(timeout=1).startswith("DRILL_STEP"):
+                    seen_step = True
+                    break
+            except queue.Empty:
+                if proc.poll() is not None:
+                    break
+        if not seen_step:
+            proc.kill()
+            proc.wait(timeout=30)
+            print("[preemption-drill] FAIL: worker produced no steps:\n"
+                  + open(err_path).read()[-2000:])
+            return 1
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=120)
+        out_rest: list = []
+        while True:
+            try:
+                out_rest.append(lines.get(timeout=1))
+            except queue.Empty:
+                break
+        out = "".join(out_rest)
+        if proc.returncode != 0:
+            print(f"[preemption-drill] FAIL: worker exit code "
+                  f"{proc.returncode} after SIGTERM (wanted clean 0):\n"
+                  + open(err_path).read()[-2000:])
+            return 1
+        if "preempted=True" not in out:
+            print("[preemption-drill] FAIL: worker finished without "
+                  "reporting a preemption stop:\n" + out[-2000:])
+            return 1
+
+        # the final snapshot must be COMMITTED (manifest verifies) and
+        # resumable by a fresh process (this one)
+        from deeplearning4j_tpu.runtime.checkpoint import CheckpointManager
+        from deeplearning4j_tpu.runtime.resilience import (
+            ResilienceConfig, ResilientFit)
+        import numpy as np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        mgr = CheckpointManager(ckdir)
+        latest = mgr.latest_step()
+        if latest is None:
+            print("[preemption-drill] FAIL: no checkpoint committed")
+            return 1
+        mgr.verify(latest)
+
+        conf = (NeuralNetConfiguration.builder()
+                .n_in(8).lr(0.05).num_iterations(1).activation("tanh")
+                .list(2).hidden_layer_sizes(16)
+                .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                          activation="softmax", loss_function="mcxent")
+                .pretrain(False).backward(True).build())
+        rng = np.random.RandomState(0)
+        batches = [DataSet(jnp.asarray(rng.randn(32, 8)
+                                       .astype(np.float32)),
+                           jnp.asarray(np.eye(3, dtype=np.float32)[
+                               rng.randint(0, 3, 32)]))
+                   for _ in range(8)]
+        net = MultiLayerNetwork(conf).init(seed=1)
+        driver = ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=ckdir, resume=True, checkpoint_every=4,
+            max_steps=8))           # bounded resume slice: fast smoke
+        driver.fit(batches, num_epochs=200, seed=3)
+        if driver.steps_run < 1:
+            print("[preemption-drill] FAIL: resume ran no steps")
+            return 1
+        print(f"[preemption-drill] ok: SIGTERM at a live step -> clean "
+              f"exit 0, committed snapshot at step {latest}, fresh "
+              f"process resumed {driver.steps_run} step(s)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

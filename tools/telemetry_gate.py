@@ -9,7 +9,11 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
    (enabling spans changes no jitted program — the tracer is host-side
    by construction) and must produce a journal whose chrome-trace
    conversion is valid Perfetto JSON with the fit span present;
-4. the same off/on zero-compile contract for the continuous-batching
+4. the same off/on zero-compile contract for a warmed ``ResilientFit``
+   with BACKGROUND snapshots (runtime/checkpoint.py
+   ``AsyncCheckpointer``, the PR 8 default): staging copies, writer
+   commits, and drains must never trace a new program;
+5. the same off/on zero-compile contract for the continuous-batching
    decode loop (serving/decode.py): after ``DecodeEngine.warmup()``, a
    concurrent request mix — joins, EOS recycling, varied prompt
    lengths — must dispatch only cached programs with the tracer off AND
@@ -60,6 +64,49 @@ def _decode_requests(cb, np, n: int, seed: int) -> None:
                for i in range(n)]
     for h in handles:
         h.result(120)
+
+
+def _checkpoint_gate(registry, telemetry, net, batches) -> int:
+    """Async-checkpoint loop gate: a WARMED ResilientFit with background
+    snapshots (the PR 8 default) must dispatch only cached programs —
+    the AsyncCheckpointer's device-side staging copies and its writer
+    thread are outside every jitted region — with the tracer off AND
+    on."""
+    import tempfile
+
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+
+    def one_fit(seed):
+        with tempfile.TemporaryDirectory() as ckdir:
+            ResilientFit(net, ResilienceConfig(
+                checkpoint_dir=ckdir, checkpoint_every=2,
+                patience=10 ** 6)).fit(batches, num_epochs=2, seed=seed)
+
+    one_fit(0)              # warm (same engine step as the fit gate,
+    registry.mark()         # but snapshots + drain now ride along)
+
+    assert not telemetry.enabled()
+    one_fit(1)
+    delta_off = registry.compile_delta_since_mark()
+    if delta_off != 0:
+        print(f"[telemetry-gate] FAIL: tracer-off async-checkpoint fit "
+              f"compiled {delta_off} new program(s)")
+        return 1
+
+    telemetry.enable("telemetry-gate-ckpt")
+    registry.mark()
+    one_fit(2)
+    delta_on = registry.compile_delta_since_mark()
+    telemetry.disable()
+    if delta_on != 0:
+        print(f"[telemetry-gate] FAIL: tracer-on async-checkpoint fit "
+              f"compiled {delta_on} new program(s) — checkpoint "
+              "instrumentation leaked into a jitted region")
+        return 1
+    print(f"[telemetry-gate] ok: async-checkpoint loop compile_delta "
+          f"off={delta_off} on={delta_on}")
+    return 0
 
 
 def _decode_gate(registry, telemetry) -> int:
@@ -148,6 +195,9 @@ def main() -> int:
     telemetry.disable()
     print(f"[telemetry-gate] ok: compile_delta off={delta_off} "
           f"on={delta_on}, {len(records)} journal record(s)")
+    rc = _checkpoint_gate(registry, telemetry, net, batches)
+    if rc:
+        return rc
     return _decode_gate(registry, telemetry)
 
 
