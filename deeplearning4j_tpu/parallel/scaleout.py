@@ -400,13 +400,18 @@ class DistributedRunner:
         self._stop = threading.Event()
 
     # -- worker loop (WorkerActor.checkJobAvailable:287 parity) ------------
-    def _worker_loop(self, worker_id: str) -> None:
+    def _worker_loop(self, worker_id: str,
+                     stop: Optional[threading.Event] = None) -> None:
         from deeplearning4j_tpu.runtime import telemetry
 
+        # the stop event is bound PER RUN: a worker leaked by a timed-out
+        # join must keep watching its own run's (set) event, not a later
+        # run's fresh one
+        stop = self._stop if stop is None else stop
         performer = self.performer_factory()
         self.tracker.add_worker(worker_id)
         telemetry.event("scaleout.worker_join", worker=worker_id)
-        while not self._stop.is_set():
+        while not stop.is_set():
             self.tracker.heartbeat(worker_id)
             job = self.tracker.job_for(worker_id)
             if job is None:
@@ -433,8 +438,14 @@ class DistributedRunner:
 
     # -- master loop (MasterActor 1s pump :104-137 parity) -----------------
     def run(self, timeout_s: float = 60.0) -> Any:
+        # a fresh stop event per run: the previous run's ``finally``
+        # left the shared event SET, so a reused runner's workers would
+        # all exit on arrival and the pump would spin to TimeoutError
+        # with every job queued and zero live workers
+        stop = self._stop = threading.Event()
         workers = [threading.Thread(target=self._worker_loop,
-                                    args=(f"worker-{i}",), daemon=True)
+                                    args=(f"worker-{i}", stop),
+                                    daemon=True)
                    for i in range(self.n_workers)]
         for w in workers:
             w.start()
@@ -443,7 +454,7 @@ class DistributedRunner:
                                self.router, lambda: self.n_workers,
                                self.poll, timeout_s)
         finally:
-            self._stop.set()
+            stop.set()
             for w in workers:
                 w.join(timeout=5)
 

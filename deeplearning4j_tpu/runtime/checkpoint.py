@@ -639,8 +639,9 @@ class AsyncCheckpointer:
              meta: Optional[Dict] = None) -> SnapshotHandle:
         from deeplearning4j_tpu.runtime.metrics import checkpoint_metrics
 
-        if self._closed:
-            raise RuntimeError("AsyncCheckpointer is closed")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
         t_req = time.perf_counter()
         if not self._sem.acquire(blocking=False):
             checkpoint_metrics.note("backpressure_waits")
@@ -657,12 +658,30 @@ class AsyncCheckpointer:
             nbytes, (time.perf_counter() - t_req) * 1e3)
         handle = SnapshotHandle(step)
         with self._lock:
+            # re-check + enqueue ATOMICALLY with the closed flag: a
+            # save() racing close() could otherwise enqueue its job
+            # BEHIND the writer's stop sentinel — the writer exits at
+            # the sentinel, the job is never processed, and the
+            # caller's handle.result() blocks forever
+            if self._closed:
+                self._sem.release()
+                # the staging above already bumped the in-flight gauge;
+                # this snapshot will never commit, so bring it back down
+                # (same no-commit decrement the writer's error path uses)
+                checkpoint_metrics.note_commit_failed()
+                raise RuntimeError("AsyncCheckpointer is closed")
             self._pending.append(handle)
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._writer, name="ckpt-writer", daemon=True)
                 self._thread.start()
-        self._q.put((handle, staged, meta, t_req))
+            # self._q is UNBOUNDED (in-flight snapshots are bounded by
+            # the semaphore above instead), so this put() never blocks;
+            # it must stay under the lock to order against close()'s
+            # stop sentinel
+            self._q.put(
+                (handle, staged, meta, t_req)
+            )  # jaxlint: disable=blocking-under-lock — unbounded queue, bounded upstream by self._sem
         return handle
 
     # -- writer thread ------------------------------------------------------
@@ -726,13 +745,19 @@ class AsyncCheckpointer:
         stops even when the drain raises (a failed commit, a timeout) —
         the error propagates, but an abandoned checkpointer must not
         leak a thread parked on its queue (plus every staged pytree
-        still queued behind it)."""
-        if self._closed:
-            return
+        still queued behind it).
+
+        The closed flag flips UNDER the lock and BEFORE the drain:
+        ``save()`` re-checks it under the same lock when enqueueing, so
+        no snapshot can slip in behind the stop sentinel and hang its
+        caller (the drain races the writer, never the producers)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         try:
             self.wait_until_finished(timeout)
         finally:
-            self._closed = True
             if self._thread is not None:
                 self._q.put(None)
                 self._thread.join(timeout)

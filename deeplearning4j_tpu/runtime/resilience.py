@@ -138,7 +138,11 @@ class PreemptionGuard:
         tracer, and the logging module all take non-reentrant locks, and
         the signal can land while the interrupted thread already holds
         one (e.g. mid ``note_staged``) — re-acquiring it from the
-        handler would deadlock the process inside its grace window."""
+        handler would deadlock the process inside its grace window.
+        This flag-only contract is machine-checked: jaxlint's
+        ``impure-signal-handler`` rule resolves every callable
+        registered through ``signal.signal`` (this class's ``_handler``
+        included) and fails CI on locks/logging/metrics in its body."""
         self._requested.set()
 
     def requested(self) -> bool:

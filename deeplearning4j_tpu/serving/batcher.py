@@ -22,7 +22,10 @@ batches formed).
 
 Thread-safety: ``submit`` may be called from any number of threads; one
 worker thread owns the queue drain and the engine dispatch order, so
-per-thread result ordering is preserved by construction.
+per-thread result ordering is preserved by construction.  The lock
+discipline (every shared mutation under ``self._cv``, no blocking wait
+while holding it) is machine-checked by jaxlint's concurrency family
+(``unlocked-shared-mutation``, ``blocking-under-lock``).
 """
 
 from __future__ import annotations

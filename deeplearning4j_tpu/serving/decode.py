@@ -31,7 +31,10 @@ A replicated front-end with load-shedding lives in
 ``serving/router.py``.  Steady state is compile-free: ``warmup()``
 pre-traces both executables for every bucket, after which any mix of
 prompt lengths, joins, and slot recycling dispatches only cached
-programs (asserted by the bench row and the telemetry gate).
+programs (asserted by the bench row and the telemetry gate).  The
+worker/lock contract (engine driven by ONE thread, shared request
+state mutated only under its Condition, no blocking wait under a held
+lock) is machine-checked by jaxlint's concurrency family.
 """
 
 from __future__ import annotations

@@ -809,3 +809,889 @@ def test_checked_in_baseline_is_empty():
     data = json.loads(
         (REPO_ROOT / "tools" / "jaxlint" / "baseline.json").read_text())
     assert data["entries"] == []
+
+
+# ---------------------------------------------------------------------------
+# PR 10 framework: families, fingerprint, --jobs, --format json
+# ---------------------------------------------------------------------------
+
+def only(src, rule, path="pkg/mod.py"):
+    """Lines at which exactly ``rule`` fired (other rules ignored — a
+    divergent-branch fixture legitimately also trips host-sync)."""
+    import textwrap
+    return [f.line for f in check_source(textwrap.dedent(src), path)
+            if f.rule == rule]
+
+
+def test_registry_ships_both_new_families():
+    collective = {"unbound-axis", "collective-in-divergent-branch",
+                  "donation-across-collective"}
+    concurrency = {"unlocked-shared-mutation", "blocking-under-lock",
+                   "impure-signal-handler"}
+    assert collective | concurrency <= set(REGISTRY)
+    assert len(REGISTRY) >= 11
+    for name in collective:
+        assert REGISTRY[name].family == "collective"
+    for name in concurrency:
+        assert REGISTRY[name].family == "concurrency"
+    for name in ("stray-jit", "use-after-donate", "impure-jit"):
+        assert REGISTRY[name].family == "tracing"
+
+
+def test_cli_list_rules_groups_by_family(capsys):
+    assert jaxlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for header in ("collective:", "concurrency:", "tracing:"):
+        assert header in out
+    for name in ("unbound-axis", "collective-in-divergent-branch",
+                 "donation-across-collective", "unlocked-shared-mutation",
+                 "blocking-under-lock", "impure-signal-handler"):
+        assert name in out
+
+
+def test_framework_fingerprint_covers_astutil_and_core(tmp_path):
+    """The cache key must change when the SHARED framework changes, not
+    only when a rule file does — a fix to the class-scoped lock
+    tracking has to re-lint files whose text never moved."""
+    import shutil
+    from tools.jaxlint import core as core_mod
+
+    pkg = REPO_ROOT / "tools" / "jaxlint"
+    scratch = tmp_path / "jaxlint_copy"
+    shutil.copytree(pkg, scratch,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    fp0 = core_mod._analyzer_fingerprint(scratch)
+    assert fp0 == core_mod._analyzer_fingerprint(scratch)  # stable
+    astutil_py = scratch / "astutil.py"
+    astutil_py.write_text(astutil_py.read_text() + "\n# touched\n")
+    fp1 = core_mod._analyzer_fingerprint(scratch)
+    assert fp1 != fp0
+    core_py = scratch / "core.py"
+    core_py.write_text(core_py.read_text() + "\n# touched\n")
+    fp2 = core_mod._analyzer_fingerprint(scratch)
+    assert fp2 not in (fp0, fp1)
+
+
+def test_result_cache_invalidates_on_framework_edit(tmp_path, monkeypatch):
+    """A cache entry written under one analyzer fingerprint must be
+    ignored once the fingerprint changes (regression: the key used to
+    cover only the file source + rule names)."""
+    from tools.jaxlint import core as core_mod
+
+    f = _violation_file(tmp_path)
+    cache = tmp_path / "cache.json"
+    findings = run_paths([f], cache_path=cache)
+    assert [x.rule for x in findings] == ["stray-jit"]
+    entry = json.loads(cache.read_text())
+    (key0,) = {v["key"] for v in entry.values()}
+
+    # simulate a framework edit: poison the cached entry with bogus
+    # findings, then flip the fingerprint — the poisoned entry must NOT
+    # be served
+    for v in entry.values():
+        v["findings"] = []
+    cache.write_text(json.dumps(entry))
+    monkeypatch.setattr(core_mod, "_ANALYZER_FP", "deadbeef" * 8)
+    findings = run_paths([f], cache_path=cache)
+    assert [x.rule for x in findings] == ["stray-jit"]
+    entry = json.loads(cache.read_text())
+    (key1,) = {v["key"] for v in entry.values()}
+    assert key1 != key0
+
+    # same poisoning WITHOUT a fingerprint change is served from cache
+    # (that's what a cache is) — proving the invalidation above really
+    # came from the fingerprint
+    for v in entry.values():
+        v["findings"] = []
+    cache.write_text(json.dumps(entry))
+    assert run_paths([f], cache_path=cache) == []
+
+
+def test_cli_jobs_output_is_deterministic(tmp_path, capsys):
+    """--jobs N must not reorder findings: per-file results are
+    stitched back in file order whatever the worker count."""
+    for i in range(6):
+        _violation_file(tmp_path, f"m{i}.py",
+                        extra="g = jax.pjit(lambda x: x)\n")
+    outs = []
+    for jobs in ("1", "3", "8"):
+        assert jaxlint_main([str(tmp_path), "--no-baseline",
+                             "--jobs", jobs]) == 1
+        outs.append(capsys.readouterr().out)
+    assert outs[0] == outs[1] == outs[2]
+    assert outs[0].count("stray-jit") == 12
+
+
+def test_cli_jobs_rejects_nonpositive(capsys):
+    assert jaxlint_main(["--jobs", "0", "pkg"]) == 2
+    assert "--jobs" in capsys.readouterr().err
+
+
+def test_cli_format_json_records_and_exit_codes(tmp_path, capsys):
+    f = _violation_file(tmp_path)
+    assert jaxlint_main([str(f), "--no-baseline",
+                         "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["ok"] is False and data["errors"] == 1
+    (rec,) = data["findings"]
+    assert rec["rule"] == "stray-jit" and rec["severity"] == "error"
+    assert rec["file"].endswith("mod.py") and rec["line"] == 2
+    assert rec["family"] == "tracing"
+    assert isinstance(rec["col"], int) and rec["message"]
+
+    # clean tree: ok object, exit 0, empty findings
+    f.write_text("x = 1\n")
+    assert jaxlint_main([str(f), "--no-baseline",
+                         "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["ok"] is True and data["findings"] == []
+
+
+def test_ci_runs_the_json_format_gate():
+    text = (REPO_ROOT / "tools" / "ci.sh").read_text()
+    assert "--format json" in text.split("telemetry")[0]
+
+
+# ---------------------------------------------------------------------------
+# unbound-axis
+# ---------------------------------------------------------------------------
+
+def test_unbound_axis_flags_literal_outside_vocabulary():
+    src = '''
+    from jax import lax
+
+    def local_mean(x):
+        return lax.pmean(x, "dta")
+    '''
+    assert only(src, "unbound-axis") == [5]
+
+
+def test_unbound_axis_vocabulary_and_shard_map_bound_pass():
+    src = '''
+    import jax
+    from jax import lax
+    from deeplearning4j_tpu.compat import shard_map
+
+    def body(x):
+        return lax.psum(x, "data") + lax.pmean(x, "model")
+
+    def ring(x):
+        return lax.all_gather(x, "ring")
+
+    f = jax.pmap(ring, axis_name="ring")
+    '''
+    assert only(src, "unbound-axis") == []
+
+
+def test_unbound_axis_resolves_parameter_defaults():
+    src = '''
+    from jax import lax
+
+    def reduce_it(x, axis="bogus"):
+        return lax.psum(x, axis)
+
+    def fine(x, axis="data"):
+        return lax.psum(x, axis)
+
+    def unknowable(x, axis):
+        return lax.psum(x, axis)
+    '''
+    assert only(src, "unbound-axis") == [5]
+
+
+def test_unbound_axis_resolves_local_constant_not_imports():
+    src = '''
+    from jax import lax
+    from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+    MY_AXIS = "nowhere"
+
+    def a(x):
+        return lax.psum(x, MY_AXIS)
+
+    def b(x):
+        return lax.psum(x, DATA_AXIS)
+    '''
+    # the local constant resolves (and is unbound); the imported name is
+    # the exporter's contract and stays silent
+    assert only(src, "unbound-axis") == [8]
+
+
+def test_unbound_axis_suppression():
+    src = '''
+    from jax import lax
+
+    def local_mean(x):
+        return lax.pmean(x, "ad-hoc")  # jaxlint: disable=unbound-axis — fixture
+    '''
+    assert only(src, "unbound-axis") == []
+
+
+# ---------------------------------------------------------------------------
+# collective-in-divergent-branch
+# ---------------------------------------------------------------------------
+
+def test_divergent_branch_flags_collective_under_tracer_if():
+    src = '''
+    from jax import lax
+
+    def train_step(params, grads, loss):
+        if loss > 3.0:
+            grads = lax.psum(grads, "data")
+        return grads
+    '''
+    assert only(src, "collective-in-divergent-branch") == [6]
+
+
+def test_divergent_branch_post_psum_decision_passes():
+    src = '''
+    from jax import lax
+
+    def train_step(params, grads, loss):
+        gloss = lax.psum(loss, "data")
+        if gloss > 3.0:
+            grads = lax.psum(grads, "data")
+        return grads
+    '''
+    # the branch decision flowed THROUGH a collective: replica-uniform,
+    # exactly the PR 5 guard-skip pattern
+    assert only(src, "collective-in-divergent-branch") == []
+
+
+def test_divergent_branch_taint_propagates_through_locals():
+    src = '''
+    from jax import lax
+
+    def train_step(params, batch):
+        local_score = batch * 2.0
+        while local_score > 0:
+            params = lax.pmean(params, "data")
+        return params
+    '''
+    assert only(src, "collective-in-divergent-branch") == [7]
+
+
+def test_divergent_branch_only_in_hot_functions():
+    src = '''
+    from jax import lax
+
+    def host_driver(flag, grads):
+        if flag:
+            return lax.psum(grads, "data")
+        return grads
+    '''
+    assert only(src, "collective-in-divergent-branch") == []
+
+
+def test_divergent_branch_suppression():
+    src = '''
+    from jax import lax
+
+    def train_step(params, loss):
+        if loss > 3.0:
+            params = lax.pmean(params, "data")  # jaxlint: disable=collective-in-divergent-branch — fixture
+        return params
+    '''
+    assert only(src, "collective-in-divergent-branch") == []
+
+
+# ---------------------------------------------------------------------------
+# donation-across-collective
+# ---------------------------------------------------------------------------
+
+def test_donation_across_collective_flags_builder_read_after():
+    src = '''
+    from deeplearning4j_tpu.parallel.sharded_fit import build_scanned_epochs
+
+    def fit(step, mesh, params, ustate, batches, key):
+        fn = build_scanned_epochs(step, mesh, label="fit")
+        new_p, new_u, scores, skips = fn(params, ustate, batches, key, 0, 1)
+        return params, scores
+    '''
+    assert only(src, "donation-across-collective") == [7]
+    assert only(src, "use-after-donate") == []   # no double report
+
+
+def test_donation_across_collective_rebind_and_donate_false_pass():
+    src = '''
+    from deeplearning4j_tpu.parallel.sharded_fit import (
+        build_scanned_epochs, build_sharded_step)
+
+    def fit(step, mesh, params, ustate, batch, key):
+        fn = build_sharded_step(step, mesh, label="fit")
+        params, ustate, score, skip = fn(params, ustate, batch, key, 0)
+        fn2 = build_sharded_step(step, mesh, label="eval", donate=False)
+        out = fn2(params, ustate, batch, key, 1)
+        return params, out
+    '''
+    assert only(src, "donation-across-collective") == []
+
+
+def test_donation_across_collective_resolves_local_factories():
+    src = '''
+    from deeplearning4j_tpu.compat import shard_map
+    from deeplearning4j_tpu.runtime import compile_cache
+
+    def make_round(body, mesh, specs):
+        sharded = shard_map(body, mesh=mesh, in_specs=specs,
+                            out_specs=specs)
+        return compile_cache.cached_jit(sharded, label="round",
+                                        donate_argnums=(0,))
+
+    def drive(body, mesh, specs, state, batch):
+        fn = make_round(body, mesh, specs)
+        out = fn(state, batch)
+        return state
+    '''
+    assert only(src, "donation-across-collective") == [14]
+
+
+def test_donation_across_collective_suppression():
+    src = '''
+    from deeplearning4j_tpu.parallel.sharded_fit import build_sharded_step
+
+    def fit(step, mesh, params, ustate, batch, key):
+        fn = build_sharded_step(step, mesh, label="fit")
+        new_p, new_u, score, skip = fn(params, ustate, batch, key, 0)
+        return params  # jaxlint: disable=donation-across-collective — fixture
+    '''
+    assert only(src, "donation-across-collective") == []
+
+
+# ---------------------------------------------------------------------------
+# unlocked-shared-mutation
+# ---------------------------------------------------------------------------
+
+def test_unlocked_mutation_flags_public_side_without_lock():
+    src = '''
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []
+            self._thread = threading.Thread(target=self._loop)
+
+        def submit(self, x):
+            self._pending.append(x)
+
+        def _loop(self):
+            with self._lock:
+                self._pending.pop(0)
+    '''
+    assert only(src, "unlocked-shared-mutation") == [11]
+
+
+def test_unlocked_mutation_common_lock_and_init_pass():
+    src = '''
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._pending = []          # pre-thread: exempt
+            self._thread = threading.Thread(target=self._loop)
+
+        def submit(self, x):
+            with self._cv:
+                self._pending.append(x)
+
+        def close(self):
+            with self._cv:
+                self._open = False
+
+        def _loop(self):
+            with self._cv:
+                self._pending.pop(0)
+    '''
+    assert only(src, "unlocked-shared-mutation") == []
+
+
+def test_unlocked_mutation_resolves_targets_transitively():
+    """Thread(target=self._run) where _run delegates via self._drain():
+    the callee's mutations are worker-side too."""
+    src = '''
+    import threading
+
+    class Runner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            threading.Thread(target=self._run).start()
+
+        def push(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def _run(self):
+            self._drain()
+
+        def _drain(self):
+            self._items.clear()
+    '''
+    assert only(src, "unlocked-shared-mutation") == [18]
+
+
+def test_unlocked_mutation_sees_threads_built_in_comprehensions():
+    """The DistributedRunner spelling: workers spawned in a list
+    comprehension still resolve as thread targets."""
+    src = '''
+    import threading
+
+    class Pool:
+        def __init__(self, n):
+            self._lock = threading.Lock()
+            self._done = []
+            self.workers = [threading.Thread(target=self._work)
+                            for _ in range(n)]
+
+        def collect(self):
+            self._done.pop()
+
+        def _work(self):
+            with self._lock:
+                self._done.append(1)
+    '''
+    assert only(src, "unlocked-shared-mutation") == [12]
+
+
+def test_unlocked_mutation_lock_free_classes_are_out_of_scope():
+    """No lock field to seed from => the class is lock-free by design
+    (queues/events); the rule stays silent rather than guessing."""
+    src = '''
+    import threading
+
+    class Flag:
+        def __init__(self):
+            self._stop = threading.Event()
+            self._last = None
+            threading.Thread(target=self._run).start()
+
+        def update(self, x):
+            self._last = x
+
+        def _run(self):
+            self._last = None
+    '''
+    assert only(src, "unlocked-shared-mutation") == []
+
+
+def test_unlocked_mutation_suppression():
+    src = '''
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._hint = 0
+            self._thread = threading.Thread(target=self._loop)
+
+        def note(self, x):
+            self._hint = x  # jaxlint: disable=unlocked-shared-mutation — monotonic hint, benign race
+
+        def _loop(self):
+            with self._lock:
+                self._hint = 0
+    '''
+    assert only(src, "unlocked-shared-mutation") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_under_lock_flags_result_join_queue():
+    src = '''
+    import queue
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+            self._thread = threading.Thread(target=self._run)
+
+        def flush(self, fut):
+            with self._lock:
+                fut.result()
+
+        def stop(self):
+            with self._lock:
+                self._thread.join()
+
+        def pull(self):
+            with self._lock:
+                return self._q.get()
+
+        def _run(self):
+            pass
+    '''
+    assert only(src, "blocking-under-lock") == [13, 17, 21]
+
+
+def test_blocking_under_lock_nonblocking_forms_pass():
+    src = '''
+    import queue
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition()
+            self._q = queue.Queue()
+            self._thread = threading.Thread(target=self._run)
+
+        def pull(self):
+            with self._lock:
+                return self._q.get(block=False)
+
+        def wait_ready(self):
+            with self._cv:
+                self._cv.wait()         # releases the held condition
+
+        def outside(self, fut):
+            with self._lock:
+                x = 1
+            fut.result()
+            self._thread.join()
+
+        def _run(self):
+            pass
+    '''
+    assert only(src, "blocking-under-lock") == []
+
+
+def test_blocking_under_lock_reentrant_lock_cases():
+    src = '''
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rlock = threading.RLock()
+            self._thread = threading.Thread(target=self._run)
+
+        def bad(self):
+            with self._lock:
+                with self._lock:
+                    pass
+
+        def fine(self):
+            with self._rlock:
+                with self._rlock:
+                    pass
+
+        def nested_distinct(self):
+            with self._lock:
+                with self._rlock:
+                    pass
+
+        def _run(self):
+            pass
+    '''
+    assert only(src, "blocking-under-lock") == [12]
+
+
+def test_blocking_under_lock_block_until_ready_and_sem():
+    src = '''
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._sem = threading.BoundedSemaphore(2)
+            self._thread = threading.Thread(target=self._run)
+
+        def sync(self, out):
+            with self._lock:
+                out.block_until_ready()
+
+        def reserve(self):
+            with self._lock:
+                self._sem.acquire()
+
+        def _run(self):
+            pass
+    '''
+    assert only(src, "blocking-under-lock") == [12, 16]
+
+
+def test_blocking_under_lock_module_level_locks_count():
+    src = '''
+    import threading
+
+    _LOCK = threading.Lock()
+
+    def drain(t):
+        t = threading.Thread(target=print)
+        with _LOCK:
+            t.join()
+    '''
+    assert only(src, "blocking-under-lock") == [9]
+
+
+def test_blocking_under_lock_suppression():
+    src = '''
+    import queue
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+            self._thread = threading.Thread(target=self._run)
+
+        def push(self, job):
+            with self._lock:
+                self._q.put(job)  # jaxlint: disable=blocking-under-lock — unbounded queue, never blocks
+
+        def _run(self):
+            pass
+    '''
+    assert only(src, "blocking-under-lock") == []
+
+
+# ---------------------------------------------------------------------------
+# impure-signal-handler
+# ---------------------------------------------------------------------------
+
+def test_signal_handler_flags_logging_metrics_locks():
+    src = '''
+    import signal
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def on_term(signum, frame):
+        log.warning("preempted")
+        checkpoint_metrics.note("preemptions")
+        print("bye")
+
+    signal.signal(signal.SIGTERM, on_term)
+    '''
+    assert only(src, "impure-signal-handler") == [8, 9, 10]
+
+
+def test_signal_handler_flag_only_body_passes():
+    src = '''
+    import signal
+    import threading
+
+    FLAG = threading.Event()
+
+    def on_term(signum, frame):
+        if FLAG.is_set():
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        FLAG.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    '''
+    assert only(src, "impure-signal-handler") == []
+
+
+def test_signal_handler_resolves_bound_method_registration():
+    """The PreemptionGuard install form: signal.signal(s, self._handler)
+    resolves to the class method, and the check follows self.* calls
+    transitively."""
+    src = '''
+    import signal
+    import threading
+
+    class Guard:
+        def __init__(self):
+            self._requested = threading.Event()
+            self._book_lock = threading.Lock()
+
+        def _handler(self, signum, frame):
+            self.request()
+
+        def request(self):
+            with self._book_lock:
+                self._requested.set()
+
+        def install(self):
+            for s in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(s, self._handler)
+    '''
+    assert only(src, "impure-signal-handler") == [14]
+
+
+def test_signal_handler_guard_subclass_hooks_are_handlers():
+    """A PreemptionGuard subclass overriding request() is checked even
+    with no visible signal.signal call — the base installs it."""
+    src = '''
+    from deeplearning4j_tpu.runtime.resilience import PreemptionGuard
+
+    class ChattyGuard(PreemptionGuard):
+        def request(self):
+            telemetry.event("resilience.preempted")
+    '''
+    assert only(src, "impure-signal-handler") == [6]
+
+
+def test_signal_handler_unresolvable_and_unregistered_pass():
+    src = '''
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def not_a_handler(signum, frame):
+        log.warning("this function is never registered")
+    '''
+    assert only(src, "impure-signal-handler") == []
+
+
+def test_signal_handler_suppression():
+    src = '''
+    import signal
+
+    def on_term(signum, frame):
+        print("bye")  # jaxlint: disable=impure-signal-handler — fixture
+
+    signal.signal(signal.SIGTERM, on_term)
+    '''
+    assert only(src, "impure-signal-handler") == []
+
+
+def test_repo_preemption_guard_handler_is_flag_only():
+    """The PR 8 contract, machine-checked against the REAL source: the
+    guard's handler chain carries no locks/logging/metrics."""
+    src = (REPO_ROOT / "deeplearning4j_tpu" / "runtime"
+           / "resilience.py").read_text()
+    flagged = [f for f in check_source(
+        src, "deeplearning4j_tpu/runtime/resilience.py")
+        if f.rule == "impure-signal-handler"]
+    assert flagged == []
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_unlocked_mutation_resolves_timer_and_positional_targets():
+    """Timer spells its callable ``function``/args[1] (args[0] is the
+    interval), and Thread's args[0] is ``group`` — both positional
+    forms must resolve (regression: args[0] was read for both)."""
+    src = '''
+    import threading
+
+    class Flusher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._buf = []
+            self._timer = threading.Timer(5.0, self._flush)
+
+        def add(self, x):
+            self._buf.append(x)
+
+        def _flush(self):
+            with self._lock:
+                self._buf.clear()
+    '''
+    assert only(src, "unlocked-shared-mutation") == [11]
+    src2 = src.replace("threading.Timer(5.0, self._flush)",
+                       "threading.Timer(5.0, function=self._flush)")
+    assert only(src2, "unlocked-shared-mutation") == [11]
+    src3 = '''
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._buf = []
+            self._t = threading.Thread(None, self._flush)
+
+        def add(self, x):
+            self._buf.append(x)
+
+        def _flush(self):
+            with self._lock:
+                self._buf.clear()
+    '''
+    assert only(src3, "unlocked-shared-mutation") == [11]
+
+
+def test_unbound_axis_ignores_unrelated_scopes_and_resolves_for_loops():
+    """A same-named string local to an UNRELATED function must not
+    resolve another function's axis variable, and a literal for-loop
+    binding over vocabulary axes is bound (regression: resolution
+    walked every Assign in the module)."""
+    src = '''
+    from jax import lax
+
+    def plot_helper():
+        axis = "y"
+        return axis
+
+    def train_step(x):
+        for axis in ("data", "model"):
+            x = lax.psum(x, axis)
+        return x
+    '''
+    assert only(src, "unbound-axis") == []
+    # ...while a for-loop over a NON-vocabulary literal still flags
+    src2 = '''
+    from jax import lax
+
+    def train_step(x):
+        for axis in ("dta",):
+            x = lax.psum(x, axis)
+        return x
+    '''
+    assert only(src2, "unbound-axis") == [6]
+
+
+def test_divergent_branch_static_counters_stay_clean():
+    """A trace-static Python counter (``depth += 1``) must not taint —
+    the branch is identical on every replica (regression: AugAssign
+    tainted unconditionally)."""
+    src = '''
+    from jax import lax
+
+    def train_step(params, grads):
+        depth = 0
+        depth += 1
+        if depth % 2 == 0:
+            grads = lax.psum(grads, "data")
+        return grads
+    '''
+    assert only(src, "collective-in-divergent-branch") == []
+    # ...but augmenting WITH a per-replica operand still taints
+    src2 = '''
+    from jax import lax
+
+    def train_step(params, grads, loss):
+        acc = 0.0
+        acc += loss
+        if acc > 1.0:
+            grads = lax.psum(grads, "data")
+        return grads
+    '''
+    assert only(src2, "collective-in-divergent-branch") == [8]
+
+
+def test_refused_save_does_not_leak_in_flight_gauge():
+    """AsyncCheckpointer.save() losing the race to close() after
+    staging must bring the in-flight gauge back down (regression:
+    note_staged's increment had no matching decrement on that path)."""
+    import importlib.util
+    spec = importlib.util.find_spec("jax")
+    if spec is None:
+        pytest.skip("jax unavailable")
+    import numpy as np
+    from deeplearning4j_tpu.runtime.checkpoint import (
+        AsyncCheckpointer, CheckpointManager)
+    from deeplearning4j_tpu.runtime.metrics import checkpoint_metrics
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ck = AsyncCheckpointer(CheckpointManager(d))
+        ck.save(0, {"w": np.ones((4,), np.float32)})
+        ck.close(timeout=30)
+        before = checkpoint_metrics.snapshot()["in_flight"]
+        with pytest.raises(RuntimeError, match="closed"):
+            ck.save(1, {"w": np.ones((4,), np.float32)})
+        after = checkpoint_metrics.snapshot()["in_flight"]
+        assert after == before

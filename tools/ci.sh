@@ -18,11 +18,15 @@ cd "$REPO"
 # fast tier skips the slow files.
 # Static analysis first: jaxlint machine-checks the JAX invariants
 # (engine-routed jits, donation discipline, compat-only shard_map, pure
-# host-sync-free steps) in milliseconds — no point booting jax for the
-# test tier if the tree already violates them.  Non-zero on any finding
-# not in tools/jaxlint/baseline.json.
+# host-sync-free steps, SPMD collective discipline, thread/lock/signal
+# contracts) in milliseconds — no point booting jax for the test tier
+# if the tree already violates them.  Non-zero on any finding not in
+# tools/jaxlint/baseline.json.  --format json emits file/line/rule/
+# severity records so a CI front-end can render findings as inline
+# annotations; the exit code contract is identical to text mode.
 echo "[ci] jaxlint"
-python -m tools.jaxlint deeplearning4j_tpu bench.py tools || exit 1
+python -m tools.jaxlint deeplearning4j_tpu bench.py tools \
+  --format json || exit 1
 
 # Telemetry overhead gate: a tracer-off AND a tracer-on fit must show
 # compile_delta_since_mark == 0 (the span tracer is host-side only and

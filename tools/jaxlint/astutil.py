@@ -4,6 +4,20 @@ Three rules (host-sync-in-hot-path, impure-jit, use-after-donate) need
 the same answers — which callables end up traced by XLA, which of their
 parameters are static, and which names a function binds locally — so
 the answers live here once.
+
+Two further layers serve the PR 10 rule families:
+
+- collective analysis (``collective_axis_expr``, ``bound_axis_names``,
+  ``resolve_axis_literal``) — which ``psum``/``pmean``/... calls name
+  which mesh axes, and which axis names the module actually binds;
+- class-scoped concurrency analysis (``class_infos`` → ``ClassInfo``) —
+  per-class lock/queue/thread attribute typing, thread-target
+  resolution through ``Thread(target=self._worker)`` and bare method
+  references, the self-call closure that turns a thread target into the
+  full worker-method set, and lexical held-lock regions
+  (``lock_regions``).  This is the framework step that makes
+  thread-safety rules cheap: a rule reads the ``ClassInfo`` instead of
+  re-deriving who runs on which thread under which lock.
 """
 
 from __future__ import annotations
@@ -274,3 +288,421 @@ def enclosing_function_params(tree: ast.Module
 
     visit(tree, None)
     return owner
+
+
+# ---------------------------------------------------------------------------
+# collective analysis (unbound-axis, collective-in-divergent-branch)
+# ---------------------------------------------------------------------------
+
+#: SPMD collectives whose axis argument names a mesh/pmap axis.  The
+#: leaf spelling is what matters: ``lax.psum``, ``jax.lax.psum`` and the
+#: repo's own ``parallel/collectives.py`` wrappers all end in these.
+COLLECTIVE_FNS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                  "psum_scatter", "all_to_all", "ppermute", "pshuffle",
+                  "axis_index"}
+
+#: the package-wide axis vocabulary (parallel/mesh.py ALL_AXES).  An
+#: axis literal outside this set must be bound by an explicit
+#: pmap/vmap/shard_map ``axis_name`` somewhere in the module or the
+#: collective is a silent no-op / NameError waiting for eager mode.
+MESH_AXIS_VOCAB = {"data", "model", "pipe", "seq", "expert"}
+
+#: callables whose ``axis_name``/``axis_names`` kwarg BINDS an axis
+_AXIS_BINDERS = {"pmap", "vmap", "xmap", "shard_map", "Mesh",
+                 "make_mesh"}
+
+
+def is_collective_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name is not None \
+        and name.rsplit(".", 1)[-1] in COLLECTIVE_FNS
+
+
+def collective_axis_expr(call: ast.Call) -> Optional[ast.AST]:
+    """The axis-NAME expression of a collective call: the ``axis_name``
+    keyword if present, else the conventional positional slot
+    (``psum(x, axis)`` — slot 1; ``axis_index(axis)`` — slot 0).  The
+    integer ``axis=`` kwarg of ``all_gather`` is a gather DIMENSION,
+    not an axis name, and is never returned."""
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    name = dotted_name(call.func) or ""
+    leaf = name.rsplit(".", 1)[-1]
+    pos = 0 if leaf == "axis_index" else 1
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def bound_axis_names(tree: ast.Module) -> Set[str]:
+    """Axis names the module BINDS beyond the mesh vocabulary: literal
+    ``axis_name=``/``axis_names=`` kwargs of pmap/vmap/xmap/shard_map/
+    Mesh calls, plus literal Mesh axis tuples (``Mesh(devs, ("x",))``)."""
+    out: Set[str] = set(MESH_AXIS_VOCAB)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None or name.rsplit(".", 1)[-1] not in _AXIS_BINDERS:
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                out |= _literal_strs(kw.value)
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("Mesh", "make_mesh") and len(node.args) > 1:
+            out |= _literal_strs(node.args[1])
+    return out
+
+
+def _imported_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                out.add((a.asname or a.name).split(".")[0])
+    return out
+
+
+def _default_for_param(fn_or_lambda, name: str) -> Optional[ast.AST]:
+    """The default-value expression for parameter ``name``, if any."""
+    a = fn_or_lambda.args
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        if p.arg == name:
+            return d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg == name and d is not None:
+            return d
+    return None
+
+
+def resolve_axis_literal(expr: ast.AST, tree: ast.Module,
+                         enclosing: List[ast.AST]) -> Optional[Set[str]]:
+    """Best-effort resolution of an axis expression to its literal
+    string value(s).  ``enclosing`` is the chain of function/lambda
+    nodes around the call site, innermost last.  Returns None when the
+    value cannot be known statically (a parameter without a literal
+    default, an imported constant, an attribute read) — unresolvable
+    axes are the CALLER's contract, not this module's."""
+    strs = _literal_strs(expr)
+    if strs:
+        return strs
+    if not isinstance(expr, ast.Name):
+        return None
+    name = expr.id
+    if name in _imported_names(tree):
+        return None                 # bound elsewhere; trust the exporter
+    # innermost enclosing function that declares it as a parameter wins
+    for fn in reversed(enclosing):
+        a = fn.args
+        params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if name in params:
+            d = _default_for_param(fn, name)
+            if d is not None:
+                got = _literal_strs(d)
+                return got or None
+            return None
+    # a single unambiguous literal binding VISIBLE from the call site:
+    # module top-level plus the enclosing function scopes — a same-named
+    # variable local to an unrelated function must not leak in
+    values: Set[str] = set()
+    opaque = False
+
+    def _own_scope_nodes(body):
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue        # nested scope: its bindings aren't ours
+            for c in ast.iter_child_nodes(n):
+                stack.append(c)
+
+    scopes = [list(tree.body)]
+    scopes += [list(fn.body) for fn in enclosing if hasattr(fn, "body")
+               and isinstance(fn.body, list)]
+    for body in scopes:
+        for node in _own_scope_nodes(body):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == name
+                            for t in node.targets):
+                got = _literal_strs(node.value)
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                got = _literal_strs(node.value)
+            elif isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                # ``for axis in ("data", "model")`` binds each element
+                got = _literal_strs(node.iter)
+            else:
+                continue
+            if got:
+                values |= got
+            else:
+                opaque = True
+    if values and not opaque:
+        return values
+    return None
+
+
+def enclosing_chain(tree: ast.Module) -> Dict[int, List[ast.AST]]:
+    """id(node) -> the function/lambda nodes lexically enclosing it,
+    outermost first.  The collective rules resolve parameter defaults
+    against this chain."""
+    out: Dict[int, List[ast.AST]] = {}
+
+    def visit(node: ast.AST, stack: List[ast.AST]) -> None:
+        out[id(node)] = list(stack)
+        nxt = stack + [node] if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+            else stack
+        for child in ast.iter_child_nodes(node):
+            visit(child, nxt)
+
+    visit(tree, [])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# class-scoped concurrency analysis (unlocked-shared-mutation,
+# blocking-under-lock, impure-signal-handler)
+# ---------------------------------------------------------------------------
+
+#: threading constructors, by leaf name, bucketed by how a rule must
+#: treat an attribute built from them
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_RLOCK_CTORS = {"RLock"}
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_THREAD_CTORS = {"Thread", "Timer"}
+_EVENT_CTORS = {"Event"}
+_SEM_CTORS = {"Semaphore", "BoundedSemaphore"}
+
+
+def _ctor_leaf(value: ast.AST) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name is not None:
+            return name.rsplit(".", 1)[-1]
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``"X"`` (else None)."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class ClassInfo:
+    """Everything the concurrency rules need to know about one class."""
+    node: ast.ClassDef
+    methods: Dict[str, FunctionNode] = field(default_factory=dict)
+    lock_attrs: Set[str] = field(default_factory=set)
+    rlock_attrs: Set[str] = field(default_factory=set)
+    cond_attrs: Set[str] = field(default_factory=set)
+    queue_attrs: Set[str] = field(default_factory=set)
+    thread_attrs: Set[str] = field(default_factory=set)
+    event_attrs: Set[str] = field(default_factory=set)
+    sem_attrs: Set[str] = field(default_factory=set)
+    #: method names passed as a Thread/Timer ``target=`` (directly or
+    #: as a bare ``self.m`` reference handed to a spawner)
+    thread_targets: Set[str] = field(default_factory=set)
+    #: thread_targets closed under the self-call graph: every method a
+    #: worker thread can reach via ``self.m()``
+    worker_methods: Set[str] = field(default_factory=set)
+
+    def owns_thread(self) -> bool:
+        return bool(self.thread_targets)
+
+
+def _self_call_edges(fn: FunctionNode) -> Set[str]:
+    """Names of methods this method calls as ``self.m(...)``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            attr = self_attr(node.func)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def class_infos(tree: ast.Module) -> List[ClassInfo]:
+    """One ``ClassInfo`` per class in the module (nested classes
+    included), with attribute typing seeded from every ``self.X = ctor``
+    assignment anywhere in the class body and thread targets resolved
+    through ``Thread(target=self.m)`` keyword and positional forms."""
+    infos: List[ClassInfo] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        info = ClassInfo(cls)
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[stmt.name] = stmt
+        buckets = ((_LOCK_CTORS, info.lock_attrs),
+                   (_RLOCK_CTORS, info.rlock_attrs),
+                   ({"Condition"}, info.cond_attrs),
+                   (_QUEUE_CTORS, info.queue_attrs),
+                   (_THREAD_CTORS, info.thread_attrs),
+                   (_EVENT_CTORS, info.event_attrs),
+                   (_SEM_CTORS, info.sem_attrs))
+        for node in ast.walk(cls):
+            # attribute typing: self.X = threading.Lock() / queue.Queue()
+            if isinstance(node, ast.Assign):
+                leaf = _ctor_leaf(node.value)
+                if leaf is not None:
+                    for tgt in node.targets:
+                        attr = self_attr(tgt)
+                        if attr is None:
+                            continue
+                        for ctors, bucket in buckets:
+                            if leaf in ctors:
+                                bucket.add(attr)
+            # thread-target resolution: Thread(target=self.m, ...) /
+            # Timer(interval, self.m) in ANY expression position —
+            # assignments, comprehensions
+            # (``[Thread(target=self._worker_loop) for ...]``),
+            # bare ``Thread(...).start()`` chains.  The positional slot
+            # is ctor-specific: Thread's args[0] is ``group`` and
+            # Timer's is ``interval`` — the callable rides at index 1
+            # for both (Timer spells its keyword ``function``).
+            ctor = _ctor_leaf(node) if isinstance(node, ast.Call) else None
+            if ctor in _THREAD_CTORS:
+                target_kw = "function" if ctor == "Timer" else "target"
+                for kw in node.keywords:
+                    if kw.arg == target_kw:
+                        attr = self_attr(kw.value)
+                        if attr is not None:
+                            info.thread_targets.add(attr)
+                if len(node.args) > 1:
+                    attr = self_attr(node.args[1])
+                    if attr is not None:
+                        info.thread_targets.add(attr)
+        # close thread targets over the self-call graph
+        edges = {name: _self_call_edges(fn)
+                 for name, fn in info.methods.items()}
+        seen = set()
+        frontier = [t for t in info.thread_targets if t in info.methods]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            for callee in edges.get(m, ()):
+                if callee in info.methods and callee not in seen:
+                    frontier.append(callee)
+        info.worker_methods = seen
+        infos.append(info)
+    return infos
+
+
+def _with_lock_names(stmt: ast.With, lockish: Set[str],
+                     local_locks: Set[str]) -> Set[str]:
+    """Lock identifiers a ``with`` statement acquires: ``self.X`` where
+    X is a known lock/condition attr (returned as ``"self.X"``), or a
+    bare local name known to hold a lock (returned as-is)."""
+    held: Set[str] = set()
+    for item in stmt.items:
+        expr = item.context_expr
+        attr = self_attr(expr)
+        if attr is not None and attr in lockish:
+            held.add(f"self.{attr}")
+        elif isinstance(expr, ast.Name) and expr.id in local_locks:
+            held.add(expr.id)
+    return held
+
+
+def lock_regions(fn: FunctionNode, lockish: Set[str],
+                 module_locks: Optional[Set[str]] = None
+                 ) -> Dict[int, Set[str]]:
+    """id(node) -> the set of lock identifiers lexically HELD there.
+
+    ``lockish`` is the class's lock+condition attribute names;
+    ``module_locks`` adds module-level lock variables (``with _LOCK:``).
+    Nested function bodies are excluded — a closure defined under a
+    lock does not run under it."""
+    local_locks = set(module_locks or ())
+    # locals assigned from a lock ctor inside this function body
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and _ctor_leaf(node.value) in (_LOCK_CTORS | {"Condition"}):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    local_locks.add(tgt.id)
+    out: Dict[int, Set[str]] = {}
+
+    def visit(node: ast.AST, held: Set[str], top: bool) -> None:
+        out[id(node)] = set(held)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and not top:
+            return                      # closures don't inherit the lock
+        nxt = held
+        if isinstance(node, ast.With):
+            nxt = held | _with_lock_names(node, lockish, local_locks)
+        for child in ast.iter_child_nodes(node):
+            visit(child, nxt, False)
+
+    visit(fn, set(), True)
+    return out
+
+
+def module_lock_names(tree: ast.Module) -> Set[str]:
+    """Module-level ``NAME = threading.Lock()``-style bindings."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and _ctor_leaf(stmt.value) in (_LOCK_CTORS | {"Condition"}):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# callable resolution (impure-signal-handler, donation factories)
+# ---------------------------------------------------------------------------
+
+def module_functions(tree: ast.Module) -> Dict[str, FunctionNode]:
+    """Top-level (module-scope) function defs by name."""
+    return {stmt.name: stmt for stmt in tree.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def resolve_callable(expr: ast.AST, tree: ast.Module,
+                     cls: Optional[ast.ClassDef]) -> Optional[FunctionNode]:
+    """Resolve a callable REFERENCE to its definition, where statically
+    possible: a bare name -> module-level def, ``self.m`` -> method of
+    the enclosing class.  Anything else (imported callables, attributes
+    of other objects) returns None."""
+    if isinstance(expr, ast.Name):
+        return module_functions(tree).get(expr.id)
+    attr = self_attr(expr)
+    if attr is not None and cls is not None:
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == attr:
+                return stmt
+    return None
+
+
+def enclosing_class(tree: ast.Module) -> Dict[int, ast.ClassDef]:
+    """id(node) -> nearest enclosing ClassDef."""
+    out: Dict[int, ast.ClassDef] = {}
+
+    def visit(node: ast.AST, current: Optional[ast.ClassDef]) -> None:
+        if current is not None:
+            out[id(node)] = current
+        nxt = node if isinstance(node, ast.ClassDef) else current
+        for child in ast.iter_child_nodes(node):
+            visit(child, nxt)
+
+    visit(tree, None)
+    return out

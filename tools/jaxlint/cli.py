@@ -2,11 +2,18 @@
 
 Exit codes: 0 = clean (or every finding baselined/suppressed),
 1 = at least one non-baselined finding, 2 = usage error.
+
+``--format json`` emits one machine-readable object (file/line/col/
+rule/severity/family/message records plus the summary) on stdout with
+the SAME exit codes, so CI renders findings as annotations instead of
+scraping text; ``--jobs N`` fans per-file analysis out over N workers
+with byte-identical output ordering.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 from typing import Optional, Sequence
@@ -48,12 +55,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="result cache at FILE (implies --cache)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the registered rules and exit")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    dest="fmt",
+                    help="finding output format (default: %(default)s); "
+                         "json emits file/line/rule/severity records for "
+                         "CI annotation rendering, same exit codes")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="analyze N files concurrently (files are "
+                         "independent; output order is deterministic "
+                         "regardless of N)")
     args = ap.parse_args(argv)
 
+    if args.jobs < 1:
+        print(f"error: --jobs must be >= 1, got {args.jobs}",
+              file=sys.stderr)
+        return 2
+
     if args.list_rules:
+        # grouped by family so the two PR 10 rule families read as the
+        # units they ship as
+        by_family: dict = {}
         for name in sorted(REGISTRY):
-            rule = REGISTRY[name]
-            print(f"{name:24s} [{rule.severity}] {rule.description}")
+            by_family.setdefault(REGISTRY[name].family, []).append(name)
+        for family in sorted(by_family):
+            print(f"{family}:")
+            for name in by_family[family]:
+                rule = REGISTRY[name]
+                print(f"  {name:30s} [{rule.severity}] "
+                      f"{rule.description}")
         return 0
 
     select = [s.strip() for s in args.select.split(",") if s.strip()] \
@@ -61,7 +90,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cache_path = args.cache_file if args.cache_file is not None \
         else (DEFAULT_CACHE if args.cache else None)
     try:
-        findings = run_paths(args.paths, select, cache_path=cache_path)
+        findings = run_paths(args.paths, select, cache_path=cache_path,
+                             jobs=args.jobs)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
@@ -94,6 +124,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"error: baseline {args.baseline}: {e}", file=sys.stderr)
         return 2
     new, grandfathered = baseline_mod.apply(findings, entries)
+    errors = sum(1 for f in new if f.severity == "error")
+    warnings = len(new) - errors
+
+    if args.fmt == "json":
+        # one object, not a line stream: CI reads it with a single
+        # json.load and renders per-record annotations
+        print(json.dumps({
+            "ok": not new,
+            "errors": errors,
+            "warnings": warnings,
+            "baselined": len(grandfathered),
+            "rules": len(REGISTRY) if select is None else len(select),
+            "findings": [{
+                "file": f.path, "line": f.line, "col": f.col,
+                "rule": f.rule, "severity": f.severity,
+                "family": getattr(REGISTRY.get(f.rule), "family",
+                                  "framework"),
+                "message": f.message,
+            } for f in new],
+        }, indent=2))
+        return 1 if new else 0
 
     for f in new:
         print(f.render())
@@ -102,8 +153,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{'' if len(grandfathered) == 1 else 's'} not shown; "
               "see --baseline)")
     if new:
-        errors = sum(1 for f in new if f.severity == "error")
-        warnings = len(new) - errors
         print(f"jaxlint: {errors} error(s), {warnings} warning(s)")
         return 1
     print(f"jaxlint: ok ({len(REGISTRY) if select is None else len(select)}"

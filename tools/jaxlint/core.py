@@ -55,12 +55,14 @@ class Finding:
 
 
 class Rule:
-    """Base class: subclass, set ``name``/``severity``/``description``,
-    implement ``check``.  Register with ``@register``."""
+    """Base class: subclass, set ``name``/``severity``/``description``
+    (and ``family`` for non-tracing rules), implement ``check``.
+    Register with ``@register``."""
 
     name: str = ""
     severity: str = "error"
     description: str = ""
+    family: str = "tracing"    # "tracing" | "collective" | "concurrency"
 
     def applies_to(self, posix_path: str) -> bool:
         """Path filter (POSIX string).  Default: every file."""
@@ -236,31 +238,88 @@ def check_source(source: str, posix_path: str,
 _ANALYZER_FP: Optional[str] = None
 
 
-def _analyzer_fingerprint() -> str:
-    """Hash of the analyzer's OWN sources — part of every cache key so a
-    rule fix invalidates cached results for unchanged files too."""
-    global _ANALYZER_FP
-    if _ANALYZER_FP is None:
-        import hashlib
+def _analyzer_fingerprint(root: Optional[Path] = None) -> str:
+    """Hash of the analyzer's OWN sources — part of every cache key so
+    that editing ANY of them (rule modules, but also the shared
+    framework: ``astutil.py``, ``core.py``, ``cli.py``, ...) invalidates
+    cached results for unchanged target files too.  A fix to the
+    class-scoped lock tracking must re-lint every file, not only the
+    ones whose text changed.
+
+    ``root`` overrides the hashed package directory (tests point it at
+    a scratch copy to prove framework edits change the fingerprint);
+    the default — this package — is computed once per process.
+    """
+    import hashlib
+
+    def compute(pkg: Path) -> str:
         h = hashlib.sha256()
-        pkg = Path(__file__).resolve().parent
         for f in sorted(pkg.rglob("*.py")):
             if "__pycache__" not in f.parts:
-                h.update(f.as_posix().encode())
+                # path RELATIVE to the package, so the fingerprint only
+                # depends on the analyzer's content, not where the
+                # checkout lives
+                h.update(f.relative_to(pkg).as_posix().encode())
+                h.update(b"\x00")
                 h.update(f.read_bytes())
-        _ANALYZER_FP = h.hexdigest()
+        return h.hexdigest()
+
+    if root is not None:
+        return compute(Path(root))
+    global _ANALYZER_FP
+    if _ANALYZER_FP is None:
+        _ANALYZER_FP = compute(Path(__file__).resolve().parent)
     return _ANALYZER_FP
 
 
+def _lint_file(path: Path, rules: Optional[Sequence[Rule]],
+               rule_names: Sequence[str], cache: Optional[dict]
+               ) -> Tuple[str, List[Finding], Optional[str], bool]:
+    """One file's worth of work: returns (posix path, findings, cache
+    key or None, hit) — pure w.r.t. shared state, so files can run on
+    any worker in any order."""
+    posix = path.as_posix()
+    try:
+        source = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return posix, [Finding("parse-error", posix, 1, 0,
+                               f"unreadable: {e}", "error")], None, False
+    key = None
+    if cache is not None:
+        import hashlib
+        key = hashlib.sha256(
+            (_analyzer_fingerprint() + "\x00"
+             + "\x00".join(rule_names) + "\x00" + source)
+            .encode("utf-8")).hexdigest()
+        hit = cache.get(posix)
+        if hit is not None and hit.get("key") == key:
+            return posix, [Finding(**f) for f in hit["findings"]], \
+                key, True
+    try:
+        file_findings = check_source(source, posix, rules)
+    except SyntaxError as e:
+        file_findings = [Finding("parse-error", posix, e.lineno or 1,
+                                 e.offset or 0,
+                                 f"syntax error: {e.msg}", "error")]
+    return posix, file_findings, key, False
+
+
 def run_paths(paths: Sequence, select: Optional[Sequence[str]] = None,
-              cache_path: Optional[Path] = None) -> List[Finding]:
+              cache_path: Optional[Path] = None,
+              jobs: int = 1) -> List[Finding]:
     """Lint every .py under ``paths``; returns unsuppressed findings.
 
     ``select`` restricts to a subset of rule names.  Baseline filtering
     is layered on top by the CLI (``baseline.apply``) so API callers see
     the raw truth.  With ``cache_path`` a per-file result cache is
     consulted and updated — keyed on (analyzer sources, rule selection,
-    file source), so editing either the file or jaxlint itself re-lints.
+    file source), so editing either the file or ANY jaxlint source
+    (rules, astutil, core) re-lints.
+
+    ``jobs`` > 1 analyzes files concurrently — files are independent
+    (rules are stateless instances, the cache is read-only during the
+    run) and results are stitched back in file order, so the output is
+    byte-identical whatever the worker count.
     """
     if select is not None:
         unknown = set(select) - set(REGISTRY)
@@ -272,43 +331,32 @@ def run_paths(paths: Sequence, select: Optional[Sequence[str]] = None,
         rules = None
         rule_names = sorted(REGISTRY)
 
-    cache: dict = {}
-    dirty = False
-    if cache_path is not None and cache_path.exists():
-        import json
-        try:
-            cache = json.loads(cache_path.read_text(encoding="utf-8"))
-        except (OSError, ValueError):
-            cache = {}
+    cache: Optional[dict] = None
+    if cache_path is not None:
+        cache = {}
+        if cache_path.exists():
+            import json
+            try:
+                cache = json.loads(cache_path.read_text(encoding="utf-8"))
+                if not isinstance(cache, dict):
+                    cache = {}
+            except (OSError, ValueError):
+                cache = {}
+
+    files = iter_python_files([Path(p) for p in paths])
+    if jobs > 1 and len(files) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(
+                lambda p: _lint_file(p, rules, rule_names, cache), files))
+    else:
+        results = [_lint_file(p, rules, rule_names, cache) for p in files]
 
     findings: List[Finding] = []
-    for path in iter_python_files([Path(p) for p in paths]):
-        posix = path.as_posix()
-        try:
-            source = path.read_text(encoding="utf-8")
-        except (OSError, UnicodeDecodeError) as e:
-            findings.append(Finding("parse-error", posix, 1, 0,
-                                    f"unreadable: {e}", "error"))
-            continue
-        key = None
-        if cache_path is not None:
-            import hashlib
-            key = hashlib.sha256(
-                (_analyzer_fingerprint() + "\x00"
-                 + "\x00".join(rule_names) + "\x00" + source)
-                .encode("utf-8")).hexdigest()
-            hit = cache.get(posix)
-            if hit is not None and hit.get("key") == key:
-                findings.extend(Finding(**f) for f in hit["findings"])
-                continue
-        try:
-            file_findings = check_source(source, posix, rules)
-        except SyntaxError as e:
-            file_findings = [Finding("parse-error", posix, e.lineno or 1,
-                                     e.offset or 0,
-                                     f"syntax error: {e.msg}", "error")]
+    dirty = False
+    for posix, file_findings, key, hit in results:
         findings.extend(file_findings)
-        if key is not None:
+        if key is not None and not hit:
             cache[posix] = {"key": key,
                             "findings": [vars(f) for f in file_findings]}
             dirty = True

@@ -1,9 +1,27 @@
-"""Rule modules register themselves on import (see core.register)."""
+"""Rule modules register themselves on import (see core.register).
+
+Three families:
+
+- tracing   (PR 4): stray-jit, use-after-donate, host-sync-in-hot-path,
+              raw-shard-map, impure-jit
+- collective (PR 10): unbound-axis, collective-in-divergent-branch,
+              donation-across-collective — the SPMD discipline the PR 5
+              sharded fit hand-enforced
+- concurrency (PR 10): unlocked-shared-mutation, blocking-under-lock,
+              impure-signal-handler — the thread/drain/handler contracts
+              of the PR 7 batcher and PR 8 async checkpointer
+"""
 
 from tools.jaxlint.rules import (  # noqa: F401
+    blocking_under_lock,
+    divergent_collective,
+    donation_across_collective,
     host_sync,
     impure_jit,
+    impure_signal_handler,
     raw_shard_map,
     stray_jit,
+    unbound_axis,
+    unlocked_shared_mutation,
     use_after_donate,
 )

@@ -1,0 +1,189 @@
+"""blocking-under-lock: never park a thread while holding a lock.
+
+The drain/close contracts of the serving and checkpoint stacks
+(PR 7 ``ContinuousBatcher.close``, PR 8 ``AsyncCheckpointer.
+wait_until_finished``) were each review-hardened into the same shape:
+release the instance lock FIRST, then block.  A ``Future.result()``,
+``Thread.join()``, ``block_until_ready()``, semaphore acquire or
+blocking queue ``get``/``put`` executed while a lock is held stalls
+every thread that needs that lock for as long as the wait lasts — and
+when the waited-on thread itself needs the lock to make progress
+(worker books a metric under it, producer appends under it), the stall
+is a deadlock.  The same goes for re-entering a NON-re-entrant
+``threading.Lock``/``Condition`` already held by the enclosing ``with``.
+
+Lock regions are lexical (``astutil.lock_regions``): ``with self._lock``
+/ ``with self._cv`` on the class's known lock/Condition fields, local
+lock variables, and module-level locks.  ``Condition.wait``/``wait_for``
+on the HELD condition is the sanctioned pattern (it releases the lock
+while parked) and never flags.
+
+``join``/``get``/``put``/``acquire`` are receiver-typed (thread attrs,
+queue attrs, lock/semaphore fields) so ``", ".join(...)`` and friends
+never false-positive.  ``.result()`` is DELIBERATELY receiver-agnostic:
+futures cross so many hands (returned, stored, passed) that static
+receiver typing would miss most of them, the method name has no common
+non-blocking homonym in this codebase, and a rare benign hit is exactly
+what the inline suppression-with-reason exists for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _local_ctor_names(fn, ctors: Set[str]) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            name = astutil.dotted_name(node.value.func)
+            if name is not None and name.rsplit(".", 1)[-1] in ctors:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _kw_false(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in call.keywords)
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    severity = "error"
+    family = "concurrency"
+    description = ("blocking wait (.result()/.join()/block_until_ready/"
+                   "queue get/put/semaphore) or re-entrant acquire "
+                   "inside a held-lock region")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        module_locks = astutil.module_lock_names(tree)
+        infos = astutil.class_infos(tree)
+        checked: Set[int] = set()
+        for info in infos:
+            lockish = info.lock_attrs | info.cond_attrs
+            for fn in info.methods.values():
+                checked.add(id(fn))
+                yield from self._check_fn(fn, info, lockish,
+                                          module_locks, posix_path)
+        for fn in astutil.module_functions(tree).values():
+            if id(fn) not in checked:
+                yield from self._check_fn(fn, None, set(), module_locks,
+                                          posix_path)
+
+    def _check_fn(self, fn, info: Optional[astutil.ClassInfo],
+                  lockish: Set[str], module_locks: Set[str],
+                  posix_path: str) -> Iterable[Finding]:
+        regions = astutil.lock_regions(fn, lockish, module_locks)
+        local_threads = _local_ctor_names(fn, {"Thread", "Timer"})
+        local_queues = _local_ctor_names(
+            fn, {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"})
+        for node in ast.walk(fn):
+            held = regions.get(id(node))
+            if not held:
+                continue
+            if isinstance(node, ast.With):
+                yield from self._check_reentry(node, info, lockish,
+                                               module_locks, held,
+                                               posix_path)
+            if not isinstance(node, ast.Call):
+                continue
+            yield from self._check_call(node, info, held, local_threads,
+                                        local_queues, posix_path)
+
+    def _check_reentry(self, node: ast.With, info, lockish: Set[str],
+                       module_locks: Set[str], held: Set[str],
+                       posix_path: str) -> Iterable[Finding]:
+        """``with self._lock`` nested under an already-held ``with
+        self._lock`` — instant deadlock unless the lock is an RLock."""
+        rlocks = info.rlock_attrs if info is not None else set()
+        for item in node.items:
+            expr = item.context_expr
+            attr = astutil.self_attr(expr)
+            key = None
+            if attr is not None and attr in lockish:
+                key = f"self.{attr}"
+                if attr in rlocks:
+                    continue
+            elif isinstance(expr, ast.Name) and expr.id in module_locks:
+                key = expr.id
+            if key is not None and key in held:
+                yield self.finding(
+                    posix_path, node,
+                    f"re-entrant `with {key}` while {key} is already "
+                    "held — threading.Lock/Condition are not re-entrant; "
+                    "this deadlocks the thread against itself")
+
+    def _check_call(self, node: ast.Call, info, held: Set[str],
+                    local_threads: Set[str], local_queues: Set[str],
+                    posix_path: str) -> Iterable[Finding]:
+        func = node.func
+        locks = " + ".join(sorted(held))
+        if not isinstance(func, ast.Attribute):
+            return
+        attr = func.attr
+        recv = func.value
+        recv_attr = astutil.self_attr(recv)
+        if attr == "result":
+            yield self.finding(
+                posix_path, node,
+                f".result() while holding {locks} — the future may need "
+                "that lock (or its worker) to resolve; wait after "
+                "releasing")
+        elif attr == "block_until_ready":
+            yield self.finding(
+                posix_path, node,
+                f"block_until_ready() while holding {locks} — a device "
+                "sync under a lock stalls every thread that needs it")
+        elif attr == "join":
+            thread_recv = (recv_attr is not None and info is not None
+                           and recv_attr in info.thread_attrs) \
+                or (isinstance(recv, ast.Name) and recv.id in local_threads)
+            if thread_recv:
+                yield self.finding(
+                    posix_path, node,
+                    f"Thread.join() while holding {locks} — if the "
+                    "worker needs the lock to finish, this never returns")
+        elif attr in ("get", "put"):
+            queue_recv = (recv_attr is not None and info is not None
+                          and recv_attr in info.queue_attrs) \
+                or (isinstance(recv, ast.Name) and recv.id in local_queues)
+            if queue_recv and not _kw_false(node, "block"):
+                yield self.finding(
+                    posix_path, node,
+                    f"blocking queue .{attr}() while holding {locks} — "
+                    "use the _nowait form or move the wait outside the "
+                    "lock")
+        elif attr == "acquire":
+            sem_recv = recv_attr is not None and info is not None \
+                and recv_attr in info.sem_attrs
+            lock_key = f"self.{recv_attr}" if recv_attr is not None else \
+                (recv.id if isinstance(recv, ast.Name) else None)
+            if sem_recv and not _kw_false(node, "blocking"):
+                yield self.finding(
+                    posix_path, node,
+                    f"semaphore .acquire() while holding {locks} — the "
+                    "release may need the held lock; backpressure waits "
+                    "belong outside it")
+            elif lock_key is not None and lock_key in held \
+                    and not (info is not None and recv_attr is not None
+                             and recv_attr in info.rlock_attrs):
+                yield self.finding(
+                    posix_path, node,
+                    f"re-entrant .acquire() of already-held {lock_key} — "
+                    "threading.Lock is not re-entrant")
+        elif attr == "wait" and recv_attr is not None and info is not None \
+                and recv_attr in info.event_attrs:
+            yield self.finding(
+                posix_path, node,
+                f"Event.wait() while holding {locks} — the setter may "
+                "need the lock; wait after releasing")

@@ -1,0 +1,167 @@
+"""collective-in-divergent-branch: replicas must reach collectives
+together.
+
+A collective (psum/pmean/all_gather/...) is a RENDEZVOUS: every replica
+along the axis must dispatch it, in the same order, or the mesh
+deadlocks — the class of bug the PR 5 sharded fit designed around by
+deciding guard skips from COLLECTIVE values ("so every replica skips
+identically and replicated params never diverge",
+parallel/sharded_fit.py).  The dangerous shape is a Python ``if`` (or
+``while``) on a PER-REPLICA traced value with a collective reachable
+under it: each shard branches on its own data, some enter the psum and
+some don't, and the program hangs on hardware after passing every
+single-device test.
+
+The check is a linear taint pass over each hot function (see
+``astutil.hot_functions``): tracer parameters are per-replica; a value
+assigned from a per-replica value stays per-replica; a value that
+flowed THROUGH a collective is replica-uniform again (psum launders the
+taint — branching on a post-psum score is exactly the sanctioned
+pattern).  A branch whose test reads a tainted name flags every
+collective call in its subtree.  Reads via metadata attributes
+(``.shape``/``.ndim``/...) are trace-static and never taint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _walk_no_scopes(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree without descending into nested function/class
+    bodies — a nested def under the branch is not executed by it."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _SCOPES):
+                stack.append(child)
+
+
+def _tainted_read(expr: ast.AST, taint: Set[str]) -> Optional[str]:
+    """First tainted name the expression reads as a VALUE (metadata
+    attribute reads are trace-static and don't count)."""
+    nodes = list(ast.walk(expr))
+    metadata = astutil.metadata_only_names(nodes)
+    for node in nodes:
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in taint and id(node) not in metadata:
+            return node.id
+    return None
+
+
+def _contains_collective(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and astutil.is_collective_call(n)
+               for n in _walk_no_scopes(expr))
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(target)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, (ast.Store, ast.Del))}
+
+
+@register
+class CollectiveInDivergentBranchRule(Rule):
+    name = "collective-in-divergent-branch"
+    severity = "error"
+    family = "collective"
+    description = ("collective reachable under a branch on a per-replica "
+                   "traced value — replicas diverge and the mesh "
+                   "deadlocks at the rendezvous")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        hot = astutil.hot_functions(tree)
+        for fn, info in hot.items():
+            taint = astutil.dynamic_param_names(
+                fn, info.static_argnums, info.static_argnames)
+            # one flag per collective call even when branches nest
+            seen: Set[int] = set()
+            yield from self._scan(fn.body, set(taint), posix_path, seen)
+
+    def _scan(self, stmts: List[ast.stmt], taint: Set[str],
+              path: str, seen: Set[int]) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, _SCOPES):
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                hit = _tainted_read(stmt.test, taint)
+                if hit is not None:
+                    yield from self._flag_collectives(stmt, hit, path,
+                                                      seen)
+                for group in (stmt.body, stmt.orelse):
+                    yield from self._scan(group, taint, path, seen)
+            elif isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                names: Set[str] = set()
+                for t in targets:
+                    names |= _target_names(t)
+                value = stmt.value
+                if value is not None and _contains_collective(value):
+                    # flowed through a collective: replica-uniform again
+                    taint -= names
+                elif (value is not None
+                      and _tainted_read(value, taint) is not None) \
+                        or (isinstance(stmt, ast.AugAssign)
+                            and names & taint):
+                    # an AugAssign taints only when the prior target or
+                    # the operand was already per-replica — a
+                    # trace-static counter (``depth += 1``) stays clean
+                    taint |= names
+                else:
+                    taint -= names
+            elif isinstance(stmt, ast.For):
+                if _tainted_read(stmt.iter, taint) is not None:
+                    taint |= _target_names(stmt.target)
+                for group in (stmt.body, stmt.orelse):
+                    yield from self._scan(group, taint, path, seen)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan(stmt.body, taint, path, seen)
+            elif isinstance(stmt, ast.Try):
+                for group in ([stmt.body, stmt.orelse, stmt.finalbody]
+                              + [h.body for h in stmt.handlers]):
+                    yield from self._scan(group, taint, path, seen)
+            elif isinstance(stmt, ast.Match):
+                hit = _tainted_read(stmt.subject, taint)
+                for case in stmt.cases:
+                    if hit is not None:
+                        for finding in self._flag_stmts(
+                                case.body, hit, path, seen,
+                                line=stmt.lineno):
+                            yield finding
+                    yield from self._scan(case.body, taint, path, seen)
+
+    def _flag_collectives(self, branch: ast.stmt, tainted_name: str,
+                          path: str, seen: Set[int]) -> Iterator[Finding]:
+        yield from self._flag_stmts(
+            list(branch.body) + list(getattr(branch, "orelse", [])),
+            tainted_name, path, seen, line=branch.lineno)
+
+    def _flag_stmts(self, stmts: List[ast.stmt], tainted_name: str,
+                    path: str, seen: Set[int],
+                    line: Optional[int] = None) -> Iterator[Finding]:
+        for stmt in stmts:
+            for node in _walk_no_scopes(stmt):
+                if isinstance(node, ast.Call) \
+                        and astutil.is_collective_call(node) \
+                        and id(node) not in seen:
+                    seen.add(id(node))
+                    leaf = (astutil.dotted_name(node.func) or "collective"
+                            ).rsplit(".", 1)[-1]
+                    at = f" at line {line}" if line is not None else ""
+                    yield self.finding(
+                        path, node,
+                        f"{leaf}() reached under a branch{at} on "
+                        f"per-replica value {tainted_name!r} — replicas "
+                        "that skip the branch never join the collective; "
+                        "decide with a post-psum (collective) value or "
+                        "jnp.where instead")

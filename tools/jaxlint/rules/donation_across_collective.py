@@ -1,0 +1,154 @@
+"""donation-across-collective: builder-made sharded steps donate too.
+
+The PR 4 ``use-after-donate`` dataflow sees donation declared AT the
+assignment (``step = cached_jit(f, donate_argnums=(0,))``).  The PR 5
+sharded-fit stack moved that declaration into FACTORIES: a caller gets
+its compiled step from ``build_sharded_step``/``build_scanned_epochs``
+(parallel/sharded_fit.py), which wrap the per-shard body in
+``shard_map`` and compile it with ``donate_argnums=(0, 1)`` — params
+and updater state are donated on every dispatch, but nothing at the
+CALL SITE says so.  Reading ``params`` after
+
+    fn = build_scanned_epochs(step, mesh, label=...)
+    new_params, new_ustate, scores, skips = fn(params, ustate, ...)
+    loss(params)        # <-- donated on EVERY replica of the mesh
+
+touches a buffer XLA reused on every device of the mesh at once — the
+failure is per-replica garbage or a crash, and it only reproduces on
+sharded runs.
+
+This rule extends the same read-after-donate tracking to the
+wrapped-callable form, two resolutions deep:
+
+- the known sharded-fit builders (``build_sharded_step``,
+  ``build_scanned_epochs``) donate positions (0, 1) unless called with
+  a literal ``donate=False``;
+- any SAME-MODULE factory whose body both wraps a callable in
+  ``shard_map`` and compiles with a literal ``donate_argnums`` (the
+  ``(0, 1) if donate else ()`` conditional counts as donating) is
+  resolved structurally — new builders get checked without touching
+  this rule.
+
+The plain assignment and direct-call forms stay with use-after-donate;
+this rule never double-reports them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import register
+from tools.jaxlint.rules.use_after_donate import (
+    DonationTable,
+    ScopeNode,
+    UseAfterDonateRule,
+    _scope_statements,
+)
+
+#: cross-module builders this repo compiles sharded steps through
+#: (parallel/sharded_fit.py) — position (0, 1) = (params, ustate)
+KNOWN_FACTORIES: Dict[str, Set[int]] = {
+    "build_sharded_step": {0, 1},
+    "build_scanned_epochs": {0, 1},
+}
+
+
+def _donate_literal(call: ast.Call) -> Set[int]:
+    """Literal ``donate_argnums`` positions, resolving the conditional
+    ``(0, 1) if donate else ()`` builder idiom to the donating arm."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        value = kw.value
+        if isinstance(value, ast.IfExp):
+            out = set()
+            for arm in (value.body, value.orelse):
+                out |= astutil.donated_argnums(
+                    ast.Call(func=call.func, args=[], keywords=[
+                        ast.keyword(arg="donate_argnums", value=arm)]))
+            return out
+    return astutil.donated_argnums(call)
+
+
+def _local_factories(tree: ast.Module) -> Dict[str, Set[int]]:
+    """Same-module factory defs that build a donated shard_map'd
+    executable: the subtree contains both a ``shard_map(...)`` call and
+    a jit-family compile with a literal ``donate_argnums``."""
+    out: Dict[str, Set[int]] = {}
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_shard_map = False
+        donated: Set[int] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (astutil.dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if leaf == "shard_map":
+                has_shard_map = True
+            if astutil.is_jit_reference(node.func):
+                donated |= _donate_literal(node)
+        if has_shard_map and donated:
+            out[fn.name] = donated
+    return out
+
+
+def _factory_positions(call: ast.Call, factories: Dict[str, Set[int]]
+                       ) -> Optional[Set[int]]:
+    """Donated positions for a builder call, or None if it isn't one
+    (or was called with a literal ``donate=False``)."""
+    name = astutil.dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    donated = factories.get(leaf, KNOWN_FACTORIES.get(leaf))
+    if donated is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return None
+    return donated
+
+
+@register
+class DonationAcrossCollectiveRule(UseAfterDonateRule):
+    name = "donation-across-collective"
+    severity = "error"
+    family = "collective"
+    description = ("variable read after being donated into a "
+                   "shard_map'd builder step (freed on every replica)")
+    direct_form = False
+
+    def _build_tables(self, tree: ast.Module) -> Dict[ScopeNode,
+                                                      DonationTable]:
+        factories = _local_factories(tree)
+        tbls: Dict[ScopeNode, DonationTable] = {}
+
+        def scan(scope: ScopeNode) -> None:
+            table = tbls.setdefault(scope, {})
+            for stmt, _depth in _scope_statements(scope):
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and isinstance(stmt.value, ast.Call):
+                    # a factory is NOT itself donating when assigned
+                    # through cached_jit (that's use-after-donate's form)
+                    if astutil.is_jit_reference(stmt.value.func):
+                        continue
+                    donated = _factory_positions(stmt.value, factories)
+                    if donated:
+                        table[stmt.targets[0].id] = (donated, stmt)
+                elif isinstance(stmt, (ast.ClassDef, ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    scan(stmt)
+
+        scan(tree)
+        return tbls
+
+    def _message(self, name: str, label: str, line: int) -> str:
+        return (f"{name!r} read after being donated into the shard_map'd "
+                f"step from {label}() (line {line}) — the buffer was "
+                "reused on every replica of the mesh; rebind from the "
+                "step's result or build with donate=False")

@@ -1,0 +1,181 @@
+"""impure-signal-handler: a signal handler may ONLY set flags.
+
+A Python signal handler runs on the main thread at an arbitrary
+bytecode boundary — including while the interrupted thread holds the
+metrics registry lock, the tracer lock, or logging's module lock.  Any
+re-acquisition from the handler deadlocks the process inside its
+preemption grace window; this is the exact bug PR 8 fixed by hand in
+``PreemptionGuard``: the handler body is ``Event.set()`` and nothing
+else, with metric/telemetry/log booking deferred to the first
+``requested()`` observation on a regular thread (see
+runtime/resilience.py's ``request`` docstring).
+
+This rule machine-checks that contract.  Handlers are found by
+CALLABLE RESOLUTION, not naming: any function registered via
+``signal.signal(sig, fn)`` — a module function by name or a bound
+``self._handler`` method — and any ``_handler``/``request`` override on
+a ``PreemptionGuard`` subclass (the guard installs them itself).  The
+handler and every same-class/same-module callee reachable from it may
+not:
+
+- enter a ``with`` block or call ``.acquire()`` (lock/context
+  acquisition — even "just" a metrics lock),
+- log (``log``/``logger``/``logging``/``warnings``) or ``print``,
+- book metrics (``*_metrics`` receivers, ``.note*`` methods) or
+  telemetry (``telemetry.event``/``span``),
+- touch numpy/jax (``np``/``jnp``/``jax`` — allocation and dispatch
+  are not async-signal-safe).
+
+``Event.set``, dict reads, ``signal.*`` re-registration and
+``raise_signal``/``os.kill`` (the second-delivery escape hatch) stay
+legal, as do calls the analyzer cannot resolve — the contract is
+enforced where it can be seen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+_LOGGING_ROOTS = {"log", "logger", "logging", "warnings"}
+_NUMERIC_ROOTS = {"np", "numpy", "onp", "jnp", "jax"}
+_GUARD_HOOKS = {"_handler", "request"}
+
+
+def _walk_own_body(fn) -> Iterator[ast.AST]:
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _SCOPES):
+                stack.append(child)
+
+
+def _registered_handlers(tree: ast.Module
+                         ) -> List[Tuple[astutil.FunctionNode, str,
+                                         Optional[ast.ClassDef]]]:
+    """(handler def, how it was registered, owning class) triples."""
+    owner_cls = astutil.enclosing_class(tree)
+    out = []
+    seen: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and len(node.args) >= 2:
+            name = astutil.dotted_name(node.func) or ""
+            parts = name.split(".")
+            # `signal.signal(...)` or bare `signal(...)` via
+            # `from signal import signal` — not some_obj.signal(...)
+            if parts[-1] != "signal" \
+                    or (len(parts) > 1 and parts[-2] != "signal"):
+                continue
+            cls = owner_cls.get(id(node))
+            fn = astutil.resolve_callable(node.args[1], tree, cls)
+            if fn is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((fn, f"signal.signal at line {node.lineno}",
+                            owner_cls.get(id(fn))))
+    # PreemptionGuard subclasses: the guard installs _handler/request
+    # itself, so overrides are handlers even with no visible signal call
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if not any((astutil.dotted_name(b) or "").rsplit(".", 1)[-1]
+                   == "PreemptionGuard" for b in cls.bases):
+            continue
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name in _GUARD_HOOKS \
+                    and id(stmt) not in seen:
+                seen.add(id(stmt))
+                out.append((stmt, f"PreemptionGuard override "
+                                  f"{cls.name}.{stmt.name}", cls))
+    return out
+
+
+@register
+class ImpureSignalHandlerRule(Rule):
+    name = "impure-signal-handler"
+    severity = "error"
+    family = "concurrency"
+    description = ("signal handler does more than set a flag (locks, "
+                   "logging, metrics, allocation deadlock the grace "
+                   "window)")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        handlers = _registered_handlers(tree)
+        if not handlers:
+            return
+        for fn, origin, cls in handlers:
+            visited: Set[int] = set()
+            yield from self._check_handler(fn, origin, cls, tree,
+                                           posix_path, visited)
+
+    def _check_handler(self, fn, origin: str, cls, tree: ast.Module,
+                       posix_path: str, visited: Set[int]
+                       ) -> Iterator[Finding]:
+        if id(fn) in visited:
+            return
+        visited.add(id(fn))
+        label = fn.name
+        for node in _walk_own_body(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                yield self.finding(
+                    posix_path, node,
+                    f"`with` inside signal handler {label!r} ({origin}) "
+                    "— acquiring a lock/context from handler context "
+                    "deadlocks if the interrupted thread holds it; set "
+                    "a flag and do the work at the next safe point")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            dotted = astutil.dotted_name(func) or ""
+            root = dotted.split(".", 1)[0]
+            attr = func.attr if isinstance(func, ast.Attribute) else ""
+            if attr == "acquire":
+                yield self.finding(
+                    posix_path, node,
+                    f".acquire() inside signal handler {label!r} "
+                    f"({origin}) — handler-side lock acquisition is the "
+                    "deadlock PR 8 removed; set a flag instead")
+            elif isinstance(func, ast.Name) and func.id == "print":
+                yield self.finding(
+                    posix_path, node,
+                    f"print() inside signal handler {label!r} ({origin}) "
+                    "— stdio is locked and not async-signal-safe")
+            elif root in _LOGGING_ROOTS:
+                yield self.finding(
+                    posix_path, node,
+                    f"logging call inside signal handler {label!r} "
+                    f"({origin}) — the logging module lock may be held "
+                    "by the interrupted thread; defer to the first "
+                    "flag observation")
+            elif "metrics" in root or attr.startswith("note"):
+                yield self.finding(
+                    posix_path, node,
+                    f"metric booking inside signal handler {label!r} "
+                    f"({origin}) — the registry takes a non-reentrant "
+                    "lock; defer booking to the flag's first reader")
+            elif root == "telemetry" or (attr in ("event", "span")
+                                         and root in ("telemetry", "tr")):
+                yield self.finding(
+                    posix_path, node,
+                    f"telemetry call inside signal handler {label!r} "
+                    f"({origin}) — the tracer locks its ring buffer; "
+                    "defer to the flag's first reader")
+            elif root in _NUMERIC_ROOTS:
+                yield self.finding(
+                    posix_path, node,
+                    f"{root}.* call inside signal handler {label!r} "
+                    f"({origin}) — allocation/dispatch is not "
+                    "async-signal-safe")
+            else:
+                callee = astutil.resolve_callable(func, tree, cls)
+                if callee is not None:
+                    yield from self._check_handler(
+                        callee, origin, cls, tree, posix_path, visited)

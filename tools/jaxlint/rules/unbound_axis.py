@@ -1,0 +1,65 @@
+"""unbound-axis: a collective's axis name must actually be bound.
+
+``lax.psum(x, "dta")`` traces and compiles fine inside a ``shard_map``
+over ``("data",)`` on some jax versions — and on others is an eager-mode
+no-op or a late NameError at dispatch time, after the job has been
+queued on a pod.  The repo fixes its axis vocabulary package-wide in
+``parallel/mesh.py`` (``data``/``model``/``pipe``/``seq``/``expert``)
+precisely so that a collective can be validated against it statically.
+
+A collective call (``psum``/``pmean``/``all_gather``/...) is flagged
+when its axis-name argument RESOLVES to a string literal (at the call
+site, through a parameter default, or through an unambiguous local/
+module constant) that is neither in the mesh vocabulary nor bound by an
+explicit ``axis_name=``/``axis_names=`` literal on a pmap/vmap/xmap/
+shard_map/Mesh call in the same module.  Unresolvable axis expressions
+(a parameter without a default, an imported constant) are the caller's
+contract and stay silent — this rule exists to catch the typo'd or
+ad-hoc axis nobody binds, not to demand whole-program inference.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+
+@register
+class UnboundAxisRule(Rule):
+    name = "unbound-axis"
+    severity = "error"
+    family = "collective"
+    description = ("collective axis name neither in the parallel/mesh "
+                   "vocabulary nor bound by an enclosing "
+                   "shard_map/pmap axis_name")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        bound = None        # computed lazily: most files have no collectives
+        chain = None
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) \
+                    or not astutil.is_collective_call(node):
+                continue
+            axis_expr = astutil.collective_axis_expr(node)
+            if axis_expr is None:
+                continue
+            if chain is None:
+                bound = astutil.bound_axis_names(tree)
+                chain = astutil.enclosing_chain(tree)
+            values = astutil.resolve_axis_literal(
+                axis_expr, tree, chain.get(id(axis_expr), []))
+            if values is None:
+                continue
+            loose = sorted(v for v in values if v not in bound)
+            if loose:
+                leaf = (astutil.dotted_name(node.func) or "collective"
+                        ).rsplit(".", 1)[-1]
+                yield self.finding(
+                    posix_path, node,
+                    f"{leaf}() over axis {loose[0]!r}, which no enclosing "
+                    "shard_map/pmap binds and the parallel/mesh vocabulary "
+                    "does not contain — this collective is a silent no-op "
+                    "(or late NameError) outside a matching mesh")

@@ -1,0 +1,150 @@
+"""unlocked-shared-mutation: worker-thread state needs its lock.
+
+Every threaded component in this repo (DynamicBatcher, the PR 7
+ContinuousBatcher, the PR 8 AsyncCheckpointer, DistributedRunner)
+follows one discipline, hardened twice in review: state shared between
+the background worker and the public API is mutated only under the
+instance's lock/Condition.  A mutation that skips the lock is the
+classic intermittent bug — a request list appended mid-``pop``, a
+``_placed`` map resized during iteration — that passes every test until
+a production burst hits the window.
+
+The rule is class-scoped and seeded from the class's own lock fields
+(``self._lock = threading.Lock()`` / ``Condition()`` — see
+``astutil.class_infos``): in a class that starts a thread on one of its
+methods (``Thread(target=self._worker)``, resolved transitively through
+``self.m()`` calls) AND owns a lock, any ``self.*`` attribute mutated
+both from the worker-method set and from a non-worker (publicly
+callable) method must hold a COMMON lock at every mutation site.
+``__init__`` is exempt (it runs before the thread exists), as are the
+lock/semaphore fields themselves.  Thread-safe primitives' own methods
+(``Event.set``, ``Queue.put``) are not attribute mutations and never
+flag.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+#: in-place container mutation methods (same vocabulary as impure-jit)
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "add", "discard", "setdefault", "sort", "reverse", "popitem"}
+
+#: methods that run before/after the thread's lifetime by construction
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _walk_own_body(fn) -> List[ast.AST]:
+    """Nodes of the method's own body, nested function/class scopes
+    excluded — a closure's thread affinity is not the method's."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _SCOPES):
+                stack.append(child)
+    return out
+
+
+def _mutated_attr(node: ast.AST) -> Optional[str]:
+    """The ``self.X`` attribute this node mutates, if any."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            attr = astutil.self_attr(tgt)
+            if attr is not None:
+                return attr
+            if isinstance(tgt, ast.Subscript):
+                attr = astutil.self_attr(tgt.value)
+                if attr is not None:
+                    return attr
+    elif isinstance(node, ast.Delete):
+        for tgt in node.targets:
+            attr = astutil.self_attr(tgt)
+            if attr is None and isinstance(tgt, ast.Subscript):
+                attr = astutil.self_attr(tgt.value)
+            if attr is not None:
+                return attr
+    elif isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATORS:
+        return astutil.self_attr(node.func.value)
+    return None
+
+
+#: one mutation site: (attr, method name, node, locks held)
+Site = Tuple[str, str, ast.AST, Set[str]]
+
+
+@register
+class UnlockedSharedMutationRule(Rule):
+    name = "unlocked-shared-mutation"
+    severity = "error"
+    family = "concurrency"
+    description = ("self.* attribute mutated from both a thread worker "
+                   "and a public method without a common held lock")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for info in astutil.class_infos(tree):
+            if not info.owns_thread():
+                continue
+            lockish = info.lock_attrs | info.cond_attrs
+            if not lockish:
+                continue        # lock-free by design; nothing to seed from
+            exempt_attrs = (lockish | info.sem_attrs)
+            sites: List[Site] = []
+            for mname, fn in info.methods.items():
+                if mname in _EXEMPT_METHODS:
+                    continue
+                regions = astutil.lock_regions(fn, lockish)
+                for node in _walk_own_body(fn):
+                    attr = _mutated_attr(node)
+                    if attr is None or attr in exempt_attrs:
+                        continue
+                    sites.append((attr, mname, node,
+                                  regions.get(id(node), set())))
+            yield from self._judge(info, sites, posix_path)
+
+    def _judge(self, info: astutil.ClassInfo, sites: List[Site],
+               posix_path: str) -> Iterable[Finding]:
+        by_attr: Dict[str, List[Site]] = {}
+        for site in sites:
+            by_attr.setdefault(site[0], []).append(site)
+        for attr, group in sorted(by_attr.items()):
+            worker = [s for s in group
+                      if s[1] in info.worker_methods]
+            public = [s for s in group
+                      if s[1] not in info.worker_methods]
+            if not worker or not public:
+                continue        # single-threaded access pattern
+            common = set.intersection(*(s[3] for s in group))
+            if common:
+                continue
+            # the lock most sites already hold is the intended guard;
+            # flag the sites that miss it (all of them when none locks)
+            counts = Counter(l for s in group for l in s[3])
+            guard = counts.most_common(1)[0][0] if counts else None
+            wm = sorted({s[1] for s in worker})[0]
+            pm = sorted({s[1] for s in public})[0]
+            for _, mname, node, held in group:
+                if guard is not None and guard in held:
+                    continue
+                want = guard or "self." + sorted(
+                    info.lock_attrs | info.cond_attrs)[0]
+                yield self.finding(
+                    posix_path, node,
+                    f"'self.{attr}' is mutated from worker method "
+                    f"'{wm}' (thread target of "
+                    f"{info.node.name}) and public method '{pm}' but "
+                    f"this site in '{mname}' does not hold {want} — "
+                    "take the lock (or annotate why the race is benign)")

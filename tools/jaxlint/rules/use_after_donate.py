@@ -257,9 +257,26 @@ class UseAfterDonateRule(Rule):
     severity = "error"
     description = ("variable read after being passed in a donated "
                    "argument position (its buffer is deleted)")
+    #: whether to also check the direct call form
+    #: ``cached_jit(f, donate_argnums=...)(x)`` — the subclassing
+    #: donation-across-collective rule turns this off (the base rule
+    #: already owns that form; double-reporting helps nobody)
+    direct_form = True
+
+    def _build_tables(self, tree: ast.Module) -> Dict[ScopeNode,
+                                                      DonationTable]:
+        """Hook: per-scope donation tables.  Subclasses (the
+        collective-factory form) supply their own construction and
+        inherit the read-after-donate dataflow unchanged."""
+        return _donation_tables(tree)
+
+    def _message(self, name: str, label: str, line: int) -> str:
+        return (f"{name!r} read after being donated to {label}() at "
+                f"line {line} — the buffer is deleted; copy "
+                "before the call or rebind from the result")
 
     def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
-        tbls = _donation_tables(tree)
+        tbls = self._build_tables(tree)
         scopes: List[ScopeNode] = [tree]
         scopes.extend(n for n in ast.walk(tree)
                       if isinstance(n, (ast.FunctionDef,
@@ -291,7 +308,7 @@ class UseAfterDonateRule(Rule):
                     if donated is None:
                         continue
                     label = call.func.id
-                elif isinstance(call.func, ast.Call) \
+                elif self.direct_form and isinstance(call.func, ast.Call) \
                         and astutil.is_jit_reference(call.func.func):
                     # direct form: cached_jit(f, donate_argnums=...)(x)
                     donated = astutil.donated_argnums(call.func)
@@ -352,11 +369,8 @@ class UseAfterDonateRule(Rule):
                     and id(node) not in in_call \
                     and id(node) not in metadata \
                     and (node.lineno, node.col_offset) > call_end:
-                yield self.finding(
-                    posix_path, node,
-                    f"{name!r} read after being donated to {label}() at "
-                    f"line {call.lineno} — the buffer is deleted; copy "
-                    "before the call or rebind from the result")
+                yield self.finding(posix_path, node,
+                                   self._message(name, label, call.lineno))
                 return
         # the donating statement's own assignment targets rebind the name
         # (the loop-threading idiom: ``x, s = step(x, s)``)
@@ -378,11 +392,8 @@ class UseAfterDonateRule(Rule):
                 continue
             reads, writes = _name_events(later, name)
             if reads:
-                yield self.finding(
-                    posix_path, later,
-                    f"{name!r} read after being donated to {label}() at "
-                    f"line {call.lineno} — the buffer is deleted; copy "
-                    "before the call or rebind from the result")
+                yield self.finding(posix_path, later,
+                                   self._message(name, label, call.lineno))
                 return
             if writes and ancestors.get(id(later), set()) <= call_anc:
                 # a rebind inside ANY branch not already enclosing the
