@@ -34,20 +34,9 @@ A100_RESNET50_IPS = 2900.0           # fp16 MLPerf-era per-A100
 A100_LENET_IPS = 100_000.0           # estimate: dispatch-bound small net
 W2V_WORDS_PER_SEC_ANCHOR = 500_000.0  # multi-thread CPU word2vec ballpark
 
-# bf16 peak FLOP/s per chip by device_kind substring
-TPU_PEAKS = [
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
-    ("v5litepod", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-]
-
-
-def chip_peak_flops(device_kind: str) -> float | None:
-    dk = device_kind.lower()
-    for sub, peak in TPU_PEAKS:
-        if sub in dk:
-            return peak
-    return None
+# bf16 chip peaks live in ONE place — runtime/metrics.TPU_PEAK_FLOPS
+# (chip_peak_flops/estimate_mfu); _mfu below imports them lazily so this
+# module stays import-light until an inner bench runs.
 
 
 def _force_cpu(ndev: int) -> None:
@@ -89,11 +78,16 @@ def _platform_info():
 
 
 def _mfu(flops_per_step: float, step_s: float, device_kind: str,
-         n_dev: int) -> float | None:
-    peak = chip_peak_flops(device_kind)
-    if peak is None or step_s <= 0:
-        return None
-    return round(flops_per_step / step_s / (peak * n_dev), 4)
+         n_dev: int, label: str = "bench") -> float | None:
+    """Analytic-MFU estimate for a row, BOOKED into the ``mfu`` counter
+    family (runtime/metrics.mfu_metrics) so the row's embedded telemetry
+    snapshot carries it alongside the autotune counters — one peak table,
+    one estimator, no drift between the printed row and the snapshot."""
+    from deeplearning4j_tpu.runtime.metrics import mfu_metrics
+
+    est = mfu_metrics.note_mfu(label, flops_per_step, step_s,
+                               device_kind, n_dev)
+    return round(est, 4) if est is not None else None
 
 
 # -- inner benches ----------------------------------------------------------
@@ -157,14 +151,65 @@ def bert_train_flops(cfg, batch: int, seq: int) -> float:
     return 3.0 * fwd
 
 
+def _training_attn(mesh, q_shape, causal: bool):
+    """Resolve the training-path attention through the
+    ``make_attn_fn`` auto policy and report WHAT ACTUALLY RUNS.
+
+    This replaces the old probe that set ``flash_used = seq_len >=
+    FLASH_MIN_SEQ`` after a successful compile even when the XLA path
+    ran the fit: the decision now comes from the dispatch's own
+    ``describe`` (autotuned winners included), the selected flash path
+    is probe-compiled so a Mosaic failure degrades to XLA with a warning
+    instead of killing the benchmark, and the row carries the measured
+    flash/XLA crossover (autotune cache) next to the static heuristic.
+
+    Returns ``(attn_fn, report_fields)``."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+
+    attn = make_attn_fn("auto", mesh=mesh)
+    dec = attn.describe(q_shape, q_shape, causal)
+    if dec.impl == "pallas" and not dec.interpret:
+        try:
+            q = jnp.zeros(q_shape, jnp.bfloat16)
+            float(jnp.sum(attn(q, q, q, None, causal)
+                          .astype(jnp.float32)))
+        except Exception as e:  # pragma: no cover - TPU-compile specific
+            print(f'{{"warn": "flash attention unavailable: {e!r}"}}',
+                  file=sys.stderr)
+            attn = make_attn_fn("xla", mesh=mesh)
+            dec = dataclasses.replace(
+                attn.describe(q_shape, q_shape, causal),
+                source="mosaic-probe-failed")
+    crossover = None
+    try:
+        from deeplearning4j_tpu.runtime import autotune
+
+        crossover = autotune.measured_crossover(q_shape[3], causal)
+    except Exception:
+        pass  # evidence, never a reason to fail a bench
+    report = {
+        "flash_attention": dec.impl == "pallas" and not dec.interpret,
+        "attn_kernel": dec.kernel_name,
+        "attn_source": dec.source,
+        "attn_blocks": ([dec.block_q, dec.block_k]
+                        if dec.impl == "pallas" else None),
+        "flash_crossover_seq": (crossover if crossover is not None
+                                else dec.crossover),
+        "flash_crossover_source": ("autotuned" if crossover is not None
+                                   else "heuristic"),
+    }
+    return attn, report
+
+
 def bench_bert(batch_size: int = 32, seq_len: int = 128,
                steps: int = 20):
     import jax
     import jax.numpy as jnp
     import optax
     from deeplearning4j_tpu.models import bert
-    from deeplearning4j_tpu.models import transformer as tfm
-    from deeplearning4j_tpu.ops.pallas_attention import make_flash_attn
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 
     platform, kind, n_dev = _platform_info()
@@ -176,22 +221,8 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128,
 
     mesh = make_mesh(MeshSpec(data=n_dev), devices=jax.devices())
 
-    # Prefer the Pallas flash kernel, but probe-compile it first: a Mosaic
-    # failure must degrade to XLA attention, not kill the benchmark.
-    flash_used = False
-    attn = make_flash_attn(mesh)
-    from deeplearning4j_tpu.ops.pallas_attention import FLASH_MIN_SEQ
-    if attn is not tfm.attention:
-        try:
-            q = jnp.zeros((n_dev, seq_len, 1, 64), jnp.bfloat16)
-            float(jnp.sum(attn(q, q, q, None, False)))
-            # the mesh-aware wrapper dispatches XLA attention below the
-            # measured flash/XLA crossover; report what actually runs
-            flash_used = seq_len >= FLASH_MIN_SEQ
-        except Exception as e:  # pragma: no cover - TPU-compile specific
-            print(f'{{"warn": "flash attention unavailable: {e!r}"}}',
-                  file=sys.stderr)
-            attn = tfm.attention
+    attn, attn_report = _training_attn(
+        mesh, (batch_size, seq_len, cfg.n_heads, cfg.head_dim), causal=False)
 
     # all measured steps scan inside ONE dispatch: measured time is
     # device throughput, not the tunnel's 15-20 ms per-call latency
@@ -224,9 +255,188 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128,
         "n_devices": n_dev,
         "config_sig": f"b{batch_size}_T{seq_len}_s{steps}",
         "final_loss": round(final_loss, 4),
-        "flash_attention": flash_used,
+        "precision": cfg.compute_dtype,
+        **attn_report,
         "model_tflops_per_step": round(flops / 1e12, 4),
-        "mfu": _mfu(flops, dt / steps, kind, n_dev),
+        "mfu": _mfu(flops, dt / steps, kind, n_dev, label="bench.bert"),
+    }
+
+
+def gpt_train_flops(cfg, batch: int, seq: int) -> float:
+    """Analytic matmul FLOPs for one causal-LM training step (fwd*3) —
+    same accounting as :func:`bert_train_flops` (the dense score matrix
+    is counted full; causal masking discards half the MXU work but the
+    MFU convention counts the dense shape, matching the bert row)."""
+    L, h, f, V = cfg.n_layers, cfg.hidden, cfg.ffn_dim, cfg.vocab_size
+    per_layer = (8 * batch * seq * h * h + 4 * batch * seq * h * f
+                 + 4 * batch * seq * seq * h)
+    return 3.0 * (L * per_layer + 2 * batch * seq * h * V)
+
+
+def bench_gpt(batch_size: int = 8, seq_len: int = 512, steps: int = 10):
+    """GPT causal-LM training throughput — the second training row of
+    the MFU campaign: flash attention + bf16 compute by default, MFU
+    estimate per row, honest flash reporting (see ``_training_attn``)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    platform, kind, n_dev = _platform_info()
+    if platform == "cpu":
+        # batch must divide the data mesh degree (>=8 rows, rounded up
+        # to a multiple of the virtual device count)
+        seq_len, steps = 128, 3
+        batch_size = n_dev * max(1, -(-8 // n_dev))
+        cfg = gpt.gpt_tiny(vocab_size=256, max_len=seq_len)
+    else:
+        cfg = gpt.gpt_config(max_len=max(seq_len, 1024))
+
+    mesh = make_mesh(MeshSpec(data=n_dev), devices=jax.devices())
+    attn, attn_report = _training_attn(
+        mesh, (batch_size, seq_len, cfg.n_heads, cfg.head_dim), causal=True)
+    init_fn, step_fn = gpt.make_train_step(
+        cfg, mesh, optimizer=optax.adamw(3e-4), attn_fn=attn)
+
+    state = init_fn(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (batch_size, seq_len), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    state, loss = step_fn(state, ids, jax.random.key(0))   # compile+warm
+    float(loss)                                            # true D2H sync
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, loss = step_fn(state, ids, jax.random.key(100 + i))
+    final_loss = float(loss)   # fetching the last loss bounds the chain
+    dt = time.perf_counter() - t0
+
+    tps = batch_size * seq_len * steps / dt
+    flops = gpt_train_flops(cfg, batch_size, seq_len)
+    return {
+        "metric": f"gpt_{'124m' if platform != 'cpu' else 'tiny'}_lm_train"
+                  f"_tokens_per_sec_per_chip_T{seq_len}",
+        "value": round(tps / n_dev, 1),
+        "unit": "tokens/sec/chip",
+        # same per-A100 anchor family as bert: tokens/s == samples/s * T
+        "vs_baseline": round(tps / n_dev
+                             / (A100_BERT_BASE_SEQ128_SPS * 128), 3),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"b{batch_size}_T{seq_len}_s{steps}",
+        "final_loss": round(final_loss, 4),
+        "precision": cfg.compute_dtype,
+        **attn_report,
+        "model_tflops_per_step": round(flops / 1e12, 4),
+        "mfu": _mfu(flops, dt / steps, kind, n_dev, label="bench.gpt"),
+    }
+
+
+def bench_attn_training(seq_len: int = 4096, batch_size: int = 1,
+                        steps: int = 5):
+    """Attention-IN-TRAINING comparison row: the same causal-LM loss
+    fwd+bwd with the flash kernel vs XLA attention through the REAL
+    training forward (``tfm.encode`` + tied-embedding CE), not the bare
+    attention microbench longctx already covers.
+
+    On CPU the flash path runs the Pallas interpreter: the row is the
+    parity evidence — the flash path is bit-consistent with itself in
+    fp32 (two runs, identical bytes) and tolerance-equal to XLA in fp32
+    and bf16 — while the step-time columns are plumbing only (the
+    interpreter distorts).  On TPU it is the measured step-time
+    improvement at long seq_len.  Either way the row drives one
+    persisted autotune sweep for the shape, so the winner + measured
+    crossover ride along."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.models import transformer as tfm
+    from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+    from deeplearning4j_tpu.runtime import autotune
+
+    platform, kind, n_dev = _platform_info()
+    if platform == "cpu":
+        seq_len, batch_size, steps = 128, 2, 2
+        cfg = gpt.gpt_tiny(vocab_size=256, max_len=seq_len)
+        sweep_blocks = ((32, 32),)
+    else:
+        cfg = gpt.gpt_config(vocab_size=32768, max_len=seq_len,
+                             hidden=768, n_layers=4, n_heads=12)
+        sweep_blocks = None          # the default TPU candidate grid
+
+    params = gpt.init_params(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (batch_size, seq_len), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    flash = make_attn_fn("pallas")   # forced: interpret off-TPU (parity)
+
+    def step_fn(attn):
+        def loss_fn(p, ids):
+            return gpt.lm_loss(cfg, p, ids, None, None, attn)
+        return jax.jit(jax.value_and_grad(loss_fn))
+
+    def timed(fn):
+        loss, grads = fn(params, ids)
+        _value_sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, grads = fn(params, ids)
+        _value_sync(loss)
+        return (time.perf_counter() - t0) / steps, grads
+
+    t_xla, g_xla = timed(step_fn(tfm.attention))
+    t_flash, g_flash = timed(step_fn(flash))
+
+    # parity THROUGH the training forward: logits + grads.  The fp32
+    # columns really run fp32 compute (gpt configs default bf16, which
+    # would silently relabel a bf16 measurement as the fp32 evidence).
+    def logits(attn, dtype):
+        c = dataclasses.replace(cfg, compute_dtype=dtype)
+        return np.asarray(gpt.lm_logits(
+            c, params, tfm.encode(c, params, ids, attn_fn=attn)),
+            np.float32)
+
+    lg_flash = logits(flash, "float32")
+    logits_diff = float(np.max(np.abs(
+        lg_flash - logits(tfm.attention, "float32"))))
+    bit_consistent = bool((lg_flash == logits(flash, "float32")).all())
+    bf16_diff = float(np.max(np.abs(
+        logits(flash, "bfloat16") - logits(tfm.attention, "bfloat16"))))
+    gdiff = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                      - b.astype(jnp.float32))))
+                for a, b in zip(jax.tree.leaves(g_flash),
+                                jax.tree.leaves(g_xla)))
+
+    sweep = autotune.sweep_attention(seq_len, seq_len, cfg.head_dim, True,
+                                     batch=batch_size,
+                                     n_heads=cfg.n_heads,
+                                     blocks=sweep_blocks, repeats=2)
+    return {
+        "metric": f"attn_training_flash_vs_xla_speedup_T{seq_len}",
+        "value": round(t_xla / t_flash, 3),
+        "unit": "x_speedup_fwdbwd",
+        "vs_baseline": round(t_xla / t_flash, 3),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"b{batch_size}_T{seq_len}_h{cfg.n_heads}"
+                      f"x{cfg.head_dim}_L{cfg.n_layers}_s{steps}",
+        "xla_step_ms": round(t_xla * 1e3, 2),
+        "flash_step_ms": round(t_flash * 1e3, 2),
+        "flash_kernel": "pallas" if platform == "tpu"
+                        else "pallas-interpret",
+        "flash_bit_consistent_fp32": bit_consistent,
+        "max_abs_logits_diff_fp32": logits_diff,
+        "max_abs_logits_diff_bf16": bf16_diff,
+        "max_abs_grad_diff": gdiff,
+        "autotune_winner": {k: sweep[k] for k in
+                            ("impl", "block_q", "block_k", "step_ms",
+                             "interpreted")},
+        "flash_crossover_seq": autotune.measured_crossover(
+            cfg.head_dim, True),
+        "note": None if platform == "tpu" else
+                "cpu: flash runs the Pallas interpreter — parity "
+                "evidence only; step-time improvement is a TPU claim",
     }
 
 
@@ -282,7 +492,8 @@ def bench_resnet(batch_size: int = 128, image_size: int = 224,
                       + ("_s2d" if stem_s2d else ""),
         "final_loss": round(final_loss, 4),
         "model_tflops_per_step": round(flops / 1e12, 4),
-        "mfu": _mfu(flops, dt / steps / 1, kind, n_dev) if flops else None,
+        "mfu": _mfu(flops, dt / steps / 1, kind, n_dev,
+                    label="bench.resnet") if flops else None,
     }
 
 
@@ -412,7 +623,7 @@ def bench_lenet(batch_size: int = 128, steps: int = 64, epochs: int = 64,
                                       * 1e3, 1),
         "tunnel_rtt_ms": rtt_ms,
         "model_tflops_per_step": round(flops / 1e12, 6),
-        "mfu": _mfu(flops, wi / n_batches, kind, 1),
+        "mfu": _mfu(flops, wi / n_batches, kind, 1, label="bench.lenet"),
     }
 
 
@@ -1487,7 +1698,8 @@ def bench_decode_serving(n_requests: int = 24, n_clients: int = 8,
     }
 
 
-INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
+INNER = {"probe": bench_probe, "bert": bench_bert, "gpt": bench_gpt,
+         "attn_training": bench_attn_training, "resnet": bench_resnet,
          "lenet": bench_lenet, "word2vec": bench_word2vec,
          "scaling": bench_scaling, "w2v_dp": bench_w2v_dp,
          "longctx": bench_longctx,
@@ -1518,7 +1730,11 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "resnet": bench_resnet,
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
-TIMEOUTS = {"probe": (240, 120), "bert": (900, 420), "resnet": (720, 420),
+TIMEOUTS = {"probe": (240, 120), "bert": (900, 420),
+            "gpt": (1200, 420),
+            # flash-vs-XLA through the training forward + one autotune
+            # sweep; cpu runs the interpreter at a shrunk T
+            "attn_training": (1200, 420), "resnet": (720, 420),
             "lenet": (600, 420),
             # word2vec runs warm+cold for all THREE pair modes (6 fits)
             "word2vec": (1500, 900),
@@ -1891,7 +2107,8 @@ def main() -> None:
     headline = run_config("bert", tpu_ok)
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
-    names = ["serving", "decode_serving", "dp_fit", "lenet", "resnet",
+    names = ["gpt", "attn_training", "serving", "decode_serving",
+             "dp_fit", "lenet", "resnet",
              "longctx", "word2vec", "glove", "scaling", "w2v_dp"]
     if tpu_ok:
         # tpu-only capability point LAST: if the suite budget runs out it

@@ -152,16 +152,26 @@ def _opt_state_shardings(optimizer, params_shape: PyTree, pshard: PyTree,
 
 def make_train_step(cfg: TransformerConfig, mesh: Mesh,
                     optimizer: Optional[optax.GradientTransformation] = None,
-                    attn_fn=tfm.attention, n_steps: int = 1
+                    attn_fn=None, n_steps: int = 1
                     ) -> Tuple[Callable, Callable]:
     """Returns (init_fn(key) -> TrainState, step_fn(state, batch, key)
     -> (state, loss)), both jitted with dp/tp/sp shardings over `mesh`.
+
+    ``attn_fn=None`` (the default) routes attention through the
+    ``ops/pallas_attention.make_attn_fn`` auto policy: the Pallas flash
+    kernel (autotuned block sizes, shard_map-placed over the mesh) when
+    it wins on this device/shape, plain XLA attention otherwise — the
+    fast kernel is the DEFAULT training path, not a bench-only opt-in.
+    Pass ``attn_fn=tfm.attention`` to force the XLA path.
 
     ``n_steps > 1`` runs that many optimizer steps per call as one
     ``lax.scan`` dispatch (per-step PRNG keys folded from ``key``) —
     benches use it so measured throughput is device throughput, not
     host->device dispatch latency (15-20 ms per call on a tunneled
     chip, comparable to small-model step compute)."""
+    if attn_fn is None:
+        from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+        attn_fn = make_attn_fn("auto", mesh=mesh)
     optimizer = optimizer or optax.adamw(1e-4, weight_decay=0.01)
 
     pspecs = param_specs(cfg)

@@ -86,9 +86,14 @@ class TrainState(NamedTuple):
 
 def make_train_step(cfg: TransformerConfig, mesh: Mesh,
                     optimizer: Optional[optax.GradientTransformation] = None,
-                    attn_fn=tfm.attention) -> Tuple[Callable, Callable]:
+                    attn_fn=None) -> Tuple[Callable, Callable]:
     """Same sharding scheme as models/bert.make_train_step: params over
-    the model axis (tp), batch over data."""
+    the model axis (tp), batch over data.  ``attn_fn=None`` defaults to
+    the ``make_attn_fn`` auto policy (causal flash attention on TPU when
+    it wins, XLA otherwise — see models/bert.make_train_step)."""
+    if attn_fn is None:
+        from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+        attn_fn = make_attn_fn("auto", mesh=mesh)
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s),
                           tfm.param_specs(cfg))

@@ -118,12 +118,13 @@ def param_specs(cfg: MoETransformerConfig) -> PyTree:
 
 def _block(cfg: MoETransformerConfig, x: Array, p: dict,
            moe_axis: Optional[str],
-           stat_axes: Tuple[str, ...] = ()) -> Tuple[Array, Array]:
+           stat_axes: Tuple[str, ...] = (),
+           attn_fn=tfm.attention) -> Tuple[Array, Array]:
     """One post-LN (BERT convention) causal block with an MoE FFN:
     x [b, T, H] fp32 -> (x', aux_loss).  The attention half is the
     shared ``tfm._attention_sublayer``; only the FFN differs."""
     cdt = jnp.dtype(cfg.compute_dtype)
-    x, _ = tfm._attention_sublayer(cfg, x, p, None, None)
+    x, _ = tfm._attention_sublayer(cfg, x, p, None, None, attn_fn)
 
     b, T, H = x.shape
     tok = x.reshape(b * T, H).astype(cdt)
@@ -137,7 +138,8 @@ def _block(cfg: MoETransformerConfig, x: Array, p: dict,
 
 def encode(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
            moe_axis: Optional[str] = None,
-           stat_axes: Tuple[str, ...] = ()) -> Tuple[Array, Array]:
+           stat_axes: Tuple[str, ...] = (),
+           attn_fn=tfm.attention) -> Tuple[Array, Array]:
     """ids [b, T] -> (hidden [b, T, H] fp32, mean aux loss over layers)."""
     e = params["embed"]
     T = token_ids.shape[-1]
@@ -145,7 +147,7 @@ def encode(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
     x = tfm.layer_norm(x, e["ln_g"], e["ln_b"], cfg.layer_norm_eps)
 
     def body(x, p):
-        return _block(cfg, x, p, moe_axis, stat_axes)
+        return _block(cfg, x, p, moe_axis, stat_axes, attn_fn)
 
     if cfg.remat:
         body = jax.checkpoint(body)
@@ -155,14 +157,16 @@ def encode(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
 
 def lm_loss(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
             moe_axis: Optional[str] = None,
-            stat_axes: Tuple[str, ...] = ()) -> Array:
+            stat_axes: Tuple[str, ...] = (),
+            attn_fn=tfm.attention) -> Array:
     """Causal next-token CE + weighted load-balance aux.  Under token
     sharding pass ``stat_axes`` so the aux forms from globally pmean-ed
     routing statistics (the Switch aux is nonlinear in them — a mean of
     per-shard aux values is NOT the global aux); the CE term is a
     per-shard mean over equal-sized shards, so a cross-shard pmean of the
     returned value is then exactly the un-sharded loss."""
-    hidden, aux = encode(cfg, params, token_ids, moe_axis, stat_axes)
+    hidden, aux = encode(cfg, params, token_ids, moe_axis, stat_axes,
+                         attn_fn)
     cdt = jnp.dtype(cfg.compute_dtype)
     logits = jnp.einsum("bth,vh->btv", hidden.astype(cdt),
                         params["embed"]["tok"].astype(cdt),
@@ -179,8 +183,8 @@ class TrainState(NamedTuple):
 
 
 def make_train_step(cfg: MoETransformerConfig, mesh: Mesh,
-                    optimizer: Optional[optax.GradientTransformation] = None
-                    ) -> Tuple[Callable, Callable]:
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    attn_fn=None) -> Tuple[Callable, Callable]:
     """dp×ep training step: ONE shard_map over (data, expert) — tokens
     shard over both axes (attention stays local), expert weights shard
     over ``expert``, MoE dispatch all_to_alls between shards, loss pmeans
@@ -193,6 +197,13 @@ def make_train_step(cfg: MoETransformerConfig, mesh: Mesh,
     """
     from deeplearning4j_tpu.compat import shard_map
 
+    if attn_fn is None:
+        # the loss below already runs INSIDE one shard_map over
+        # (data, expert): q/k/v reaching attention are per-shard local
+        # blocks, so the flash kernel dispatches directly (local=True)
+        # instead of wrapping a second shard_map
+        from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+        attn_fn = make_attn_fn("auto", local=True)
     optimizer = optimizer or optax.adamw(1e-3, weight_decay=0.01)
     ep = mesh.shape.get(EXPERT_AXIS, 1)
     if cfg.n_experts % ep != 0:
@@ -205,7 +216,8 @@ def make_train_step(cfg: MoETransformerConfig, mesh: Mesh,
     pspecs = param_specs(cfg)
 
     def local_loss(params, ids):
-        loss = lm_loss(cfg, params, ids, moe_axis, stat_axes=tok_axes)
+        loss = lm_loss(cfg, params, ids, moe_axis, stat_axes=tok_axes,
+                       attn_fn=attn_fn)
         for ax in tok_axes:
             loss = lax.pmean(loss, ax)
         return loss

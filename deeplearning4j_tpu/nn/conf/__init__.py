@@ -14,4 +14,5 @@ from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
     VisibleUnit,
     NeuralNetConfiguration,
     MultiLayerConfiguration,
+    MIXED_PRECISION_POLICIES,
 )

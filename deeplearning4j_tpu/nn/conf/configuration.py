@@ -263,6 +263,16 @@ class ListBuilder:
         self._mlc_kwargs["grad_accum"] = int(k)
         return self
 
+    def mixed_precision(self, policy: str) -> "ListBuilder":
+        """Network-level mixed-precision policy (see
+        MultiLayerConfiguration.mixed_precision)."""
+        if policy not in MIXED_PRECISION_POLICIES:
+            raise ValueError(
+                f"mixed_precision must be one of "
+                f"{MIXED_PRECISION_POLICIES}, got {policy!r}")
+        self._mlc_kwargs["mixed_precision"] = policy
+        return self
+
     def input_preprocessor(self, layer: int, name: str, **kw) -> "ListBuilder":
         self._mlc_kwargs.setdefault("input_preprocessors", {})[layer] = \
             {"name": name, **kw}
@@ -279,6 +289,12 @@ class ListBuilder:
         return MultiLayerConfiguration(confs=self._confs, **self._mlc_kwargs)
 
 
+#: network-level mixed-precision policies: "off" = fp32 throughout (the
+#: historical default), "bf16" = bf16 compute / fp32 master params and
+#: accumulators with dynamic loss scaling in the donated train step
+MIXED_PRECISION_POLICIES = ("off", "bf16")
+
+
 @dataclass
 class MultiLayerConfiguration:
     """Parity: nn/conf/MultiLayerConfiguration.java:32."""
@@ -293,6 +309,12 @@ class MultiLayerConfiguration:
     #: gradients and ONE update at the end — effective batch = micro x
     #: accum x n_devices at the HBM footprint of one microbatch
     grad_accum: int = 1
+    #: mixed-precision policy for the backprop train step: "bf16" runs the
+    #: forward/backward in bfloat16 against fp32 MASTER params (grads and
+    #: updater accumulators stay fp32) with dynamic loss scaling — an
+    #: overflowed step is skipped by the in-step guard and the scale
+    #: halves, collective-consistently under a mesh.  "off" = fp32.
+    mixed_precision: str = "off"
     # layer index -> preprocessor spec {"name": ..., **kwargs}
     input_preprocessors: Dict[int, Dict[str, Any]] = field(default_factory=dict)
     output_preprocessors: Dict[int, Dict[str, Any]] = field(default_factory=dict)
@@ -312,6 +334,7 @@ class MultiLayerConfiguration:
             "backprop": self.backprop,
             "use_drop_connect": self.use_drop_connect,
             "grad_accum": self.grad_accum,
+            "mixed_precision": self.mixed_precision,
             "input_preprocessors": {str(k): v for k, v in self.input_preprocessors.items()},
             "output_preprocessors": {str(k): v for k, v in self.output_preprocessors.items()},
         }
@@ -325,6 +348,7 @@ class MultiLayerConfiguration:
             backprop=bool(d.get("backprop", False)),
             use_drop_connect=bool(d.get("use_drop_connect", False)),
             grad_accum=int(d.get("grad_accum", 1)),
+            mixed_precision=str(d.get("mixed_precision", "off")),
             input_preprocessors={int(k): v for k, v in d.get("input_preprocessors", {}).items()},
             output_preprocessors={int(k): v for k, v in d.get("output_preprocessors", {}).items()},
         )

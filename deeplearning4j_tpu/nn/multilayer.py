@@ -35,7 +35,8 @@ from jax import lax
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.conf.configuration import (
-    LayerKind, MultiLayerConfiguration, NeuralNetConfiguration,
+    LayerKind, MIXED_PRECISION_POLICIES, MultiLayerConfiguration,
+    NeuralNetConfiguration,
 )
 from deeplearning4j_tpu.nn.conf.preprocessors import make_preprocessor
 from deeplearning4j_tpu.nn.layers import make_layer
@@ -426,6 +427,28 @@ class MultiLayerNetwork:
         derived from exactly this."""
         return self.conf.to_json()
 
+    def _mp_on(self) -> bool:
+        """Whether the conf's mixed-precision policy is active (and
+        fail-fast validation of the knob — an unknown policy must raise
+        at the fit boundary, not silently train fp32)."""
+        policy = getattr(self.conf, "mixed_precision", "off")
+        if policy not in MIXED_PRECISION_POLICIES:
+            raise ValueError(
+                f"mixed_precision must be one of "
+                f"{MIXED_PRECISION_POLICIES}, got {policy!r}")
+        return policy == "bf16"
+
+    @staticmethod
+    def _init_ustate(train_step, updaters, params):
+        """Fresh updater state for an engine step: the machinery's own
+        initializer when it exposes one (the mixed-precision bundle
+        threads the dynamic loss-scale state alongside the per-layer
+        updater states), else the plain per-layer list."""
+        init = getattr(train_step, "init_ustate", None)
+        if init is not None:
+            return init(params)
+        return [u.init(p) for u, p in zip(updaters, params)]
+
     def _backprop_machinery(self, mesh=None):
         """(train_step, train_epochs, updaters) from the MODULE-LEVEL
         compile engine, keyed on the canonical conf signature (plus the
@@ -456,15 +479,18 @@ class MultiLayerNetwork:
         fit entry points copy caller params once at the API boundary."""
         from deeplearning4j_tpu.parallel.mesh import mesh_signature
 
-        dp = mesh is not None or self.conf.grad_accum > 1
-        # the accum factor joins the memo key: ResilientFit's elastic
-        # recovery legitimately rebuilds on the same mesh signature with
-        # a different grad_accum (the one sanctioned conf mutation), and
-        # the engine key below would catch it while this per-net memo
-        # would not — a stale hit here trains with the wrong
-        # accumulation and breaks the effective-batch equivalence
+        dp = (mesh is not None or self.conf.grad_accum > 1
+              or self._mp_on())
+        # the accum factor AND the mixed-precision policy join the memo
+        # key: ResilientFit's elastic recovery legitimately rebuilds on
+        # the same mesh signature with a different grad_accum, and a
+        # caller may flip conf.mixed_precision between fits — the engine
+        # key below (conf JSON) would catch both while this per-net memo
+        # would not, and a stale hit trains with the wrong accumulation
+        # or silently with the wrong precision/loss-scaling
         memo_key = (("dp", mesh_signature(mesh),
-                     max(self.conf.grad_accum, 1)) if dp else "legacy")
+                     max(self.conf.grad_accum, 1), self._mp_on())
+                    if dp else "legacy")
         if memo_key not in self._bp_cache:
             if dp:
                 self._bp_cache[memo_key] = compile_cache.get_or_build(
@@ -598,7 +624,19 @@ class MultiLayerNetwork:
         full-batch mean exactly.  The in-step guard then sees the
         COLLECTIVE (score, grads): one shard's non-finite gradient
         poisons the psum, so every replica skips the same step and the
-        replicated params cannot diverge."""
+        replicated params cannot diverge.
+
+        ``conf.mixed_precision == "bf16"`` additionally runs the
+        forward/backward in bfloat16 against fp32 MASTER params (the
+        cast lives inside the objective, so grads and every updater
+        accumulator stay fp32) with DYNAMIC loss scaling: the loss is
+        multiplied by the scale before the backward, grads unscaled in
+        the same global divide as the mean, and an overflowed step rides
+        the existing guard — the collective skip verdict both drops the
+        update and halves the scale on every replica identically
+        (``parallel/sharded_fit.next_loss_scale``).  The scale state
+        threads through the scanned epochs alongside the updater state;
+        the bundle's ``init_ustate`` builds the combined structure."""
         from jax.sharding import PartitionSpec as P
 
         from deeplearning4j_tpu.parallel import sharded_fit
@@ -616,12 +654,19 @@ class MultiLayerNetwork:
                      if c.kind is LayerKind.BATCH_NORM]
         accum = max(net.conf.grad_accum, 1)
         axis = DATA_AXIS if mesh is not None else None
+        mp_on = net._mp_on()
 
         def micro_fn(params, x, y, mask, key):
             """Masked SUM loss + masked BN-stat sums for one microbatch
             (the unit both the accumulation scan and the shard psum
-            combine linearly)."""
+            combine linearly).  Under mixed precision the fp32 masters
+            are cast to bf16 HERE — inside the differentiated function —
+            so the backward re-casts gradients to fp32."""
             n = len(net.layers)
+            if mp_on:
+                params = sharded_fit.mp_cast(params)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(jnp.bfloat16)
             acts = net.feed_forward(params, x, key, train=True, upto=n - 1)
             h = acts[-1]
             last = n - 1
@@ -645,6 +690,14 @@ class MultiLayerNetwork:
             return loss_sum, stats
 
         def dp_step(params, ustate, batch, key, iteration):
+            if mp_on:
+                # the dynamic loss-scale state rides NEXT TO the per-
+                # layer updater states so it threads through the scanned
+                # epochs (and checkpoints) with zero builder changes
+                layer_ustate, ls = ustate
+                scale = ls["scale"]
+            else:
+                layer_ustate, ls, scale = ustate, None, None
             x, y, n_valid = batch
             key = jax.random.fold_in(key, iteration)
             local = x.shape[0]
@@ -662,9 +715,17 @@ class MultiLayerNetwork:
             # only ever extends the tail), so no psum is needed for it
             count = n_valid.astype(jnp.float32)
 
+            def scaled_obj(p, xi, yi, mi, ki):
+                """The differentiated objective: loss-scaled sum (what
+                the backward sees) with the unscaled sum riding as aux
+                for the score."""
+                loss_sum, stats = micro_fn(p, xi, yi, mi, ki)
+                scaled = loss_sum * scale if mp_on else loss_sum
+                return scaled, (loss_sum, stats)
+
             if accum == 1:
-                (loss_sum, stats), grads = jax.value_and_grad(
-                    micro_fn, has_aux=True)(params, x, y, mask, key)
+                (_, (loss_sum, stats)), grads = jax.value_and_grad(
+                    scaled_obj, has_aux=True)(params, x, y, mask, key)
             else:
                 micro = local // accum
                 xm = x.reshape((accum, micro) + x.shape[1:])
@@ -674,8 +735,8 @@ class MultiLayerNetwork:
                 def micro_body(carry, inp):
                     g_acc, s_acc = carry
                     xi, yi, mi, i = inp
-                    (s, st), g = jax.value_and_grad(
-                        micro_fn, has_aux=True)(
+                    (_, (s, st)), g = jax.value_and_grad(
+                        scaled_obj, has_aux=True)(
                             params, xi, yi, mi,
                             jax.random.fold_in(key, i))
                     # fp32 sum accumulators: constant-HBM effective
@@ -700,11 +761,15 @@ class MultiLayerNetwork:
                 stats = jax.tree.map(lambda s: lax.psum(s, axis), stats)
             denom = jnp.maximum(count, 1.0)
             score = loss_sum / denom
-            grads = jax.tree.map(lambda g: g / denom, grads)
+            # one global divide finishes mean AND loss-scale unscaling;
+            # an overflowed backward leaves inf/NaN in the grads here,
+            # which the collective guard below turns into a skip
+            gdenom = denom * scale if mp_on else denom
+            grads = jax.tree.map(lambda g: g / gdenom, grads)
 
             new_params, new_ustate = [], []
             for i, upd in enumerate(updaters):
-                u_i, s_i = upd.update(ustate[i], grads[i], params[i],
+                u_i, s_i = upd.update(layer_ustate[i], grads[i], params[i],
                                       iteration, 1)
                 new_params.append(apply_updates(params[i], u_i))
                 new_ustate.append(s_i)
@@ -721,7 +786,16 @@ class MultiLayerNetwork:
                 p["running_var"] = 0.9 * p["running_var"] + 0.1 * var
                 new_params[i] = p
             new_params, new_ustate, skipped = resilience.guard_update(
-                params, ustate, new_params, new_ustate, (score, grads))
+                params, layer_ustate, new_params, new_ustate,
+                (score, grads))
+            if mp_on:
+                # the scale transition deliberately BYPASSES the guard:
+                # a skipped (overflowed) step must still halve the scale
+                # — that is the recovery.  ``skipped`` is collective, so
+                # every replica takes the same transition.
+                return (new_params, (new_ustate,
+                                     sharded_fit.next_loss_scale(
+                                         ls, skipped)), score, skipped)
             return new_params, new_ustate, score, skipped
 
         batch_specs = (P(DATA_AXIS), P(DATA_AXIS), P()) \
@@ -732,8 +806,17 @@ class MultiLayerNetwork:
         train_epochs = sharded_fit.build_scanned_epochs(
             dp_step, mesh, batch_specs=batch_specs,
             label="multilayer.train_epochs")
-        train_step.takes_n_valid = True
-        train_epochs.takes_n_valid = True
+
+        def init_ustate(params):
+            layer_u = [u.init(p) for u, p in zip(updaters, params)]
+            if mp_on:
+                return (layer_u, sharded_fit.init_loss_scale())
+            return layer_u
+
+        for fn in (train_step, train_epochs):
+            fn.takes_n_valid = True
+            fn.init_ustate = init_ustate
+            fn.mixed_precision = mp_on
         return (train_step, train_epochs, updaters)
 
     def _resolve_fit_mesh(self, mesh, min_batch: int):
@@ -827,7 +910,8 @@ class MultiLayerNetwork:
         self._notify_fit_start()
         min_batch = min(b.features.shape[0] for b in batches)
         rmesh = self._resolve_fit_mesh(mesh, min_batch)
-        dp = rmesh is not None or self.conf.grad_accum > 1
+        dp = (rmesh is not None or self.conf.grad_accum > 1
+              or self._mp_on())
         with telemetry.span("multilayer.fit", path="dp" if dp else "single",
                             epochs=num_epochs, batches=len(batches)):
             if dp:
@@ -844,7 +928,7 @@ class MultiLayerNetwork:
         # caller ever saw, get consumed in place)
         params = jax.tree.map(jnp.copy, self._require_params())
         train_step, train_epochs, updaters = self._backprop_machinery()
-        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        ustate = self._init_ustate(train_step, updaters, params)
         run_key = jax.random.key(seed)
         # the scanned path stacks every batch on device: only take it when
         # the whole dataset comfortably fits in HBM, else stream per-step.
@@ -910,7 +994,7 @@ class MultiLayerNetwork:
 
         params = jax.tree.map(jnp.copy, self._require_params())
         train_step, train_epochs, updaters = self._backprop_machinery(rmesh)
-        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        ustate = self._init_ustate(train_step, updaters, params)
         run_key = jax.random.key(seed)
         accum = max(self.conf.grad_accum, 1)
         ndp = rmesh.shape[DATA_AXIS] if rmesh is not None else 1
@@ -1094,7 +1178,7 @@ class MultiLayerNetwork:
         # donation guard — see fit_backprop
         params = jax.tree.map(jnp.copy, self._require_params())
         train_step, _, updaters = self._backprop_machinery(rmesh)
-        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        ustate = self._init_ustate(train_step, updaters, params)
         run_key = jax.random.key(seed)
         dp_mode = getattr(train_step, "takes_n_valid", False)
         accum = max(self.conf.grad_accum, 1)

@@ -1,19 +1,65 @@
-"""Shared Pallas-vs-XLA kernel selection for the NLP trainers.
+"""Shared Pallas-vs-XLA kernel selection policy.
 
-Word2Vec and GloVe both auto-select a VMEM-resident Pallas kernel on TPU
-when their tables fit, fall back to the XLA gather/scatter path
-otherwise, and honor a forced ``kernel=`` config value ("pallas" off-TPU
-runs through the interpreter — the test harness).  This is the one copy
-of that policy.
+Word2Vec and GloVe auto-select a VMEM-resident Pallas kernel on TPU when
+their tables fit, fall back to the XLA gather/scatter path otherwise,
+and honor a forced ``kernel=`` config value ("pallas" off-TPU runs
+through the interpreter — the test harness).  ``resolve_attn_kernel``
+generalizes the same contract to the flash-attention training path
+(ops/pallas_attention.make_attn_fn): auto-selection may consult an
+autotuned winner, an explicit ``kernel="pallas"`` request NEVER falls
+back silently, and off-TPU a forced Pallas kernel runs interpreted so
+tier-1 exercises the kernel code path.  This is the one copy of that
+policy.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 
 KERNELS = ("auto", "pallas", "xla")
+
+#: attention kernel modes share the NLP vocabulary — one policy, one spelling
+ATTN_KERNELS = KERNELS
+
+
+def resolve_attn_kernel(kernel: str, *, k_len: int, aligned: bool,
+                        on_tpu: bool, blocked: Optional[str] = None,
+                        autotuned_impl: Optional[str] = None,
+                        min_seq: int, desc: str = "flash attention"
+                        ) -> Tuple[str, bool]:
+    """(impl, interpret) for a requested attention ``kernel`` mode.
+
+    ``aligned`` is the Mosaic-tileability verdict for the shape,
+    ``blocked`` an optional reason the Pallas kernel cannot run in this
+    context at all (seq-parallel mesh, indivisible sharding, ...).
+    ``autotuned_impl`` is a persisted sweep winner ("pallas"/"xla") that
+    overrides the ``min_seq`` heuristic for auto mode on TPU.
+
+    Contract (same as :func:`resolve_kernel` for word2vec/glove): auto
+    degrades silently, an explicit ``kernel='pallas'`` raises instead of
+    falling back, and a forced Pallas kernel off-TPU runs through the
+    interpreter (the CPU test harness)."""
+    if kernel not in ATTN_KERNELS:
+        raise ValueError(
+            f"kernel must be one of {ATTN_KERNELS}, got {kernel!r}")
+    if kernel == "xla":
+        return "xla", False
+    if aligned and blocked is None:
+        if kernel == "pallas":
+            return "pallas", not on_tpu
+        if not on_tpu:
+            return "xla", False          # auto off-TPU: interpreter is
+        if autotuned_impl in ("pallas", "xla"):   # no training kernel
+            return autotuned_impl, False
+        return ("pallas" if k_len >= min_seq else "xla"), False
+    if kernel == "pallas":
+        raise ValueError(
+            f"kernel='pallas' but {desc} cannot run the Pallas kernel: "
+            f"{blocked or 'shape is not Mosaic-tileable'} — never a "
+            f"silent fallback on an explicit request")
+    return "xla", False
 
 
 def resolve_kernel(kernel: str, block: int, desc: str

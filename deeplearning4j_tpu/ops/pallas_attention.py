@@ -24,6 +24,7 @@ the plain XLA implementation off-TPU or for unaligned shapes.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -437,6 +438,156 @@ def attention_auto(q: Array, k: Array, v: Array,
     return tfm.attention(q, k, v, mask, causal)
 
 
+#: default Pallas block sizes when no autotuned winner is on record
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class AttnDecision:
+    """What the training attention dispatch decided for one shape — the
+    honest record bench rows report instead of guessing from seq_len:
+    ``impl`` is what actually runs ("pallas"/"xla"), ``source`` where the
+    verdict came from ("forced" / "autotuned" / "heuristic" / a fallback
+    reason), ``crossover`` the Pallas-wins sequence threshold consulted
+    by the auto heuristic."""
+    impl: str
+    interpret: bool
+    block_q: int
+    block_k: int
+    source: str
+    crossover: int
+
+    @property
+    def kernel_name(self) -> str:
+        if self.impl != "pallas":
+            return "xla"
+        return "pallas-interpret" if self.interpret else "pallas"
+
+
+def make_attn_fn(kernel: str = "auto", mesh=None, *, local: bool = False,
+                 autotune: bool = True):
+    """The default training-path attention: trace-time Pallas-vs-XLA
+    dispatch through the shared ``ops/kernel_select`` policy.
+
+    Returns an ``attn(q, k, v, mask=None, causal=False)`` drop-in for
+    ``models/transformer.attention``.  At trace time it looks at the
+    concrete shapes and decides per the policy:
+
+    - ``kernel="xla"`` forces the plain attention; ``kernel="pallas"``
+      forces the flash kernel and RAISES where it cannot run (never a
+      silent fallback on an explicit request — interpret mode off-TPU,
+      the CPU test harness); ``"auto"`` picks the winner.
+    - auto consults the persistent autotuner (``runtime/autotune.py``)
+      for this (device kind, shape bucket) first — a swept verdict
+      overrides the static ``FLASH_MIN_SEQ`` crossover, and its winning
+      ``block_q``/``block_k`` replace the defaults whenever the Pallas
+      kernel runs.
+    - under a multi-device ``mesh`` the kernel is placed in a
+      ``shard_map`` over (data, model) — a raw ``pallas_call`` inside a
+      GSPMD-jitted step is an opaque custom call the partitioner cannot
+      split; ``local=True`` says q/k/v are ALREADY per-shard blocks
+      (caller is inside its own shard_map, e.g. models/moe.py) so the
+      kernel dispatches directly.
+
+    ``attn.describe(q_shape, k_shape, causal)`` returns the
+    :class:`AttnDecision` for a shape without tracing — what bench rows
+    record as the flash-reporting evidence.
+    """
+    from deeplearning4j_tpu.ops import kernel_select as ks
+
+    if kernel not in ks.ATTN_KERNELS:
+        raise ValueError(
+            f"kernel must be one of {ks.ATTN_KERNELS}, got {kernel!r}")
+
+    def describe(q_shape, k_shape, causal: bool = False) -> AttnDecision:
+        from deeplearning4j_tpu.parallel.mesh import (
+            DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+        B, Tq, NH, D = q_shape
+        Tk = k_shape[1]
+        on_tpu = jax.devices()[0].platform == "tpu"
+        aligned = _aligned_for_tpu(Tq, Tk, D)
+        blocked = None
+        if mesh is not None and not local:
+            if mesh.shape.get(SEQ_AXIS, 1) > 1:
+                blocked = ("mesh has a seq axis — ring attention owns "
+                           "sequence parallelism")
+            else:
+                dp = mesh.shape.get(DATA_AXIS, 1)
+                tp = mesh.shape.get(MODEL_AXIS, 1)
+                if B % dp != 0 or NH % tp != 0:
+                    blocked = (f"batch {B} / heads {NH} do not divide "
+                               f"the mesh degrees (data={dp}, model={tp})")
+        elif (mesh is None and not local and kernel == "auto"
+              and on_tpu and jax.device_count() > 1):
+            # an auto-selected pallas_call inside a GSPMD-partitioned jit
+            # cannot be split; a forced "pallas" trusts the caller's
+            # placement (single-program harnesses, explicit shard_map)
+            blocked = "multiple devices without a mesh (use mesh=)"
+
+        record = None
+        # consult only where the verdict can matter: auto on TPU (impl
+        # override) or a forced pallas anywhere (block-size override) —
+        # auto off-TPU is XLA unconditionally, and booking consults for
+        # it would inflate the mfu family's cache-miss evidence
+        if (autotune and aligned and blocked is None
+                and (on_tpu or kernel == "pallas")):
+            from deeplearning4j_tpu.runtime import autotune as at
+            record = at.lookup_attention(Tq, Tk, D, causal)
+
+        impl, interpret = ks.resolve_attn_kernel(
+            kernel, k_len=Tk, aligned=aligned, on_tpu=on_tpu,
+            blocked=blocked,
+            autotuned_impl=record["impl"] if record else None,
+            min_seq=FLASH_MIN_SEQ, desc="training attention")
+        bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+        if impl == "pallas" and record and record.get("impl") == "pallas":
+            bq = int(record.get("block_q", bq))
+            bk = int(record.get("block_k", bk))
+        if kernel != "auto":
+            source = "forced"
+        elif impl == "xla" and (blocked or not aligned or not on_tpu):
+            source = (blocked or
+                      ("shape not Mosaic-tileable" if not aligned
+                       else "off-tpu"))
+        else:
+            source = "autotuned" if record else "heuristic"
+        return AttnDecision(impl=impl, interpret=interpret, block_q=bq,
+                            block_k=bk, source=source,
+                            crossover=FLASH_MIN_SEQ)
+
+    def attn(q, k, v, mask=None, causal=False):
+        from deeplearning4j_tpu.models import transformer as tfm
+
+        d = describe(q.shape, k.shape, causal)
+        if d.impl != "pallas":
+            return tfm.attention(q, k, v, mask, causal)
+        if mesh is not None and not local and mesh.size > 1:
+            from jax.sharding import PartitionSpec as P
+
+            from deeplearning4j_tpu.compat import shard_map
+            from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS,
+                                                          MODEL_AXIS)
+            qspec = P(DATA_AXIS, None, MODEL_AXIS, None)
+            mspec = P(DATA_AXIS, None)
+            if mask is None:
+                mask = jnp.ones((q.shape[0], k.shape[1]), jnp.float32)
+            f = shard_map(
+                lambda q, k, v, m: flash_attention(
+                    q, k, v, m, causal, block_q=d.block_q,
+                    block_k=d.block_k, interpret=d.interpret),
+                mesh=mesh, in_specs=(qspec, qspec, qspec, mspec),
+                out_specs=qspec, check_vma=False)
+            return f(q, k, v, mask)
+        return flash_attention(q, k, v, mask, causal, block_q=d.block_q,
+                               block_k=d.block_k, interpret=d.interpret)
+
+    attn.describe = describe
+    attn.kernel = kernel
+    return attn
+
+
 def make_flash_attn(mesh):
     """Mesh-aware flash attention for multi-chip training steps.
 
@@ -446,37 +597,14 @@ def make_flash_attn(mesh):
     sharded over (``data`` for the batch, ``model`` for heads — attention
     is independent per (batch, head), so no collectives are needed).
     Falls back to plain XLA attention off-TPU, under sequence parallelism
-    (ring attention owns that axis), or for unaligned shapes.
+    (ring attention owns that axis), or for unaligned shapes.  Since the
+    MFU campaign this is a thin wrapper over :func:`make_attn_fn` —
+    selection (autotuned winners included) lives there.
     """
-    from deeplearning4j_tpu.compat import shard_map
-    from jax.sharding import PartitionSpec as P
-
     from deeplearning4j_tpu.models import transformer as tfm
-    from deeplearning4j_tpu.parallel.mesh import (
-        DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+    from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS
 
     if (jax.devices()[0].platform != "tpu"
             or mesh.shape.get(SEQ_AXIS, 1) > 1):
         return tfm.attention
-
-    dp = mesh.shape.get(DATA_AXIS, 1)
-    tp = mesh.shape.get(MODEL_AXIS, 1)
-    qspec = P(DATA_AXIS, None, MODEL_AXIS, None)
-    mspec = P(DATA_AXIS, None)
-
-    def attn(q, k, v, mask=None, causal=False):
-        B, Tq, NH, D = q.shape
-        Tk = k.shape[1]
-        if (B % dp != 0 or NH % tp != 0 or Tk < FLASH_MIN_SEQ
-                or not _aligned_for_tpu(Tq, Tk, D)):
-            return tfm.attention(q, k, v, mask, causal)
-        if mask is None:
-            mask = jnp.ones((B, Tk), jnp.float32)
-        f = shard_map(
-            lambda q, k, v, m: flash_attention(q, k, v, m, causal,
-                                               interpret=False),
-            mesh=mesh, in_specs=(qspec, qspec, qspec, mspec),
-            out_specs=qspec, check_vma=False)
-        return f(q, k, v, mask)
-
-    return attn
+    return make_attn_fn("auto", mesh=mesh)

@@ -33,6 +33,7 @@ import functools
 from typing import Any, Callable, Hashable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -50,6 +51,67 @@ ShardStep = Callable[..., Tuple[PyTree, PyTree, jax.Array, jax.Array]]
 #: per-batch instead (same number MultiLayerNetwork.SCAN_MAX_DATASET_BYTES
 #: has used since PR 1)
 SCAN_MAX_DATASET_BYTES = 256 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# mixed precision: bf16 compute / fp32 master + dynamic loss scaling
+# ---------------------------------------------------------------------------
+# The policy half of ``MultiLayerConfiguration.mixed_precision``: the
+# step builders (nn/multilayer._build_dp_machinery) cast params to the
+# compute dtype INSIDE the objective — grads flow back through the cast
+# as fp32, so master params and every updater accumulator stay fp32 —
+# and thread the scale state below through the scanned epochs alongside
+# the updater state.  The skip-on-overflow decision rides the PR 2 guard
+# (``resilience.guard_update``) on the POST-psum grads, so under a mesh
+# every replica halves (or grows) the scale identically and replicated
+# state never diverges; all transitions are ``jnp.where`` selects, never
+# traced branches (jaxlint's divergent-branch rule stays clean).
+
+#: initial dynamic loss scale (2^15 — the classic mixed-precision seed;
+#: bf16's fp32-sized exponent makes overflow rare, so the scale mostly
+#: idles at its cap, but a genuine overflow still halves it and skips)
+LOSS_SCALE_INIT = 2.0 ** 15
+#: floor/cap the dynamic scale walks between
+LOSS_SCALE_MIN = 1.0
+LOSS_SCALE_MAX = 2.0 ** 24
+#: consecutive finite steps before the scale doubles
+LOSS_SCALE_GROWTH_INTERVAL = 200
+
+
+def init_loss_scale() -> dict:
+    """Fresh dynamic-loss-scale state: the scale itself plus the count
+    of consecutive good (non-skipped) steps since the last change."""
+    return {"scale": jnp.float32(LOSS_SCALE_INIT),
+            "good_steps": jnp.int32(0)}
+
+
+def next_loss_scale(state: dict, skipped) -> dict:
+    """One dynamic-loss-scale transition from a step's guard verdict
+    (``skipped``: int32/bool scalar, 1 = update dropped on overflow):
+    halve on skip (floored), double after ``LOSS_SCALE_GROWTH_INTERVAL``
+    consecutive good steps (capped).  Pure ``jnp.where`` — one program
+    for both outcomes, and the verdict is already collective under a
+    mesh, so the state is replica-consistent by construction."""
+    bad = jnp.asarray(skipped) > 0
+    good = jnp.where(bad, 0, state["good_steps"] + 1)
+    grow = good >= LOSS_SCALE_GROWTH_INTERVAL
+    scale = jnp.where(
+        bad, jnp.maximum(state["scale"] * 0.5, LOSS_SCALE_MIN),
+        jnp.where(grow, jnp.minimum(state["scale"] * 2.0, LOSS_SCALE_MAX),
+                  state["scale"]))
+    return {"scale": scale,
+            "good_steps": jnp.where(grow, 0, good).astype(jnp.int32)}
+
+
+def mp_cast(tree: PyTree, dtype=None) -> PyTree:
+    """Compute-dtype view of an fp32 master pytree: float32 leaves cast
+    to ``dtype`` (default bfloat16), everything else (ints, bools,
+    already-low-precision leaves) untouched.  Differentiating THROUGH
+    this cast is what keeps grads fp32 against fp32 masters."""
+    dtype = dtype or jnp.bfloat16
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if getattr(a, "dtype", None) == jnp.float32 else a, tree)
 
 
 def _with_dispatch_span(compiled, label: str, scanned: bool):

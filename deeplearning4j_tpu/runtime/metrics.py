@@ -562,6 +562,100 @@ class CheckpointMetrics:
 #: process-wide singleton the checkpoint/preemption/elastic layer reports into
 checkpoint_metrics = CheckpointMetrics()
 
+
+#: published bf16 peak FLOP/s per chip by device_kind substring — the
+#: denominator of every MFU estimate (single source; bench.py and the
+#: autotuner both consult it here)
+TPU_PEAK_FLOPS = (
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v5litepod", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def chip_peak_flops(device_kind: str) -> Optional[float]:
+    """bf16 peak FLOP/s for a device kind (None when unknown, e.g. CPU)."""
+    dk = (device_kind or "").lower()
+    for sub, peak in TPU_PEAK_FLOPS:
+        if sub in dk:
+            return peak
+    return None
+
+
+def estimate_mfu(flops_per_step: float, step_s: float, device_kind: str,
+                 n_dev: int = 1) -> Optional[float]:
+    """Model FLOPs utilization: analytic FLOPs per step / measured step
+    wall time / fleet bf16 peak.  None when the chip's peak is unknown
+    or the timing is degenerate."""
+    peak = chip_peak_flops(device_kind)
+    if peak is None or step_s <= 0 or n_dev <= 0:
+        return None
+    return flops_per_step / step_s / (peak * n_dev)
+
+
+class MfuMetrics:
+    """Process-wide counters for the MFU campaign (runtime/autotune.py +
+    the bench rows) — the counter family everything hardware-utilization
+    reports into:
+
+    - per-label MFU **estimates**: ``note_mfu(label, flops, step_s,
+      kind, n_dev)`` books analytic-FLOPs / measured-step-time / device-
+      peak for a training loop or bench row (last value per label, with
+      the inputs kept so a reader can re-derive it);
+    - open-ended autotune counters via ``note`` — the autotuner books
+      ``sweeps`` / ``candidates_timed`` / ``winners_persisted`` /
+      ``consults`` / ``cache_hits`` / ``cache_misses`` so "zero
+      re-sweeps in a warmed process" is a machine-checkable assertion
+      (tools/autotune_gate.py), not a claim.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters: Dict[str, int] = {}
+            self._estimates: Dict[str, Dict[str, Any]] = {}
+
+    def note(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return self._counters.get(key, 0)
+
+    def note_mfu(self, label: str, flops_per_step: float, step_s: float,
+                 device_kind: str, n_dev: int = 1) -> Optional[float]:
+        est = estimate_mfu(flops_per_step, step_s, device_kind, n_dev)
+        with self._lock:
+            self._estimates[label] = {
+                "mfu": round(est, 4) if est is not None else None,
+                "tflops_per_step": round(flops_per_step / 1e12, 4),
+                "step_ms": round(step_s * 1e3, 3),
+                "device_kind": device_kind,
+                "n_devices": int(n_dev),
+            }
+        return est
+
+    def estimate(self, label: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            e = self._estimates.get(label)
+            return dict(e) if e else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            out["estimates"] = {k: dict(v)
+                                for k, v in self._estimates.items()}
+            return out
+
+
+#: process-wide singleton the autotuner + MFU estimators report into
+mfu_metrics = MfuMetrics()
+
+
 def device_memory_stats() -> Dict[str, Any]:
     """Per-device HBM usage where the backend reports it.
 

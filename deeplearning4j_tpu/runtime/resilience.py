@@ -629,6 +629,14 @@ class ResilientFit:
             dp_mode = getattr(train_step, "takes_n_valid", False)
             pad_chunk = net._pad_chunk(
                 self.mesh, max(net.conf.grad_accum, 1)) if dp_mode else 1
+            # ustate construction delegates to the model's own policy
+            # (MultiLayerNetwork._init_ustate: the bundle's init_ustate
+            # when it has one — mixed precision threads loss-scale state
+            # through the updater slot — else the per-layer list); bound
+            # here so fit/restore templates can never drift from it
+            self._ustate_init = (
+                lambda params, _ts=train_step, _u=updaters:
+                net._init_ustate(_ts, _u, params))
         finally:
             net.conf.grad_accum = orig_accum
 
@@ -647,12 +655,22 @@ class ResilientFit:
 
         return dispatch, updaters
 
+    def _make_ustate(self, updaters, params):
+        """Fresh updater state matching the CURRENT dispatch's engine
+        step (one policy — ``MultiLayerNetwork._init_ustate`` — bound in
+        ``_build_dispatch``; plain per-layer fallback only before any
+        dispatch exists)."""
+        init = getattr(self, "_ustate_init", None)
+        if init is not None:
+            return init(params)
+        return [u.init(p) for u, p in zip(updaters, params)]
+
     def _restore_latest(self, net, updaters):
         """Restore the newest COMMITTED checkpoint (corrupt/uncommitted
         steps fall back to the previous good one — CheckpointManager's
         manifest protocol) against fresh templates."""
         tpl_p = jax.tree.map(jnp.copy, net._require_params())
-        tpl_u = [u.init(p) for u, p in zip(updaters, tpl_p)]
+        tpl_u = self._make_ustate(updaters, tpl_p)
         (params, ustate), meta = self.manager.restore(like=(tpl_p, tpl_u))
         self._check_restored(params, meta.get("step"))
         return params, ustate, meta
@@ -739,7 +757,7 @@ class ResilientFit:
         # fit_backprop)
         params = jax.tree.map(jnp.copy, net._require_params())
         dispatch, updaters = self._build_dispatch(net)
-        ustate = [u.init(p) for u, p in zip(updaters, params)]
+        ustate = self._make_ustate(updaters, params)
         run_key = jax.random.key(seed)
 
         step = 0

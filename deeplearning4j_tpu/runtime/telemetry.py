@@ -54,6 +54,7 @@ from deeplearning4j_tpu.runtime.metrics import (checkpoint_metrics,
                                                 decode_metrics,
                                                 device_memory_stats,
                                                 dp_metrics,
+                                                mfu_metrics,
                                                 peak_bytes_in_use,
                                                 resilience_metrics,
                                                 serving_metrics)
@@ -506,6 +507,7 @@ registry.register("serving", serving_metrics)
 registry.register("decode", decode_metrics)
 registry.register("dp", dp_metrics)
 registry.register("checkpoint", checkpoint_metrics)
+registry.register("mfu", mfu_metrics)
 
 
 # ---------------------------------------------------------------------------

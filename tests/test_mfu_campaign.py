@@ -1,0 +1,379 @@
+"""MFU-campaign tier-1 tests (ROADMAP item 3 tentpole):
+
+- flash attention THROUGH the training path: forward logits and grads of
+  the real bert (masked-MLM) and gpt (causal) training objectives match
+  between the forced Pallas kernel (interpret mode on the CPU harness)
+  and plain XLA attention;
+- kernel selection contract: an explicit ``kernel="pallas"`` never
+  falls back silently, auto off-TPU runs the XLA program exactly;
+- bf16 mixed precision with dynamic loss scaling: fp32 master params,
+  an injected overflow skips the step and halves the scale without
+  diverging a sharded fit, scale growth/floor/cap transitions;
+- the persistent autotuner: sweep -> winner on disk -> a second process
+  consults the cache with zero re-sweeps; the ``mfu`` counter family.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models import bert, gpt
+from deeplearning4j_tpu.models import transformer as tfm
+from deeplearning4j_tpu.nn.conf import (LayerKind, MultiLayerConfiguration,
+                                        NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+from deeplearning4j_tpu.parallel import sharded_fit
+from deeplearning4j_tpu.parallel.mesh import auto_data_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fp32(cfg):
+    import dataclasses
+    return dataclasses.replace(cfg, compute_dtype="float32")
+
+
+# -- flash attention through the training path ------------------------------
+
+def test_gpt_training_flash_parity_logits_and_grads():
+    """Causal variant: lm_loss fwd+grads with the forced Pallas kernel
+    (interpreter on CPU) vs XLA attention, fp32 compute."""
+    cfg = _fp32(gpt.gpt_tiny(vocab_size=128, max_len=64))
+    params = gpt.init_params(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 64), 0, 128,
+                             dtype=jnp.int32)
+    flash = make_attn_fn("pallas", autotune=False)
+
+    def loss(attn):
+        return lambda p: gpt.lm_loss(cfg, p, ids, None, None, attn)
+
+    l_ref, g_ref = jax.value_and_grad(loss(tfm.attention))(params)
+    l_fl, g_fl = jax.value_and_grad(loss(flash))(params)
+    np.testing.assert_allclose(float(l_fl), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_fl), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_bert_training_flash_parity_masked_mlm():
+    """Masked-MLM variant: ragged attention masks flow through the flash
+    bias path identically to XLA's additive mask."""
+    cfg = _fp32(bert.bert_tiny(vocab_size=128, max_len=64))
+    params = bert.init_params(jax.random.key(0), cfg)
+    batch = bert.synthetic_batch(jax.random.key(1), cfg, 2, 64)
+    lens = jnp.asarray([48, 64])
+    batch = batch._replace(attention_mask=(
+        jnp.arange(64)[None, :] < lens[:, None]).astype(jnp.float32))
+    flash = make_attn_fn("pallas", autotune=False)
+
+    def loss(attn):
+        return lambda p: bert.mlm_loss(cfg, p, batch, None, attn)
+
+    l_ref, g_ref = jax.value_and_grad(loss(tfm.attention))(params)
+    l_fl, g_fl = jax.value_and_grad(loss(flash))(params)
+    np.testing.assert_allclose(float(l_fl), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_fl), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_bf16_training_flash_parity_tolerance():
+    """The default bf16 compute path: flash vs XLA within bf16 noise."""
+    cfg = gpt.gpt_tiny(vocab_size=128, max_len=64)      # bf16 compute
+    params = gpt.init_params(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 64), 0, 128,
+                             dtype=jnp.int32)
+    flash = make_attn_fn("pallas", autotune=False)
+    h_ref = tfm.encode(cfg, params, ids, attn_fn=tfm.attention)
+    h_fl = tfm.encode(cfg, params, ids, attn_fn=flash)
+    np.testing.assert_allclose(np.asarray(h_fl, np.float32),
+                               np.asarray(h_ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_auto_policy_off_tpu_is_exactly_xla():
+    """Auto off-TPU must run the plain XLA program — the default train
+    step stays bit-identical to the pre-campaign one on the harness."""
+    cfg = _fp32(gpt.gpt_tiny(vocab_size=64, max_len=32))
+    params = gpt.init_params(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0, 64,
+                             dtype=jnp.int32)
+    auto = make_attn_fn("auto")
+    dec = auto.describe((2, 32, cfg.n_heads, cfg.head_dim),
+                        (2, 32, cfg.n_heads, cfg.head_dim), True)
+    assert dec.impl == "xla" and dec.kernel_name == "xla"
+    np.testing.assert_array_equal(
+        np.asarray(tfm.encode(cfg, params, ids, attn_fn=auto)),
+        np.asarray(tfm.encode(cfg, params, ids, attn_fn=tfm.attention)))
+
+
+def test_default_train_step_matches_explicit_xla_on_cpu():
+    """make_train_step(attn_fn=None) resolves the auto policy; on CPU
+    that is the identical XLA step — losses bit-equal."""
+    cfg = gpt.gpt_tiny(vocab_size=64, max_len=32)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    mesh = make_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0, 64,
+                             dtype=jnp.int32)
+
+    def one_step(attn_fn):
+        init_fn, step_fn = gpt.make_train_step(cfg, mesh, attn_fn=attn_fn)
+        state = init_fn(jax.random.key(0))
+        _, loss = step_fn(state, ids, jax.random.key(2))
+        return float(loss)
+
+    assert one_step(None) == one_step(tfm.attention)
+
+
+def test_explicit_pallas_raises_instead_of_silent_fallback():
+    bad = make_attn_fn("pallas", autotune=False)
+    with pytest.raises(ValueError, match="never a silent fallback"):
+        bad.describe((2, 64, 2, 10), (2, 64, 2, 10), False)   # D=10
+    with pytest.raises(ValueError, match="kernel must be one of"):
+        make_attn_fn("fancy")
+
+
+# -- mixed precision + dynamic loss scaling ---------------------------------
+
+def _mp_conf(mixed="bf16"):
+    b = (NeuralNetConfiguration.builder()
+         .n_in(4).lr(0.1).num_iterations(1).activation("tanh")
+         .list(2).hidden_layer_sizes(8)
+         .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                   activation="softmax", loss_function="mcxent")
+         .pretrain(False).backward(True))
+    if mixed is not None:
+        b = b.mixed_precision(mixed)
+    return b.build()
+
+
+def _mp_batches(n=3, rows=16, seed=0, poison=()):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        x = rng.randn(rows, 4).astype(np.float32)
+        if i in poison:
+            x[0, 0] = np.nan
+        out.append(DataSet(jnp.asarray(x),
+                           np.eye(3, dtype=np.float32)[
+                               rng.randint(0, 3, rows)]))
+    return out
+
+
+def test_mixed_precision_serde_and_validation():
+    conf = _mp_conf()
+    assert conf.mixed_precision == "bf16"
+    rt = MultiLayerConfiguration.from_json(conf.to_json())
+    assert rt.mixed_precision == "bf16" and rt == conf
+    # legacy JSON without the field defaults off
+    d = json.loads(conf.to_json())
+    del d["mixed_precision"]
+    assert MultiLayerConfiguration.from_dict(d).mixed_precision == "off"
+    with pytest.raises(ValueError, match="mixed_precision"):
+        _mp_conf("fp8")
+    bad = _mp_conf()
+    bad.mixed_precision = "fp8"
+    with pytest.raises(ValueError, match="mixed_precision"):
+        MultiLayerNetwork(bad).init(seed=1).fit_backprop(
+            _mp_batches(), mesh=None)
+
+
+def test_mixed_precision_fit_masters_stay_fp32_and_learn():
+    net = MultiLayerNetwork(_mp_conf()).init(seed=1)
+    scores = []
+    net.set_listeners([type("L", (), {
+        "iteration_done": lambda self, m, i, s: scores.append(s)})()])
+    net.fit_backprop(_mp_batches(n=4), num_epochs=4, mesh=None)
+    assert all(leaf.dtype == jnp.float32
+               for d in net.params for leaf in d.values())
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+    assert scores[-1] < scores[0]          # bf16 compute still trains
+
+
+def test_loss_scale_overflow_skips_halves_and_recovers(devices):
+    """The injected-overflow drill on the SHARDED step: the poisoned
+    step keeps params bit-identical, halves the scale, and zeroes the
+    good-step count; the next healthy step applies and counts."""
+    mesh = auto_data_mesh()
+    net = MultiLayerNetwork(_mp_conf()).init(seed=1)
+    train_step, _, updaters = net._backprop_machinery(mesh)
+    assert train_step.mixed_precision and train_step.takes_n_valid
+    params = jax.tree.map(jnp.copy, net._require_params())
+    before = jax.tree.map(np.asarray, params)
+    ustate = train_step.init_ustate(params)
+    assert float(ustate[1]["scale"]) == sharded_fit.LOSS_SCALE_INIT
+
+    good = _mp_batches(n=1, rows=16)[0]
+    x = np.asarray(good.features).copy()
+    x[0, 0] = np.nan
+    poisoned = (jnp.asarray(x), good.labels, jnp.int32(16))
+
+    params, ustate, score, skipped = train_step(
+        params, ustate, poisoned, jax.random.key(0), 0)
+    assert int(skipped) == 1
+    assert float(ustate[1]["scale"]) == sharded_fit.LOSS_SCALE_INIT / 2
+    assert int(ustate[1]["good_steps"]) == 0
+    for a, b in zip(jax.tree.leaves(jax.tree.map(np.asarray, params)),
+                    jax.tree.leaves(before)):
+        np.testing.assert_array_equal(a, b)   # update fully dropped
+
+    healthy = (good.features, good.labels, jnp.int32(16))
+    params, ustate, score, skipped = train_step(
+        params, ustate, healthy, jax.random.key(0), 1)
+    assert int(skipped) == 0 and np.isfinite(float(score))
+    assert float(ustate[1]["scale"]) == sharded_fit.LOSS_SCALE_INIT / 2
+    assert int(ustate[1]["good_steps"]) == 1
+
+
+def test_loss_scale_overflow_does_not_diverge_sharded_fit(devices):
+    """End-to-end: a NaN batch mid-fit skips collectively (every replica
+    identically — params stay replicated and finite) and training
+    continues."""
+    mesh = auto_data_mesh()
+    net = MultiLayerNetwork(_mp_conf()).init(seed=1)
+    net.fit_backprop(_mp_batches(n=4, poison=(1,)), num_epochs=2,
+                     mesh=mesh)
+    assert net.guard_skips >= 1
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+
+
+def test_loss_scale_transitions_growth_floor_cap():
+    st = sharded_fit.init_loss_scale()
+    # halving floors at LOSS_SCALE_MIN
+    for _ in range(40):
+        st = sharded_fit.next_loss_scale(st, jnp.int32(1))
+    assert float(st["scale"]) == sharded_fit.LOSS_SCALE_MIN
+    # growth: after GROWTH_INTERVAL good steps the scale doubles once
+    for i in range(sharded_fit.LOSS_SCALE_GROWTH_INTERVAL):
+        st = sharded_fit.next_loss_scale(st, jnp.int32(0))
+    assert float(st["scale"]) == 2 * sharded_fit.LOSS_SCALE_MIN
+    assert int(st["good_steps"]) == 0      # reset after growth
+    # and it caps
+    st = {"scale": jnp.float32(sharded_fit.LOSS_SCALE_MAX),
+          "good_steps": jnp.int32(
+              sharded_fit.LOSS_SCALE_GROWTH_INTERVAL - 1)}
+    st = sharded_fit.next_loss_scale(st, jnp.int32(0))
+    assert float(st["scale"]) == sharded_fit.LOSS_SCALE_MAX
+
+
+def test_flipping_mixed_precision_rebuilds_machinery():
+    """Regression: the per-net machinery memo must key on the policy —
+    flipping conf.mixed_precision between fits used to hand back the
+    stale bundle and silently train with the old precision."""
+    conf = _mp_conf()
+    conf.grad_accum = 2                  # stay on the dp path both ways
+    net = MultiLayerNetwork(conf).init(seed=1)
+    mp_bundle = net._backprop_machinery(None)
+    assert mp_bundle[0].mixed_precision
+    net.conf.mixed_precision = "off"
+    fp_bundle = net._backprop_machinery(None)
+    assert fp_bundle is not mp_bundle
+    assert not fp_bundle[0].mixed_precision
+    net.conf.mixed_precision = "bf16"
+    assert net._backprop_machinery(None)[0].mixed_precision
+
+
+def test_mixed_precision_resilient_fit_roundtrip(tmp_path):
+    """ResilientFit drives the mp bundle (loss-scale state checkpointed
+    alongside the updater states) through a checkpointed fit."""
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+    net = MultiLayerNetwork(_mp_conf()).init(seed=1)
+    ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        patience=10 ** 6)).fit(_mp_batches(n=3), num_epochs=2)
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+
+
+# -- autotuner persistence ---------------------------------------------------
+
+def test_autotune_sweep_persists_and_cold_lookup_hits(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_AUTOTUNE_CACHE", str(tmp_path))
+    from deeplearning4j_tpu.runtime import autotune
+    from deeplearning4j_tpu.runtime.metrics import mfu_metrics
+    autotune.reset_memo()
+    mfu_metrics.reset()
+    rec = autotune.sweep_attention(64, 64, 8, False, batch=1, n_heads=1,
+                                  blocks=((16, 16),), repeats=1)
+    assert rec["impl"] in ("pallas", "xla")
+    with open(autotune.cache_path()) as f:
+        doc = json.load(f)
+    assert doc[rec["key"]]["impl"] == rec["impl"]
+    assert "candidates" in doc[rec["key"]]
+    autotune.reset_memo()                   # what a fresh process sees
+    got = autotune.ensure_attention(64, 64, 8, False)
+    assert got["impl"] == rec["impl"]
+    assert mfu_metrics.count("sweeps") == 1     # no re-sweep
+    assert mfu_metrics.count("cache_hits") >= 1
+    # shape-bucketing: a nearby length lands on the same record
+    assert autotune.lookup_attention(100, 100, 8, False) is not None
+    autotune.reset_memo()
+
+
+def test_autotune_second_process_consults_with_zero_sweeps(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_AUTOTUNE_CACHE", str(tmp_path))
+    from deeplearning4j_tpu.runtime import autotune
+    autotune.reset_memo()
+    autotune.sweep_attention(64, 64, 8, True, batch=1, n_heads=1,
+                             blocks=((16, 16),), repeats=1)
+    code = (
+        "from deeplearning4j_tpu.runtime import autotune\n"
+        "from deeplearning4j_tpu.runtime.metrics import mfu_metrics\n"
+        "r = autotune.ensure_attention(64, 64, 8, True)\n"
+        "assert r is not None, 'no cached winner'\n"
+        "assert mfu_metrics.count('sweeps') == 0, 're-swept!'\n"
+        "assert mfu_metrics.count('cache_hits') == 1\n"
+        "print('CONSULT_OK', r['impl'])\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DL4J_TPU_AUTOTUNE_CACHE=str(tmp_path))
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "CONSULT_OK" in r.stdout
+    autotune.reset_memo()
+
+
+def test_autotuned_winner_drives_block_sizes(tmp_path, monkeypatch):
+    """A persisted pallas winner's blocks reach the dispatch decision."""
+    monkeypatch.setenv("DL4J_TPU_AUTOTUNE_CACHE", str(tmp_path))
+    from deeplearning4j_tpu.runtime import autotune
+    autotune.reset_memo()
+    key = autotune.attn_key(autotune.device_kind(), 128, 128, 16, False)
+    autotune._persist(autotune.cache_path(), key, {
+        "key": key, "impl": "pallas", "block_q": 64, "block_k": 32,
+        "step_ms": 1.0, "device_kind": autotune.device_kind(),
+        "candidates": {}})
+    attn = make_attn_fn("pallas")           # forced; interpret on CPU
+    dec = attn.describe((1, 128, 1, 16), (1, 128, 1, 16), False)
+    assert (dec.block_q, dec.block_k) == (64, 32)
+    q = jax.random.normal(jax.random.key(0), (1, 128, 1, 16))
+    np.testing.assert_allclose(
+        np.asarray(attn(q, q, q)),
+        np.asarray(tfm.attention(q, q, q, None, False)),
+        rtol=2e-5, atol=2e-5)
+    autotune.reset_memo()
+
+
+def test_mfu_metrics_family_registered_and_estimates():
+    from deeplearning4j_tpu.runtime.metrics import (estimate_mfu,
+                                                    mfu_metrics)
+    from deeplearning4j_tpu.runtime.telemetry import registry
+    assert "mfu" in registry.sources()
+    assert estimate_mfu(197e12, 1.0, "TPU v5e", 1) == pytest.approx(1.0)
+    assert estimate_mfu(197e12, 1.0, "TFRT_CPU", 1) is None
+    est = mfu_metrics.note_mfu("test.row", 0.5 * 197e12, 1.0,
+                               "TPU v5 lite", 1)
+    assert est == pytest.approx(0.5)
+    snap = mfu_metrics.snapshot()
+    assert snap["estimates"]["test.row"]["mfu"] == pytest.approx(0.5)
+    assert snap["estimates"]["test.row"]["device_kind"] == "TPU v5 lite"

@@ -1,0 +1,107 @@
+"""CI smoke gate for the persistent kernel autotuner (runtime/autotune.py).
+
+Machine-checks the MFU-campaign persistence contract on CPU, seconds:
+
+1. a tiny sweep (XLA vs one interpreted Pallas block candidate, fwd+bwd)
+   must complete and persist a winner;
+2. the on-disk cache file must be well-formed JSON whose record carries
+   the full evidence (key, impl, blocks, timings, device kind);
+3. a COLD consult (in-process memo dropped — what a second process does)
+   must return the winner from disk with ZERO re-sweeps;
+4. after warmup, re-dispatching an attention step built from the cached
+   winner must show ``compile_delta == 0`` — consults are pure host-side
+   reads, so the steady state compiles nothing.
+
+Run by ``tools/ci.sh`` after the telemetry gate; exits non-zero on any
+violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as cache_home:
+        os.environ["DL4J_TPU_AUTOTUNE_CACHE"] = cache_home
+
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+        from deeplearning4j_tpu.runtime import autotune
+        from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                        mfu_metrics)
+
+        # 1) tiny sweep completes
+        mfu_metrics.reset()
+        rec = autotune.sweep_attention(64, 64, 8, True, batch=1, n_heads=1,
+                                       blocks=((16, 16),), repeats=1)
+        if mfu_metrics.count("sweeps") != 1:
+            print("[autotune-gate] FAIL: sweep did not book into the mfu "
+                  "counter family")
+            return 1
+
+        # 2) cache file well-formed
+        path = autotune.cache_path()
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"[autotune-gate] FAIL: cache file unreadable: {e!r}")
+            return 1
+        record = doc.get(rec["key"])
+        missing = [k for k in ("impl", "block_q", "block_k", "step_ms",
+                               "device_kind", "candidates")
+                   if not isinstance(record, dict) or k not in record]
+        if missing:
+            print(f"[autotune-gate] FAIL: persisted record malformed "
+                  f"(missing {missing}): {record!r}")
+            return 1
+
+        # 3) cold consult: winner from disk, zero re-sweeps
+        autotune.reset_memo()
+        sweeps_before = mfu_metrics.count("sweeps")
+        got = autotune.ensure_attention(64, 64, 8, True)
+        if got is None or got["impl"] != rec["impl"]:
+            print(f"[autotune-gate] FAIL: cold consult returned {got!r}, "
+                  f"swept winner was {rec['impl']!r}")
+            return 1
+        if mfu_metrics.count("sweeps") != sweeps_before:
+            print("[autotune-gate] FAIL: a warmed consult re-swept")
+            return 1
+        if mfu_metrics.count("cache_hits") < 1:
+            print("[autotune-gate] FAIL: cold consult did not book a "
+                  "cache hit")
+            return 1
+
+        # 4) warmed dispatch through the policy: compile_delta == 0
+        attn = make_attn_fn("pallas")      # interpret mode on CPU
+        q = jax.random.normal(jax.random.key(0), (1, 64, 1, 8))
+
+        def step(q):
+            return jnp.sum(attn(q, q, q, None, True))
+
+        from deeplearning4j_tpu.runtime import compile_cache
+        fn = compile_cache.cached_jit(step, label="autotune_gate.step")
+        float(fn(q))                               # warm
+        before = compile_metrics.snapshot()["compile_count"]
+        float(fn(q))
+        delta = compile_metrics.snapshot()["compile_count"] - before
+        if delta != 0:
+            print(f"[autotune-gate] FAIL: warmed dispatch compiled "
+                  f"{delta} new program(s)")
+            return 1
+
+    print(f"[autotune-gate] ok: winner={rec['impl']} "
+          f"blocks=({rec['block_q']},{rec['block_k']}) cache hit with "
+          f"0 re-sweeps, warmed compile_delta=0")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

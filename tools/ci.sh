@@ -36,6 +36,13 @@ python -m tools.jaxlint deeplearning4j_tpu bench.py tools \
 echo "[ci] telemetry overhead gate"
 JAX_PLATFORMS=cpu python -m tools.telemetry_gate || exit 1
 
+# Autotune smoke gate: a tiny kernel sweep must complete, persist a
+# well-formed winner record, and a cold (memo-dropped) consult must hit
+# the on-disk cache with zero re-sweeps and zero steady-state compiles —
+# the MFU-campaign persistence contract.  Seconds on CPU.
+echo "[ci] autotune smoke gate"
+JAX_PLATFORMS=cpu python -m tools.autotune_gate || exit 1
+
 # Preemption drill: SIGTERM against a live ResilientFit subprocess must
 # produce a committed (manifest-verified) final snapshot, a clean exit
 # 0, and a resumable checkpoint dir — the fault-tolerance contract

@@ -13,7 +13,11 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
    with BACKGROUND snapshots (runtime/checkpoint.py
    ``AsyncCheckpointer``, the PR 8 default): staging copies, writer
    commits, and drains must never trace a new program;
-5. the same off/on zero-compile contract for the continuous-batching
+5. the same off/on zero-compile contract for a warmed MIXED-PRECISION
+   fit (``MultiLayerConfiguration.mixed_precision="bf16"``): the
+   dynamic loss scale is a traced value threading the scanned epochs,
+   so its transitions must never retrace;
+6. the same off/on zero-compile contract for the continuous-batching
    decode loop (serving/decode.py): after ``DecodeEngine.warmup()``, a
    concurrent request mix — joins, EOS recycling, varied prompt
    lengths — must dispatch only cached programs with the tracer off AND
@@ -109,6 +113,58 @@ def _checkpoint_gate(registry, telemetry, net, batches) -> int:
     return 0
 
 
+def _mixed_precision_gate(registry, telemetry) -> int:
+    """Mixed-precision loop gate: a WARMED bf16 fit (dynamic loss scale
+    threading through the scanned epochs) must dispatch only cached
+    programs with the tracer off AND on — the scale is a traced value in
+    the updater-state slot, so its per-step transitions must never cost
+    a retrace."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).num_iterations(1).activation("tanh")
+            .list(2).hidden_layer_sizes(8)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True)
+            .mixed_precision("bf16").build())
+    rng = np.random.RandomState(1)
+    batches = [DataSet(rng.randn(16, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+               for _ in range(3)]
+    net = MultiLayerNetwork(conf).init(seed=2)
+
+    net.fit_backprop(batches, num_epochs=1)      # warm the mp engine step
+    registry.mark()
+
+    assert not telemetry.enabled()
+    net.fit_backprop(batches, num_epochs=1)
+    delta_off = registry.compile_delta_since_mark()
+    if delta_off != 0:
+        print(f"[telemetry-gate] FAIL: tracer-off mixed-precision fit "
+              f"compiled {delta_off} new program(s)")
+        return 1
+
+    telemetry.enable("telemetry-gate-mp")
+    registry.mark()
+    net.fit_backprop(batches, num_epochs=1)
+    delta_on = registry.compile_delta_since_mark()
+    telemetry.disable()
+    if delta_on != 0:
+        print(f"[telemetry-gate] FAIL: tracer-on mixed-precision fit "
+              f"compiled {delta_on} new program(s) — loss-scale state "
+              "leaked a retrace")
+        return 1
+    print(f"[telemetry-gate] ok: mixed-precision loop compile_delta "
+          f"off={delta_off} on={delta_on}")
+    return 0
+
+
 def _decode_gate(registry, telemetry) -> int:
     import numpy as np
 
@@ -196,6 +252,9 @@ def main() -> int:
     print(f"[telemetry-gate] ok: compile_delta off={delta_off} "
           f"on={delta_on}, {len(records)} journal record(s)")
     rc = _checkpoint_gate(registry, telemetry, net, batches)
+    if rc:
+        return rc
+    rc = _mixed_precision_gate(registry, telemetry)
     if rc:
         return rc
     return _decode_gate(registry, telemetry)
