@@ -1022,6 +1022,113 @@ def bench_dp_fit(ndp: int = 8, per_shard_batch: int = 16,
     return out
 
 
+def bench_model_parallel(model_degree: int = 4, ndata: int = 2,
+                         rows: int = 32, seq: int = 64, n_batches: int = 8,
+                         num_epochs: int = 4):
+    """Model-parallel sharded fit row (the data×model tentpole): the
+    SAME causal-LM fit (``models/lm_fit.CausalLM`` through the
+    sharded_fit GSPMD builders) twice over the same devices —
+
+    1. replicated layout: pure data mesh (ndata*model_degree)×1, every
+       chip holds a full weight copy;
+    2. model-sharded layout: ndata×model_degree mesh, weights laid out
+       per ``gpt.shard_specs`` (heads/MLP over `model`, tied embedding
+       over vocab).
+
+    Evidence carried in the row: per-chip param bytes ~1/model_degree
+    of the replicated layout, warmed ``compile_delta == 0`` with ONE
+    donated dispatch per fit, the two layouts numerically equivalent,
+    and step-time + MFU for both (on the forced-CPU proxy all shards
+    share one host's cores, so equal-time is the ideal — the value of
+    the sharding is the measured per-chip HBM, which is layout truth on
+    any platform)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.models.lm_fit import CausalLM
+    from deeplearning4j_tpu.parallel.mesh import (MeshSpec, make_mesh,
+                                                  per_device_bytes)
+    from deeplearning4j_tpu.runtime.metrics import compile_metrics, dp_metrics
+    import dataclasses
+
+    platform, kind, n_dev = _platform_info()
+    need = model_degree * ndata
+    if n_dev < need:
+        return {"metric": "model_parallel_per_chip_bytes_ratio",
+                "value": None, "unit": "skipped",
+                "error": f"needs >= {need} devices, have {n_dev}"}
+    cfg = dataclasses.replace(
+        gpt.gpt_tiny(vocab_size=2048, max_len=seq), hidden=128,
+        n_layers=2, n_heads=8, ffn_dim=512, compute_dtype="float32")
+    rng = np.random.RandomState(0)
+    batches = [DataSet(
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (rows, seq)), jnp.int32),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (rows, seq)), jnp.int32))
+        for _ in range(n_batches)]
+    mesh_mp = make_mesh(MeshSpec(data=ndata, model=model_degree),
+                        devices=jax.devices()[:need])
+    mesh_dp = make_mesh(MeshSpec(data=need), devices=jax.devices()[:need])
+    steps = n_batches * num_epochs
+
+    def warm(mesh):
+        CausalLM(cfg, lr=0.01).init(seed=0).fit_backprop(
+            batches, num_epochs=num_epochs, mesh=mesh)
+
+    def timed(mesh, reps=3):
+        net = CausalLM(cfg, lr=0.01).init(seed=0)
+        t = _time_fit(lambda: (net.fit_backprop(
+            batches, num_epochs=num_epochs, mesh=mesh), net.params)[1],
+            reps=reps)
+        return t, net
+
+    warm(mesh_dp)
+    t_dp, net_dp = timed(mesh_dp)
+    warm(mesh_mp)                      # compiles banked before the mark
+    before = compile_metrics.snapshot()["compile_count"]
+    dp_metrics.reset()
+    t_mp, net_mp = timed(mesh_mp, reps=3)
+    compile_delta = compile_metrics.snapshot()["compile_count"] - before
+    dp_snap = dp_metrics.snapshot()    # 3 timed fits -> 3 dispatches
+
+    total_bytes = net_mp.num_param_bytes()
+    mp_bytes = max(per_device_bytes(net_mp.params).values())
+    dp_bytes = max(per_device_bytes(net_dp.params).values())
+    max_diff = float(np.max(np.abs(net_mp.params_flat()
+                                   - net_dp.params_flat())))
+    flops = gpt_train_flops(cfg, rows, seq)
+    ratio = mp_bytes / max(dp_bytes, 1)
+    return {
+        "metric": f"model_parallel_per_chip_bytes_ratio_{ndata}x"
+                  f"{model_degree}",
+        "value": round(ratio, 4),
+        "unit": "sharded_over_replicated_per_chip_bytes",
+        "vs_baseline": round(ratio, 4),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"dm{ndata}x{model_degree}_b{rows}_T{seq}"
+                      f"_nb{n_batches}_e{num_epochs}",
+        "model_degree": model_degree,
+        "data_degree": ndata,
+        "param_bytes_total": total_bytes,
+        "param_bytes_per_chip_sharded": mp_bytes,
+        "param_bytes_per_chip_replicated": dp_bytes,
+        "fit_ms_replicated": round(t_dp * 1e3, 1),
+        "fit_ms_model_sharded": round(t_mp * 1e3, 1),
+        "samples_per_sec_model_sharded": round(steps * rows / t_mp, 1),
+        "samples_per_sec_replicated": round(steps * rows / t_dp, 1),
+        # acceptance: warmed sharded fit retraces nothing, and each of
+        # the 3 timed fits is ONE donated dispatch
+        "compile_delta": compile_delta,
+        "dispatches_per_fit": dp_snap["dispatches"] / 3.0,
+        "max_abs_diff_sharded_vs_replicated": max_diff,
+        "numerically_equivalent": bool(max_diff < 1e-3),
+        "mfu": _mfu(flops, t_mp / steps, kind, need,
+                    label="bench.model_parallel"),
+    }
+
+
 def bench_w2v_dp(ndp: int = 8, n_sentences: int = 2000, sent_len: int = 30,
                  vocab: int = 1000, epochs: int = 4):
     """Distributed word2vec evidence (VERDICT r4 next #7): the 8-shard
@@ -1726,7 +1833,10 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "gpt": bench_gpt,
          "decode_serving": bench_decode_serving,
          # sharded scanned training: scanned-vs-per-batch speedup,
          # scaling efficiency, grad_accum curve, bit-equivalence
-         "dp_fit": bench_dp_fit}
+         "dp_fit": bench_dp_fit,
+         # data×model tentpole: per-chip bytes ~1/model_degree,
+         # replicated-vs-sharded step time, zero steady-state compiles
+         "model_parallel": bench_model_parallel}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
@@ -1749,7 +1859,9 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420),
             "resnet_s2d": (1800, 0), "resilience": (300, 240),
             "serving": (420, 300), "decode_serving": (480, 420),
             # dp_fit needs >= 2 devices: cpu-only like scaling
-            "dp_fit": (0, 900)}
+            "dp_fit": (0, 900),
+            # model_parallel needs >= 8 devices: cpu-only like dp_fit
+            "model_parallel": (0, 600)}
 
 
 # -- perf-regression guard --------------------------------------------------
@@ -2108,7 +2220,7 @@ def main() -> None:
     suite = {}
     budget_end = time.time() + 40 * 60  # don't let the full suite run away
     names = ["gpt", "attn_training", "serving", "decode_serving",
-             "dp_fit", "lenet", "resnet",
+             "dp_fit", "model_parallel", "lenet", "resnet",
              "longctx", "word2vec", "glove", "scaling", "w2v_dp"]
     if tpu_ok:
         # tpu-only capability point LAST: if the suite budget runs out it

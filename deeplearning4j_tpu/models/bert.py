@@ -68,6 +68,26 @@ def param_specs(cfg: TransformerConfig) -> PyTree:
     return specs
 
 
+def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
+    """data×model GSPMD specs for the BERT family: the encoder rules
+    from ``transformer.shard_specs`` (heads + MLP hidden over ``model``,
+    tied token embedding over vocab when divisible) plus the MLM head —
+    its transform column-parallel over ``model`` and its output bias
+    over vocab alongside the tied projection.  LayerNorms and the
+    pooler stay replicated (tiny; sharding them buys collectives, not
+    memory)."""
+    from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+    specs = tfm.shard_specs(cfg, model_degree)
+    m = MODEL_AXIS if model_degree > 1 else None
+    vocab_ok = model_degree > 1 and cfg.vocab_size % model_degree == 0
+    specs["mlm"] = {"w": P(None, m), "b": P(m),
+                    "ln_g": P(None), "ln_b": P(None),
+                    "out_b": P(MODEL_AXIS) if vocab_ok else P(None)}
+    specs["pooler"] = {"w": P(None, None), "b": P(None)}
+    return specs
+
+
 class Batch(NamedTuple):
     """MLM batch. ``mlm_mask`` marks the (already-corrupted) predict positions;
     ``labels`` holds original ids everywhere (ignored where mask==0)."""

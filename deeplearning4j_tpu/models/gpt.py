@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.models import transformer as tfm
 from deeplearning4j_tpu.models.transformer import TransformerConfig
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 Array = jax.Array
 PyTree = Any
@@ -51,6 +51,26 @@ def init_params(key: Array, cfg: TransformerConfig) -> PyTree:
     if not cfg.causal:
         raise ValueError("GPT config must be causal")
     return tfm.init_params(key, cfg)
+
+
+def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
+    """data×model sharding specs for the GPT family: attention heads +
+    MLP hidden over ``model``, the tied token embedding (= the LM
+    output projection) over vocab when the degree divides it.  The GPT
+    param tree IS the transformer tree, so this is
+    ``transformer.shard_specs`` re-exported under the family name the
+    sharded-fit/serving plumbing asks for."""
+    return tfm.shard_specs(cfg, model_degree)
+
+
+def slot_specs(cfg: TransformerConfig) -> "DecodeSlots":
+    """PartitionSpecs for ``DecodeSlots`` under a model-sharded decode
+    engine: the KV cache [L, S, T_max, NH, D] shards its HEAD axis over
+    ``model`` (each chip holds only its heads' cache — the serving-side
+    HBM win that lets a model bigger than one chip serve), tokens and
+    positions replicated (tiny, and every shard needs them)."""
+    h = P(None, None, None, MODEL_AXIS, None)
+    return DecodeSlots(k=h, v=h, tokens=P(), pos=P())
 
 
 def lm_logits(cfg: TransformerConfig, params: PyTree, hidden: Array) -> Array:

@@ -116,6 +116,45 @@ def param_specs(cfg: MoETransformerConfig) -> PyTree:
     return {"embed": embed, "blocks": blocks}
 
 
+def shard_specs(cfg: MoETransformerConfig, model_degree: int = 1) -> PyTree:
+    """data×model GSPMD specs for the MoE family (meshes WITHOUT an
+    ``expert`` axis — the sharded-fit/serving convention): the expert
+    tables, which dominate the footprint, shard their EXPERT axis over
+    ``model`` (expert parallelism riding the model axis), attention
+    heads over ``model``, and the token embedding over vocab when the
+    degree divides it.  The all_to_all dispatch of the shard_map path
+    becomes GSPMD-inserted collectives here."""
+    from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+    m = MODEL_AXIS
+    if model_degree > 1:
+        if cfg.n_experts % model_degree:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} not divisible by model "
+                f"degree {model_degree} — expert tables shard their "
+                f"expert axis over `model`")
+        if cfg.n_heads % model_degree:
+            raise ValueError(
+                f"n_heads={cfg.n_heads} not divisible by model degree "
+                f"{model_degree} — attention heads shard over `model`")
+    blocks = {
+        "wq": P(None, None, m, None), "wk": P(None, None, m, None),
+        "wv": P(None, None, m, None), "wo": P(None, m, None, None),
+        "bq": P(None, m, None), "bk": P(None, m, None),
+        "bv": P(None, m, None), "bo": P(None, None),
+        "ln1_g": P(None, None), "ln1_b": P(None, None),
+        "ln2_g": P(None, None), "ln2_b": P(None, None),
+        "router": P(None, None, None),
+        "wi": P(None, m, None, None),       # [L, E, H, F]: experts over m
+        "wo_e": P(None, m, None, None),
+    }
+    tok = (P(m, None) if model_degree > 1
+           and cfg.vocab_size % model_degree == 0 else P(None, None))
+    embed = {"tok": tok, "pos": P(None, None),
+             "ln_g": P(None), "ln_b": P(None)}
+    return {"embed": embed, "blocks": blocks}
+
+
 def _block(cfg: MoETransformerConfig, x: Array, p: dict,
            moe_axis: Optional[str],
            stat_axes: Tuple[str, ...] = (),

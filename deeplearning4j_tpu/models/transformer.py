@@ -119,6 +119,30 @@ def param_specs(cfg: TransformerConfig) -> PyTree:
     return {"embed": embed, "blocks": blocks}
 
 
+def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
+    """Per-layer weight sharding specs for data×model GSPMD training
+    and serving (parallel/sharded_fit GSPMD mode, serving/decode model
+    sharding): ``param_specs``'s tensor-parallel rules — attention
+    heads and MLP hidden over ``model`` — PLUS the token embedding
+    (and, via weight tying, the output projection) sharded over vocab
+    when the degree divides it.  Validates divisibility up front so a
+    bad (cfg, mesh) pairing fails at build time with the real
+    constraint, not deep inside XLA partitioning."""
+    if model_degree > 1:
+        if cfg.n_heads % model_degree:
+            raise ValueError(
+                f"n_heads={cfg.n_heads} not divisible by model degree "
+                f"{model_degree} — attention heads shard over `model`")
+        if cfg.ffn_dim % model_degree:
+            raise ValueError(
+                f"ffn_dim={cfg.ffn_dim} not divisible by model degree "
+                f"{model_degree} — the MLP hidden shards over `model`")
+    specs = param_specs(cfg)
+    if model_degree > 1 and cfg.vocab_size % model_degree == 0:
+        specs["embed"]["tok"] = P(MODEL_AXIS, None)
+    return specs
+
+
 def act_spec() -> P:
     """[B, T, H] activations: batch over data, sequence over seq."""
     return P(DATA_AXIS, SEQ_AXIS, None)

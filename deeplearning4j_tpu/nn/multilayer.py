@@ -822,13 +822,19 @@ class MultiLayerNetwork:
     def _resolve_fit_mesh(self, mesh, min_batch: int):
         """The sharded-by-default policy.  ``mesh="auto"`` (the fit
         default) picks the all-device ``data`` mesh when it can shard
-        SAFELY: >1 device, every batch holds at least one row per shard,
-        and the conf has no per-replica stochastic state (dropout /
-        DropConnect noise streams and BatchNorm batch statistics become
-        per-shard under sharding — legitimate ghost-batch training, but
-        not something auto-detection should silently switch on).  Pass
-        an explicit ``make_mesh(...)`` to shard those anyway, or
-        ``mesh=None`` to force single-device."""
+        SAFELY: >1 device and every batch holds at least one row per
+        shard.  Dropout/DropConnect confs NOW auto-shard (ROADMAP item
+        5, first half): the DP step folds the shard index into the
+        per-step RNG key, so each data replica draws an INDEPENDENT
+        mask over its own rows — the sampled-mask distribution over the
+        global batch is unchanged, but the concrete masks differ from a
+        single-device run of the same seed (MIGRATION.md documents the
+        semantics change).  Only BatchNorm still gates: its in-batch
+        normalization statistics would silently become per-shard
+        (ghost-batch) statistics, which stays an explicit-mesh decision
+        until the cross-replica-moments half of item 5 lands.  Pass an
+        explicit ``make_mesh(...)`` to shard BN anyway, or ``mesh=None``
+        to force single-device."""
         from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS,
                                                       auto_data_mesh)
 
@@ -845,8 +851,7 @@ class MultiLayerNetwork:
         m = auto_data_mesh()
         if m is None or min_batch < m.shape[DATA_AXIS]:
             return None
-        if any(c.dropout > 0 or c.drop_connect
-               or c.kind is LayerKind.BATCH_NORM for c in self.conf.confs):
+        if any(c.kind is LayerKind.BATCH_NORM for c in self.conf.confs):
             return None
         return m
 

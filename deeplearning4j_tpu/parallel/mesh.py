@@ -202,12 +202,42 @@ def mesh_signature(mesh: Optional[Mesh]):
     concrete device assignment.  Two meshes of the same shape over
     DIFFERENT devices must not share a cached executable (the compiled
     shard_map closure pins its devices), so the device ids are part of
-    the signature — no silent cross-mesh cache hits."""
+    the signature — no silent cross-mesh cache hits.  The axis SIZES are
+    equally load-bearing: a 2x4 data×model mesh and an 8x1 data mesh
+    over the SAME eight devices compile different programs (different
+    param layouts, different collectives), and the signature keeps them
+    distinct entries."""
     if mesh is None:
         return None
     return (tuple(zip(mesh.axis_names,
                       (mesh.shape[a] for a in mesh.axis_names))),
             tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def model_degree(mesh: Optional[Mesh]) -> int:
+    """Tensor-parallel degree of ``mesh`` (1 when absent/None)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(MODEL_AXIS, 1))
+
+
+def per_device_bytes(tree) -> Dict[int, int]:
+    """Bytes each device ACTUALLY holds for ``tree``'s arrays, summed
+    from their addressable shards — the HBM-accounting primitive behind
+    the model-parallel bench row and the per-chip ~1/model_degree
+    assertion (a replicated layout charges every device the full
+    footprint; a model-sharded one charges each device its shard plus
+    the replicated leftovers).  Host-resident leaves without shards
+    (plain numpy) contribute nothing."""
+    out: Dict[int, int] = {}
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue
+        for s in shards:
+            did = int(s.device.id)
+            out[did] = out.get(did, 0) + int(s.data.nbytes)
+    return out
 
 
 #: memoized auto-detected data mesh (keyed on the live device list so a
@@ -253,34 +283,58 @@ def surviving_devices(mesh: Mesh, lost_ids) -> list:
 
 def elastic_remesh(mesh: Mesh, lost_ids,
                    grad_accum: int = 1) -> Tuple[Optional[Mesh], int]:
-    """Rebuild a DATA mesh over the survivors of a device loss while
-    PRESERVING the effective batch: returns ``(new_mesh, new_accum)``
-    with ``new_degree * new_accum == old_degree * grad_accum`` — the
-    PR 5 sum-loss formulation makes the re-meshed run BIT-identical to
-    the uninterrupted one at equal effective batch, so "same run,
-    smaller mesh" is an equivalence, not an approximation.
+    """Rebuild a ``data``(×``model``) mesh over the survivors of a
+    device loss while PRESERVING the effective batch: returns
+    ``(new_mesh, new_accum)`` with ``new_data_degree * new_accum ==
+    old_data_degree * grad_accum`` — the PR 5 sum-loss formulation
+    makes the re-meshed run BIT-identical to the uninterrupted one at
+    equal effective batch, so "same run, smaller mesh" is an
+    equivalence, not an approximation.
 
-    The new data degree is the LARGEST survivor count dividing the old
-    effective factor (losing 1 of 4 devices continues on 2 with
-    accum x2 — idle-ing one healthy device is cheaper than changing
-    the numerics).  ``new_mesh`` is None when only one device survives
-    or only degree 1 divides: the caller continues single-device with
-    ``new_accum = old_degree * grad_accum``.  Only pure data meshes are
-    elastic — model/pipe/seq/expert-sharded state cannot be re-laid-out
-    by a host-side driver and raises."""
-    for axis in (MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS):
+    Only the DATA axis shrinks.  A ``model`` degree > 1 is preserved
+    verbatim — the tensor-parallel layout is baked into every weight
+    shard, so the recovery keeps whole model groups and drops data
+    replicas: the new data degree is the LARGEST group count the
+    survivors can field that divides the old effective factor.  When
+    the survivors cannot hold even ONE intact model group, the loss is
+    unrecoverable by a host-side driver and raises with the surviving
+    count and the required divisor (restoring onto fewer-than-model
+    devices needs a resharding restore, which no snapshot here
+    carries).  Pipe/seq/expert-sharded meshes still refuse outright.
+
+    For pure data meshes, ``new_mesh`` is None when only one device
+    survives or only degree 1 divides: the caller continues
+    single-device with ``new_accum = old_degree * grad_accum``.  A
+    data×model mesh never collapses to None — a ``1×model`` mesh is
+    still a mesh (the weights stay sharded)."""
+    for axis in (PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS):
         if axis in mesh.shape and mesh.shape[axis] > 1:
             raise ValueError(
-                f"elastic_remesh only supports pure data meshes; this "
-                f"mesh has {axis}={mesh.shape[axis]} (re-sharding "
-                f"model-parallel state needs a resharding restore, see "
+                f"elastic_remesh only shrinks data(×model) meshes; this "
+                f"mesh has {axis}={mesh.shape[axis]} (re-laying-out "
+                f"{axis}-sharded state needs a resharding restore, see "
                 f"load_pytree_sharded)")
     survivors = surviving_devices(mesh, lost_ids)
     if not survivors:
         raise ValueError(
             f"device loss {sorted(set(int(i) for i in lost_ids))} leaves "
             "no survivors in this mesh — nothing to resume on")
+    model = int(mesh.shape.get(MODEL_AXIS, 1))
     eff = mesh.shape[DATA_AXIS] * max(grad_accum, 1)
+    if model > 1:
+        groups = len(survivors) // model
+        if groups < 1:
+            raise ValueError(
+                f"device loss leaves {len(survivors)} surviving "
+                f"device(s), fewer than one intact model={model} group: "
+                f"the survivor count must be divisible into groups of "
+                f"{model} (required divisor {model}) to keep the "
+                f"tensor-parallel weight layout — restore onto a fleet "
+                f"of at least {model} devices instead")
+        degree = next(n for n in range(groups, 0, -1) if eff % n == 0)
+        return (make_mesh(MeshSpec(data=degree, model=model),
+                          devices=survivors[:degree * model]),
+                eff // degree)
     degree = next(n for n in range(len(survivors), 0, -1) if eff % n == 0)
     new_accum = eff // degree
     if degree < 2:

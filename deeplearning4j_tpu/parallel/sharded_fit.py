@@ -22,9 +22,25 @@ its guard semantics: a skip decision must be computed from COLLECTIVE
 values (post-psum grads/score) so every replica skips identically and
 replicated params never diverge.
 
+data×model meshes (the model-parallel tentpole): passing
+``param_specs`` (a pytree of ``PartitionSpec`` over the params, e.g.
+``models/transformer.shard_specs`` — attention heads and MLP hidden
+over ``model``, embeddings over vocab) switches both builders to
+GSPMD mode: the step is a GLOBAL-view function (no shard_map, no
+hand-written psums — XLA inserts the collectives from the shardings),
+params and updater state are laid out with ``NamedSharding`` from the
+specs instead of replicated, the batch stays sharded over ``data``,
+and donation aliases each weight shard in place on its own device.
+Because every value in a GSPMD program is logically GLOBAL, the PR 2
+guard-skip verdict and the PR 11 loss-scale transition are replica-
+consistent across BOTH axes by construction — there is one verdict,
+not one per shard.
+
 Engine keys: callers that want cross-instance sharing pass
 ``engine_key`` including ``mesh.mesh_signature(mesh)`` — mesh shape AND
-device ids — so two meshes never silently share a compiled executable.
+device ids — so two meshes never silently share a compiled executable
+(a 2×4 data×model mesh and an 8×1 data mesh over the same devices are
+different signatures, hence different entries).
 """
 
 from __future__ import annotations
@@ -145,16 +161,46 @@ def stacked_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, DATA_AXIS))
 
 
-def build_sharded_step(shard_step: ShardStep, mesh: Optional[Mesh], *,
-                       batch_specs: PyTree = None, label: str,
-                       engine_key: Optional[Hashable] = None,
-                       donate: bool = True):
-    """Per-batch dispatch shape (streaming loops): returns a compiled
-    ``fn(params, ustate, batch, key, it)``.  ``batch_specs`` is a pytree
-    of ``PartitionSpec`` matching ``batch`` (e.g. ``(P('data'),
-    P('data'), P())`` for (x, y, n_valid)).  ``mesh=None`` compiles the
-    step unsharded (the step must then avoid collectives — e.g. the
-    grad-accumulation-only path)."""
+def named_shardings(mesh: Mesh, specs: PyTree) -> PyTree:
+    """``PartitionSpec`` (prefix) tree -> ``NamedSharding`` tree over
+    ``mesh`` — the layout half of GSPMD mode.  ``specs=None`` means
+    fully replicated."""
+    if specs is None:
+        return NamedSharding(mesh, P())
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _gspmd_shardings(mesh: Mesh, param_specs: PyTree, ustate_specs: PyTree,
+                     batch_specs: PyTree):
+    """(in_shardings, out_shardings) for a GSPMD-mode step signature
+    ``(params, ustate, batch, key, it) -> (params, ustate, score,
+    skipped)``: params/ustate per their spec trees, batch per
+    ``batch_specs``, scalars replicated.  ``ustate_specs`` defaults to
+    ``param_specs`` (updater accumulators mirror the weights they
+    smooth)."""
+    psh = named_shardings(mesh, param_specs)
+    ush = named_shardings(
+        mesh, ustate_specs if ustate_specs is not None else param_specs)
+    bsh = named_shardings(mesh, batch_specs)
+    repl = NamedSharding(mesh, P())
+    return (psh, ush, bsh, repl, repl), (psh, ush, repl, repl)
+
+
+def _build_gspmd_step(shard_step, mesh, batch_specs, label, engine_key,
+                      donate, param_specs, ustate_specs):
+    in_sh, out_sh = _gspmd_shardings(mesh, param_specs, ustate_specs,
+                                     batch_specs)
+    return _with_dispatch_span(
+        compile_cache.cached_jit(
+            shard_step, key=engine_key, label=label,
+            in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=(0, 1) if donate else ()),
+        label, scanned=False)
+
+
+def _build_shardmap_step(shard_step, mesh, batch_specs, label, engine_key,
+                         donate, param_specs, ustate_specs):
     sharded = shard_step if mesh is None else shard_map(
         shard_step, mesh=mesh,
         in_specs=(P(), P(), batch_specs, P(), P()),
@@ -168,10 +214,34 @@ def build_sharded_step(shard_step: ShardStep, mesh: Optional[Mesh], *,
         label, scanned=False)
 
 
+def build_sharded_step(shard_step: ShardStep, mesh: Optional[Mesh], *,
+                       batch_specs: PyTree = None, label: str,
+                       engine_key: Optional[Hashable] = None,
+                       donate: bool = True, param_specs: PyTree = None,
+                       ustate_specs: PyTree = None):
+    """Per-batch dispatch shape (streaming loops): returns a compiled
+    ``fn(params, ustate, batch, key, it)``.  ``batch_specs`` is a pytree
+    of ``PartitionSpec`` matching ``batch`` (e.g. ``(P('data'),
+    P('data'), P())`` for (x, y, n_valid)).  ``mesh=None`` compiles the
+    step unsharded (the step must then avoid collectives — e.g. the
+    grad-accumulation-only path).
+
+    ``param_specs`` switches to GSPMD mode (module docstring): the step
+    must then be a GLOBAL-view function — its params arrive laid out
+    per the specs, its batch sharded per ``batch_specs``, and XLA owns
+    the collectives.  ``ustate_specs`` defaults to ``param_specs``."""
+    build = (_build_gspmd_step
+             if mesh is not None and param_specs is not None
+             else _build_shardmap_step)
+    return build(shard_step, mesh, batch_specs, label, engine_key, donate,
+                 param_specs, ustate_specs)
+
+
 def build_scanned_epochs(shard_step: ShardStep, mesh: Optional[Mesh], *,
                          batch_specs: PyTree = None, label: str,
                          engine_key: Optional[Hashable] = None,
-                         donate: bool = True):
+                         donate: bool = True, param_specs: PyTree = None,
+                         ustate_specs: PyTree = None):
     """The single-dispatch fit: ``fn(params, ustate, batches, key, it0,
     num_epochs)`` scans ``shard_step`` over stacked batches [NB, B, ...]
     and again over epochs — one host->device round trip for the whole
@@ -181,7 +251,10 @@ def build_scanned_epochs(shard_step: ShardStep, mesh: Optional[Mesh], *,
     ``num_epochs`` is static (retrace per value, same contract as the
     single-device ``train_epochs``).  ``mesh=None`` keeps the same
     double scan without the shard_map wrap (grad-accumulation on one
-    device)."""
+    device).  ``param_specs`` switches to GSPMD mode exactly like
+    ``build_sharded_step`` — the model-sharded layout threads through
+    BOTH scans (the carry keeps each weight shard resident on its
+    device across every step of every epoch)."""
 
     def epochs_body(params, ustate, batches, key, it0, *, num_epochs):
         def body(carry, batch):
@@ -195,6 +268,27 @@ def build_scanned_epochs(shard_step: ShardStep, mesh: Optional[Mesh], *,
         (params, ustate, _), (scores, skips) = lax.scan(
             epoch_body, (params, ustate, it0), None, length=num_epochs)
         return params, ustate, scores, skips
+
+    if mesh is not None and param_specs is not None:
+        # GSPMD: the same double scan, compiled with the param/ustate
+        # layout pinned by in/out shardings; the stacked batch rides
+        # with the scan axis replicated and the example axis over `data`
+        stacked_specs = jax.tree.map(lambda s: P(None, *s), batch_specs,
+                                     is_leaf=lambda x: isinstance(x, P))
+        in_sh, out_sh = _gspmd_shardings(mesh, param_specs, ustate_specs,
+                                         stacked_specs)
+
+        def epochs_global(params, ustate, batches, key, it0, num_epochs):
+            return epochs_body(params, ustate, batches, key, it0,
+                               num_epochs=num_epochs)
+
+        return _with_dispatch_span(
+            compile_cache.cached_jit(
+                epochs_global, key=engine_key, label=label,
+                static_argnums=(5,),
+                in_shardings=in_sh, out_shardings=out_sh,
+                donate_argnums=(0, 1) if donate else ()),
+            label, scanned=True)
 
     if mesh is None:
         def epochs(params, ustate, batches, key, it0, num_epochs):

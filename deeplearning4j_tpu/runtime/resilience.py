@@ -484,8 +484,12 @@ class ResilientFit:
     in-graph, guard skips decided collectively so replicas never
     diverge — checkpoints, rollback, and resume are unchanged host
     policy on top (resume is step-for-step equivalent to an
-    uninterrupted sharded run; tested).  Default None keeps the
-    single-device step byte-for-byte as before.
+    uninterrupted sharded run; tested).  A data×model mesh (driving a
+    model whose machinery lays params out with ``NamedSharding`` —
+    ``models/lm_fit.CausalLM``) works identically: snapshots gather
+    the logical arrays, restores re-shard through the engine step's
+    pinned layouts, and resume stays bit-exact on the same mesh.
+    Default None keeps the single-device step byte-for-byte as before.
 
     Robustness upgrades (ROADMAP item 4):
 
@@ -680,7 +684,11 @@ class ResilientFit:
         preserved via grad_accum scaling) -> restore last committed
         snapshot.  Returns (dispatch, updaters, params, ustate, step).
         Single-device runs have nothing to shrink onto — the loss
-        re-raises."""
+        re-raises.  data×model meshes shrink their DATA axis only
+        (``parallel.mesh.elastic_remesh`` keeps whole model groups
+        intact — the tensor-parallel weight layout survives the
+        re-mesh verbatim; too few survivors for one group raises with
+        the surviving count and required divisor)."""
         from deeplearning4j_tpu.parallel import mesh as mesh_lib
 
         checkpoint_metrics.note("device_losses")
@@ -700,20 +708,22 @@ class ResilientFit:
                 sorted(members))
             raise err
         old_degree = int(self.mesh.shape[mesh_lib.DATA_AXIS])
+        m_degree = mesh_lib.model_degree(self.mesh)
         old_accum = max(self.elastic_accum or net.conf.grad_accum, 1)
         new_mesh, new_accum = mesh_lib.elastic_remesh(
             self.mesh, err.lost_ids, old_accum)
         new_degree = (int(new_mesh.shape[mesh_lib.DATA_AXIS])
                       if new_mesh is not None else 1)
         log.warning(
-            "device loss (ids %s): re-meshing %d->%d data shards, "
-            "grad_accum %d->%d (effective batch preserved); restoring "
-            "last committed snapshot", sorted(set(err.lost_ids)),
-            old_degree, new_degree, old_accum, new_accum)
+            "device loss (ids %s): re-meshing %d->%d data shards "
+            "(model degree %d preserved), grad_accum %d->%d (effective "
+            "batch preserved); restoring last committed snapshot",
+            sorted(set(err.lost_ids)),
+            old_degree, new_degree, m_degree, old_accum, new_accum)
         telemetry.event("resilience.device_loss",
                         lost=sorted(set(err.lost_ids)),
                         old_degree=old_degree, new_degree=new_degree,
-                        new_accum=new_accum)
+                        model_degree=m_degree, new_accum=new_accum)
         self._drain()   # the restore below must see every commit
         self.mesh = new_mesh
         self.elastic_accum = new_accum

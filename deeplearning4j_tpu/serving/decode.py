@@ -35,6 +35,18 @@ programs (asserted by the bench row and the telemetry gate).  The
 worker/lock contract (engine driven by ONE thread, shared request
 state mutated only under its Condition, no blocking wait under a held
 lock) is machine-checked by jaxlint's concurrency family.
+
+MODEL-SHARDED serving (the data×model tentpole's serving half): pass
+``mesh=`` (a mesh with a ``model`` axis — ``Router.replicate(...,
+model_degree=N)`` builds one per device group) and the engine pins
+GSPMD shardings on both executables: params laid out per
+``gpt.shard_specs`` (heads/MLP over ``model``, tied embedding over
+vocab) and the slot KV cache sharded over its HEAD axis
+(``gpt.slot_specs``), so each chip holds only its heads' weights and
+cache — a model bigger than one chip's HBM serves from a group of
+chips, with per-chip param bytes ~1/model_degree of the replicated
+layout.  The engine key grows ``mesh_signature`` so two groups (or a
+sharded and a replicated engine) never share an executable.
 """
 
 from __future__ import annotations
@@ -45,8 +57,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.models import gpt
+from deeplearning4j_tpu.parallel.mesh import mesh_signature, model_degree
 from deeplearning4j_tpu.runtime import compile_cache, telemetry
 from deeplearning4j_tpu.runtime.metrics import decode_metrics
 
@@ -106,11 +120,12 @@ class DecodeEngine:
     def __init__(self, cfg, params: Any, *, n_slots: int = 8,
                  buckets: Optional[Sequence[int]] = None,
                  prefill_chunk: int = gpt.PREFILL_CHUNK,
-                 label: str = "decode"):
+                 label: str = "decode", mesh=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1: {n_slots}")
         self.cfg = cfg
         self._params = params
+        self.mesh = mesh
         self.n_slots = int(n_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.buckets = tuple(sorted(set(
@@ -141,16 +156,47 @@ class DecodeEngine:
         self._buckets: Dict[int, _Bucket] = {
             t: _Bucket(t, self.n_slots) for t in self.buckets}
         prefill_fn, decode_fn, key = gpt.make_slot_fns(cfg)
-        # one executable pair per (conf, slot-geometry): the shapes
-        # traced differ only in T_max across buckets, so the compile
-        # count is bounded by 2 x len(buckets)
-        geo = (self.n_slots, self.prefill_chunk)
+        # one executable pair per (conf, slot-geometry, mesh): the
+        # shapes traced differ only in T_max across buckets, so the
+        # compile count is bounded by 2 x len(buckets); the mesh
+        # signature keeps a sharded engine (or a second device group)
+        # from hitting a replicated engine's executable
+        geo = (self.n_slots, self.prefill_chunk, mesh_signature(mesh))
+        shard_kw_prefill: Dict[str, Any] = {}
+        shard_kw_decode: Dict[str, Any] = {}
+        self._slot_shardings = None
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel.sharded_fit import \
+                named_shardings
+
+            m_deg = model_degree(mesh)
+            if cfg.n_heads % m_deg:
+                raise ValueError(
+                    f"n_heads={cfg.n_heads} not divisible by model "
+                    f"degree {m_deg}: the slot KV cache shards over "
+                    f"heads (gpt.slot_specs)")
+            psh = named_shardings(mesh, gpt.shard_specs(
+                cfg, model_degree=m_deg))
+            ssh = named_shardings(mesh, gpt.slot_specs(cfg))
+            repl = NamedSharding(mesh, P())
+            self._slot_shardings = ssh
+            # prefill(params, slots, toks, slot, start, n_valid, temp,
+            # seed) / decode(params, slots, active, temps, seeds): only
+            # params and the slot state carry a layout
+            shard_kw_prefill = dict(
+                in_shardings=(psh, ssh) + (repl,) * 6,
+                out_shardings=(ssh, repl))
+            shard_kw_decode = dict(
+                in_shardings=(psh, ssh) + (repl,) * 3,
+                out_shardings=(ssh, repl))
         self._prefill = compile_cache.cached_jit(
             prefill_fn, key=(key, geo, "prefill"),
-            label=f"{label}.prefill", donate_argnums=(1,))
+            label=f"{label}.prefill", donate_argnums=(1,),
+            **shard_kw_prefill)
         self._decode = compile_cache.cached_jit(
             decode_fn, key=(key, geo, "step"),
-            label=f"{label}.step", donate_argnums=(1,))
+            label=f"{label}.step", donate_argnums=(1,),
+            **shard_kw_decode)
 
     # -- params ------------------------------------------------------------
     def current_params(self) -> Any:
@@ -178,7 +224,13 @@ class DecodeEngine:
 
     def _state(self, b: _Bucket):
         if b.slots is None:
-            b.slots = gpt.init_slots(self.cfg, self.n_slots, b.t_max)
+            slots = gpt.init_slots(self.cfg, self.n_slots, b.t_max)
+            if self._slot_shardings is not None:
+                # scatter the fresh cache into its head-sharded layout
+                # up front: the first donated dispatch then aliases the
+                # shards in place instead of resharding
+                slots = jax.device_put(slots, self._slot_shardings)
+            b.slots = slots
         return b.slots
 
     # -- AOT warmup --------------------------------------------------------

@@ -14,9 +14,13 @@ client's latency.
   ``runtime.metrics.decode_metrics.requests_shed`` and, when tracing,
   a ``decode.shed`` event) — clients see a clean, immediate, typed
   rejection they can retry against, not a timeout.
-- ``Router.replicate(...)`` builds the replicas, placing each engine's
-  params on a device round-robin (``jax.devices()``) so replicas decode
-  on distinct chips when the platform has them.
+- ``Router.replicate(...)`` builds the replicas over DEVICE GROUPS:
+  each replica is a ``model_degree``-sized group of chips with the
+  engine's params model-sharded across the group (heads/MLP over
+  ``model``, KV cache over heads) — replicas round-robin over groups,
+  so a model bigger than one chip's HBM still replicates for
+  throughput.  ``model_degree=1`` (default) is the original one
+  -device-per-replica placement.
 """
 
 from __future__ import annotations
@@ -62,7 +66,8 @@ class Router:
 
     # -- construction ------------------------------------------------------
     @classmethod
-    def replicate(cls, cfg, params: Any, n_replicas: int, *,
+    def replicate(cls, cfg, params: Any, n_replicas: Optional[int] = None,
+                  *, model_degree: int = 1,
                   devices: Optional[Sequence] = None,
                   max_queue_depth: int = 64,
                   n_slots: int = 8,
@@ -70,20 +75,49 @@ class Router:
                   prefill_chunk: Optional[int] = None,
                   default_max_tokens: int = 64,
                   warmup: bool = True) -> "Router":
-        """Build N engine+batcher replicas for one model, params placed
-        round-robin over ``devices`` (default: all local devices)."""
+        """Build N engine+batcher replicas for one model over DEVICE
+        GROUPS: each replica owns a ``model_degree``-sized consecutive
+        group of ``devices`` (default: all local devices), its params
+        laid out model-sharded over the group (``gpt.shard_specs``) and
+        its KV cache sharded over heads — so a model bigger than one
+        chip's HBM serves, each chip holding ~1/model_degree of the
+        weights.  Replicas round-robin over the groups when
+        ``n_replicas`` exceeds the group count; ``n_replicas=None``
+        defaults to one replica per group.  ``model_degree=1`` keeps
+        the original per-device placement byte-for-byte (groups of one
+        device).  MIGRATION.md documents the signature change."""
         from deeplearning4j_tpu.models import gpt
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.sharded_fit import named_shardings
 
+        if model_degree < 1:
+            raise ValueError(f"model_degree must be >= 1: {model_degree}")
+        devices = list(devices) if devices is not None else jax.devices()
+        n_groups = len(devices) // model_degree
+        if n_groups < 1:
+            raise ValueError(
+                f"model_degree {model_degree} exceeds the {len(devices)} "
+                f"available device(s): a replica needs one whole group")
+        if n_replicas is None:
+            n_replicas = n_groups
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1: {n_replicas}")
-        devices = list(devices) if devices is not None else jax.devices()
         chunk = prefill_chunk or gpt.PREFILL_CHUNK
         batchers = []
         for i in range(n_replicas):
-            dev = devices[i % len(devices)]
-            p = jax.device_put(params, dev)
+            if model_degree == 1:
+                dev = devices[i % len(devices)]
+                p = jax.device_put(params, dev)
+                mesh = None
+            else:
+                group = devices[(i % n_groups) * model_degree:
+                                (i % n_groups + 1) * model_degree]
+                mesh = make_mesh(MeshSpec(data=1, model=model_degree),
+                                 devices=group)
+                p = jax.device_put(params, named_shardings(
+                    mesh, gpt.shard_specs(cfg, model_degree=model_degree)))
             eng = DecodeEngine(cfg, p, n_slots=n_slots, buckets=buckets,
-                               prefill_chunk=chunk)
+                               prefill_chunk=chunk, mesh=mesh)
             if warmup:
                 eng.warmup()
             batchers.append(ContinuousBatcher(

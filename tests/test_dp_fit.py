@@ -225,12 +225,23 @@ def test_sharded_machinery_cache_keyed_per_mesh(devices):
     assert net1._backprop_machinery() is not b8
 
 
-def test_auto_gates_keep_stochastic_confs_single_device(devices):
-    """Auto-detection must not silently flip dropout/BN confs to
-    per-shard noise streams; explicit meshes may."""
+def test_auto_gates_keep_bn_confs_single_device(devices):
+    """Dropout confs NOW auto-shard (ROADMAP item 5 first half: the
+    shard index folds into the step key, per-replica masks); only
+    BatchNorm still gates auto-detection — its in-batch statistics
+    would silently go per-shard."""
     net = MultiLayerNetwork(_conf(dropout=0.5)).init(seed=1)
-    assert net._resolve_fit_mesh("auto", 32) is None
+    assert net._resolve_fit_mesh("auto", 32) is not None
     assert net._resolve_fit_mesh(auto_data_mesh(), 32) is not None
+    bn_conf = (NeuralNetConfiguration.builder()
+               .n_in(4).lr(0.1).use_adagrad(False).activation("tanh")
+               .list(4).hidden_layer_sizes(8, 8, 6)
+               .override(1, kind=LayerKind.BATCH_NORM)
+               .override(3, kind=LayerKind.OUTPUT, n_out=3,
+                         activation="softmax", loss_function="mcxent")
+               .pretrain(False).backward(True).build())
+    assert MultiLayerNetwork(bn_conf).init(
+        seed=1)._resolve_fit_mesh("auto", 32) is None
     # plain confs do auto-shard
     assert MultiLayerNetwork(_conf())._resolve_fit_mesh(
         "auto", 32) is not None

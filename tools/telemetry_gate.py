@@ -22,7 +22,13 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
    concurrent request mix — joins, EOS recycling, varied prompt
    lengths — must dispatch only cached programs with the tracer off AND
    on (the decode path's prefill/dispatch spans and join/complete
-   events are host-side only).
+   events are host-side only);
+7. the same off/on zero-compile contract for a warmed DATA×MODEL fit
+   (``models/lm_fit.CausalLM`` on a 2×4 mesh through the sharded_fit
+   GSPMD builders): the model-sharded scanned dispatch, its staging
+   device_puts, and the loss-scale/guard state threading must never
+   retrace — the gate process forces 8 virtual CPU devices so the
+   real sharded program runs.
 
 Run by ``tools/ci.sh`` before the test tiers; exits non-zero on any
 violation.  (jaxlint runs separately in ci.sh and must also stay clean —
@@ -37,6 +43,13 @@ import sys
 import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the data×model gate needs a real multi-device mesh; force the virtual
+# 8-device CPU platform BEFORE any backend initializes (same pattern as
+# tests/conftest.py)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 
 def _net_and_data():
@@ -165,6 +178,69 @@ def _mixed_precision_gate(registry, telemetry) -> int:
     return 0
 
 
+def _model_parallel_gate(registry, telemetry) -> int:
+    """data×model loop gate: a WARMED 2×4 GSPMD fit (CausalLM through
+    the sharded_fit builders — model-sharded params, donated scanned
+    dispatch, guard + loss-scale state threading) must dispatch only
+    cached programs with the tracer off AND on."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.models.lm_fit import CausalLM
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    if len(jax.devices()) < 8:
+        print("[telemetry-gate] skip: model-parallel loop needs 8 "
+              f"devices, have {len(jax.devices())}")
+        return 0
+    cfg = dataclasses.replace(gpt.gpt_tiny(vocab_size=64, max_len=16),
+                              hidden=32, n_layers=2, n_heads=4,
+                              ffn_dim=64, compute_dtype="float32")
+    rng = np.random.RandomState(0)
+    batches = [DataSet(jnp.asarray(rng.randint(0, 64, (8, 16)),
+                                   jnp.int32),
+                       jnp.asarray(rng.randint(0, 64, (8, 16)),
+                                   jnp.int32))
+               for _ in range(3)]
+    mesh = make_mesh(MeshSpec(data=2, model=4),
+                     devices=jax.devices()[:8])
+    lm = CausalLM(cfg, lr=0.05)
+
+    def one_fit(seed):
+        lm.init(seed=1)
+        lm.fit_backprop(batches, num_epochs=1, seed=seed, mesh=mesh)
+
+    one_fit(0)              # warm the data×model engine entry
+    registry.mark()
+
+    assert not telemetry.enabled()
+    one_fit(1)
+    delta_off = registry.compile_delta_since_mark()
+    if delta_off != 0:
+        print(f"[telemetry-gate] FAIL: tracer-off data×model fit "
+              f"compiled {delta_off} new program(s)")
+        return 1
+
+    telemetry.enable("telemetry-gate-mp-mesh")
+    registry.mark()
+    one_fit(2)
+    delta_on = registry.compile_delta_since_mark()
+    telemetry.disable()
+    if delta_on != 0:
+        print(f"[telemetry-gate] FAIL: tracer-on data×model fit "
+              f"compiled {delta_on} new program(s) — model-parallel "
+              "instrumentation leaked into a jitted region")
+        return 1
+    print(f"[telemetry-gate] ok: data×model loop compile_delta "
+          f"off={delta_off} on={delta_on}")
+    return 0
+
+
 def _decode_gate(registry, telemetry) -> int:
     import numpy as np
 
@@ -255,6 +331,9 @@ def main() -> int:
     if rc:
         return rc
     rc = _mixed_precision_gate(registry, telemetry)
+    if rc:
+        return rc
+    rc = _model_parallel_gate(registry, telemetry)
     if rc:
         return rc
     return _decode_gate(registry, telemetry)
