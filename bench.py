@@ -718,10 +718,18 @@ def bench_word2vec(n_sentences: int = 1600, sent_len: int = 30,
 
 def _bench_dcn_two_process(d: int = 256, per_shard_batch: int = 64,
                            steps: int = 10) -> dict | None:
-    """Grad-sharing step across a REAL 2-process jax.distributed cluster
-    (the DCN path: gradient psum crosses process boundaries over gRPC) —
-    the smoke-measured analog of the reference's Spark grad averaging over
-    the wire.  Returns None when the environment can't form the cluster."""
+    """Training step across a REAL 2-process jax.distributed cluster,
+    through the PRODUCTION spine — each subprocess joins via
+    ``multihost.initialize``, builds the global data mesh spanning both
+    processes, and drives a ``MultiLayerNetwork`` through
+    ``ResilientFit`` (whose engine step is ``parallel/sharded_fit
+    .build_sharded_step``: grads psum'd over DCN, cluster-committed
+    snapshots, collective guard skips) — so ``dcn_samples_per_sec``
+    measures what ``cli train --coordinator ...`` users actually run,
+    not a bespoke psum harness.  A warmed second fit must show
+    ``compile_delta == 0`` per process.  Returns None when the
+    environment can't form the cluster or its backend can't run
+    cross-process computations (the skip path)."""
     import socket
     import textwrap
 
@@ -730,63 +738,88 @@ def _bench_dcn_two_process(d: int = 256, per_shard_batch: int = 64,
         coord = f"127.0.0.1:{s.getsockname()[1]}"
 
     worker = textwrap.dedent("""
-        import os, sys, time
+        import os, sys, tempfile, time
         os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4").strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 4)
+        try:
+            jax.config.update("jax_num_cpu_devices", 4)
+        except AttributeError:
+            pass    # pre-0.4.38: the XLA_FLAGS fallback above covers it
         sys.path.insert(0, {repo!r})
         import numpy as np
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from deeplearning4j_tpu.parallel.mesh import (
-            MeshSpec, initialize_distributed, make_mesh)
-        initialize_distributed({coord!r}, 2, {pid})
-        mesh = make_mesh(MeshSpec(data=8))
-        d, psb = {d}, {psb}
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import multihost
+        from deeplearning4j_tpu.runtime.telemetry import registry
+        from deeplearning4j_tpu.runtime.resilience import (
+            ResilienceConfig, ResilientFit)
+        cluster = multihost.initialize(multihost.ClusterConfig(
+            {coord!r}, 2, {pid}), attempts=2, timeout_s=120)
+        mesh = multihost.global_data_mesh()
+        assert mesh.shape["data"] == 8, mesh.shape
+        d, psb, steps = {d}, {psb}, {steps}
         B = psb * 8
+        conf = (NeuralNetConfiguration.builder()
+                .n_in(d).lr(0.05).momentum(0.5).use_adagrad(False)
+                .num_iterations(1).activation("tanh")
+                .list(3).hidden_layer_sizes(d, d)
+                .override(2, kind=LayerKind.OUTPUT, n_out=10,
+                          activation="softmax", loss_function="mcxent")
+                .pretrain(False).backward(True).build())
         rng = np.random.RandomState(0)
-        f32 = lambda a: np.asarray(a, np.float32)
-        params = {{"w1": jnp.asarray(f32(rng.randn(d, d) * 0.05)),
-                   "b1": jnp.zeros((d,)),
-                   "w2": jnp.asarray(f32(rng.randn(d, d) * 0.05)),
-                   "b2": jnp.zeros((d,))}}
-        def loss(p, x, y):
-            h = jnp.tanh(x @ p["w1"] + p["b1"])
-            return jnp.mean((h @ p["w2"] + p["b2"] - y) ** 2)
-        def step(p, x, y):
-            g = jax.grad(loss)(p, x, y)
-            return jax.tree.map(lambda a, gg: a - 0.01 * gg, p, g)
-        bshard = NamedSharding(mesh, P("data", None))
-        rshard = NamedSharding(mesh, P())
-        x = jax.device_put(f32(rng.randn(B, d)), bshard)
-        y = jax.device_put(f32(rng.randn(B, d)), bshard)
-        params = jax.device_put(params, rshard)
-        jstep = jax.jit(step, in_shardings=(rshard, bshard, bshard),
-                        out_shardings=rshard)
-        for _ in range(3):
-            params = jstep(params, x, y)
-        float(np.asarray(params["b1"])[0])
-        t0 = time.perf_counter()
-        for _ in range({steps}):
-            params = jstep(params, x, y)
-        float(np.asarray(params["b1"])[0])
-        dt = (time.perf_counter() - t0) / {steps}
+        batches = [DataSet(np.asarray(rng.randn(B, d), np.float32),
+                           np.eye(10, dtype=np.float32)[
+                               rng.randint(0, 10, B)])
+                   for _ in range(steps)]
+
+        def run(sub):
+            net = MultiLayerNetwork(conf).init(seed=0)
+            # ONE checkpoint dir SHARED by both processes ({ckdir} from
+            # the parent): the cluster-committed snapshots, heartbeats,
+            # and commit barriers all assume a shared filesystem — a
+            # per-process tempdir would make every peer's heartbeat
+            # look missing and the manifest unreadable off-coordinator
+            drv = ResilientFit(net, ResilienceConfig(
+                checkpoint_dir=os.path.join({ckdir!r}, sub),
+                checkpoint_every=10 * steps), mesh=mesh,
+                cluster=cluster)
+            t0 = time.perf_counter()
+            drv.fit(batches, num_epochs=1, seed=3)
+            jax.block_until_ready(jax.tree.leaves(net.params)[0])
+            return time.perf_counter() - t0
+
+        run("warm")                       # compiles banked
+        registry.mark()
+        dt = run("timed") / steps
+        assert registry.compile_delta_since_mark() == 0
         print("DCN_STEP_MS", round(dt * 1000, 3), flush=True)
     """)
+    import tempfile
+
+    ckdir = tempfile.mkdtemp(prefix="dcn_bench_ckpt_")
     procs = [subprocess.Popen(
         [sys.executable, "-c",
          worker.format(repo=os.path.dirname(os.path.abspath(__file__)),
                        coord=coord, pid=pid, d=d, psb=per_shard_batch,
-                       steps=steps)],
+                       steps=steps, ckdir=ckdir)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for pid in (0, 1)]
     try:
-        outs = [p.communicate(timeout=240) for p in procs]
+        outs = [p.communicate(timeout=420) for p in procs]
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
         return None
+    finally:
+        import shutil
+
+        shutil.rmtree(ckdir, ignore_errors=True)
     if any(p.returncode != 0 for p in procs):
         return None
     ms = [float(line.split()[1]) for out, _ in outs
@@ -794,6 +827,8 @@ def _bench_dcn_two_process(d: int = 256, per_shard_batch: int = 64,
     if not ms:
         return None
     return {"dcn_processes": 2, "dcn_global_devices": 8,
+            "dcn_spine": "sharded_fit+resilient_fit",
+            "dcn_compile_delta": 0,
             "dcn_step_ms": round(max(ms), 3),
             "dcn_samples_per_sec": round(per_shard_batch * 8 / (max(ms) / 1e3),
                                          1)}
@@ -904,7 +939,8 @@ def bench_scaling(ndp: int = 8, n_batches: int = 16, num_epochs: int = 4,
     if dcn:
         out.update(dcn)
     else:
-        out["dcn"] = "2-process jax.distributed unavailable here"
+        out["dcn"] = ("2-process jax.distributed bring-up or cross-"
+                      "process compute unavailable here")
     return out
 
 
@@ -933,7 +969,8 @@ def bench_dp_fit(ndp: int = 8, per_shard_batch: int = 16,
     from deeplearning4j_tpu.ops.updaters import dl4j_updater
     from deeplearning4j_tpu.parallel import DataParallelTrainer
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
-    from deeplearning4j_tpu.runtime.metrics import compile_metrics, dp_metrics
+    from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                    dp_metrics)
 
     platform, kind, n_dev = _platform_info()
     ndp = min(ndp, n_dev)
@@ -1050,7 +1087,8 @@ def bench_model_parallel(model_degree: int = 4, ndata: int = 2,
     from deeplearning4j_tpu.models.lm_fit import CausalLM
     from deeplearning4j_tpu.parallel.mesh import (MeshSpec, make_mesh,
                                                   per_device_bytes)
-    from deeplearning4j_tpu.runtime.metrics import compile_metrics, dp_metrics
+    from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                    dp_metrics)
     import dataclasses
 
     platform, kind, n_dev = _platform_info()

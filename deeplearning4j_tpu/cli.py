@@ -73,6 +73,30 @@ def cmd_train(args) -> int:
     if args.checkpoint_dir and args.checkpoint_every <= 0:
         raise SystemExit("--checkpoint-every must be a positive step "
                          "count")
+    # multi-host launcher: merge the flag trio with the DL4J_TPU_* env
+    # trio (flags > env, one source of truth: multihost
+    # .resolve_cluster_config), join with bounded retry/backoff, and
+    # hand the cluster to the ResilientFit driver below
+    cluster = None
+    from deeplearning4j_tpu.parallel import multihost
+    try:
+        cluster_cfg = multihost.resolve_cluster_config(
+            args.coordinator, args.num_processes, args.process_id)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if cluster_cfg is not None and cluster_cfg.num_processes > 1:
+        if not args.checkpoint_dir:
+            raise SystemExit(
+                "multi-process training requires --checkpoint-dir: "
+                "cluster-committed snapshots (on a filesystem every "
+                "host shares) are the substrate preemption and "
+                "host-loss recovery coordinate through")
+        try:
+            cluster = multihost.initialize(cluster_cfg)
+        except multihost.ClusterJoinError as e:
+            raise SystemExit(f"cluster join failed: {e}")
+        print(f"joined cluster: process {cluster.process_id} of "
+              f"{cluster.process_count} at {cluster_cfg.coordinator}")
     tracer = None
     journal_dir = args.telemetry
     if journal_dir is True:                 # bare --telemetry flag
@@ -128,8 +152,14 @@ def cmd_train(args) -> int:
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
                 resume=args.resume, sync=args.sync_checkpoints),
-                mesh=mesh)
+                mesh=mesh, cluster=cluster)
             driver.fit(batch_list, num_epochs=args.epochs, seed=args.seed)
+            if driver.evicted:
+                print("host loss: this process's devices were lost — "
+                      "exiting cleanly; the surviving hosts carry the "
+                      "run (resume from the cluster-committed "
+                      f"snapshots in {args.checkpoint_dir})")
+                return 0
             if driver.preempted:
                 print(f"preempted: final snapshot committed at step "
                       f"{driver.manager.latest_step()} in "
@@ -423,6 +453,24 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sync-checkpoints", action="store_true",
                    help="escape hatch: block the training thread on "
                         "every snapshot instead of the async writer")
+    # multi-host launcher trio (parallel/multihost.py owns the
+    # contract): flags override the DL4J_TPU_COORDINATOR/
+    # NUM_PROCESSES/PROCESS_ID env trio per field; every host runs the
+    # SAME command with its own --process-id (or the provision
+    # scripts' exported env) and the processes form one
+    # jax.distributed cluster with a global device mesh
+    t.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address "
+                        "(env: DL4J_TPU_COORDINATOR); set the trio "
+                        "to train across processes/hosts")
+    t.add_argument("--num-processes", type=int, default=None,
+                   metavar="N",
+                   help="total processes in the cluster "
+                        "(env: DL4J_TPU_NUM_PROCESSES)")
+    t.add_argument("--process-id", type=int, default=None,
+                   metavar="I",
+                   help="this process's rank in [0, N) "
+                        "(env: DL4J_TPU_PROCESS_ID)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("test", help="evaluate a saved model")

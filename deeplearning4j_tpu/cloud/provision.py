@@ -19,6 +19,18 @@ import dataclasses
 import shlex
 from typing import Dict, List, Optional
 
+# The wiring trio's single source of truth is
+# ``parallel/multihost.py`` (``ENV_TRIO`` there): these scripts EXPORT
+# the same names ``resolve_cluster_config`` consumes, and the cli.py
+# launcher flags override them per field (flags > env).  Spelled as
+# LITERALS here so this shell-script renderer stays importable without
+# jax (an operator laptop rendering launch scripts shouldn't need a
+# working accelerator stack); tests/test_multihost_runtime.py asserts
+# the two spellings never drift.
+ENV_COORDINATOR = "DL4J_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "DL4J_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "DL4J_TPU_PROCESS_ID"
+
 
 @dataclasses.dataclass(frozen=True)
 class TpuPodSpec:
@@ -75,10 +87,10 @@ def render_launch_script(spec: TpuPodSpec, train_cmd: str,
     # from the TPU-VM environment (worker 0's hostname is the
     # coordinator; TPU_WORKER_ID is this host's rank) — expanded by the
     # REMOTE shell, which is why the $ stays quoted here
-    wiring = (f'export DL4J_TPU_COORDINATOR='
+    wiring = (f'export {ENV_COORDINATOR}='
               f'"${{TPU_WORKER_HOSTNAMES%%,*}}:{coordinator_port}" '
-              f'DL4J_TPU_NUM_PROCESSES={spec.n_hosts} '
-              f'DL4J_TPU_PROCESS_ID="${{TPU_WORKER_ID}}"')
+              f'{ENV_NUM_PROCESSES}={spec.n_hosts} '
+              f'{ENV_PROCESS_ID}="${{TPU_WORKER_ID}}"')
     inner = f"{wiring}; {exports} {train_cmd}".strip()
     args = [
         "gcloud", "compute", "tpus", "tpu-vm", "ssh", spec.name,
@@ -114,8 +126,8 @@ def render_local_launch_script(spec: TpuPodSpec, train_cmd: str,
         "pids=()",
         f"for p in $(seq 0 {n - 1}); do",
         # user env first: the per-process wiring must always win
-        f"  env {exports} DL4J_TPU_COORDINATOR=\"$COORD\" "
-        f"DL4J_TPU_NUM_PROCESSES={n} DL4J_TPU_PROCESS_ID=$p "
+        f"  env {exports} {ENV_COORDINATOR}=\"$COORD\" "
+        f"{ENV_NUM_PROCESSES}={n} {ENV_PROCESS_ID}=$p "
         f"{train_cmd} &",
         "  pids+=($!)",
         "done",

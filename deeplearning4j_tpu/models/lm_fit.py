@@ -283,10 +283,6 @@ class CausalLM:
     def _pad_rows(arr: Array, target: int) -> Array:
         return pad_rows(arr, target)
 
-    def _check_bn_padding(self, needs_pad: bool) -> None:
-        """No BatchNorm in the transformer stack — padding is always
-        exactly masked; hook kept for driver-surface parity."""
-
     def _notify_fit_start(self) -> None:
         for ls in self.listeners:
             hook = getattr(ls, "on_fit_start", None)

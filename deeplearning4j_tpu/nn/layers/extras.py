@@ -3,10 +3,13 @@ counterpart — capability extensions kept in the same Layer SPI)."""
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from deeplearning4j_tpu.nn.conf.configuration import LayerKind
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
@@ -14,6 +17,37 @@ from deeplearning4j_tpu.nn import params as P
 
 Array = jax.Array
 Params = Dict[str, Array]
+
+#: trace-time context the data-parallel step builder installs around its
+#: training forward (thread-local: tracing runs on the caller's thread)
+_BN_CTX = threading.local()
+
+
+@contextlib.contextmanager
+def bn_collective(axis_name, mask):
+    """Cross-replica BatchNorm context (ROADMAP item 5, second half).
+
+    Installed by ``nn/multilayer._build_dp_machinery`` around the
+    TRAINING forward it traces: every :class:`BatchNormLayer` inside
+    then normalizes with MASKED GLOBAL batch moments — per-example
+    sums weighted by the validity ``mask`` (zero-padded tail rows
+    contribute nothing, the PR 5 masked-sum formulation applied to the
+    normalization statistics themselves), psum'd over ``axis_name``
+    when the step runs under a mesh so every data shard normalizes
+    with the SAME full-batch moments instead of per-shard ghost-batch
+    statistics.  This is what made ``_check_bn_padding``'s refusal and
+    the BN auto-mesh gate unnecessary: padding and sharding are both
+    exact now, not approximations.
+
+    Trace-time only — the context manager wraps the TRACING of the
+    step function; the decision is baked into the compiled program, so
+    there is nothing to look up at dispatch time."""
+    prev = getattr(_BN_CTX, "ctx", None)
+    _BN_CTX.ctx = (axis_name, mask)
+    try:
+        yield
+    finally:
+        _BN_CTX.ctx = prev
 
 
 @register_layer(LayerKind.EMBEDDING)
@@ -44,6 +78,38 @@ class BatchNormLayer(Layer):
         }
 
     def activate(self, params, x, key=None, train=False):
+        ctx = getattr(_BN_CTX, "ctx", None) if train else None
+        if train and ctx is not None:
+            # cross-replica path (``bn_collective``): masked sums over
+            # the local shard, psum'd to FULL-batch moments — every
+            # replica normalizes identically and padded rows are
+            # exactly excluded.  var as E[x^2]-E[x]^2 so one reduction
+            # pass (plus one psum) covers both moments; the sums run in
+            # fp32 REGARDLESS of x's dtype — under bf16 mixed precision
+            # the difference-of-squares form cancels catastrophically
+            # (var collapses to 0 or 0.5 for mean>>std activations) if
+            # accumulated at input precision.
+            axis, mask = ctx
+            red = tuple(range(x.ndim - 1))
+            m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mf = m.astype(jnp.float32)
+            spatial = 1.0
+            for s in x.shape[1:-1]:
+                spatial *= float(s)
+            s1 = jnp.sum(xf * mf, axis=red)
+            s2 = jnp.sum(jnp.square(xf) * mf, axis=red)
+            cnt = jnp.sum(mask).astype(jnp.float32) * spatial
+            if axis is not None:
+                s1, s2, cnt = lax.psum((s1, s2, cnt), axis)
+            cnt = jnp.maximum(cnt, 1.0)
+            mean = s1 / cnt
+            var = jnp.maximum(s2 / cnt - jnp.square(mean), 0.0)
+            # normalize in fp32, return at the compute dtype so the
+            # surrounding (possibly bf16) forward keeps its precision
+            # policy
+            xn = ((xf - mean) * lax.rsqrt(var + 1e-5)).astype(x.dtype)
+            return xn * params["scale"] + params["bias"]
         if train:
             mean = jnp.mean(x, axis=tuple(range(x.ndim - 1)))
             var = jnp.var(x, axis=tuple(range(x.ndim - 1)))

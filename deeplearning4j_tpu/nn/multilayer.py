@@ -639,6 +639,7 @@ class MultiLayerNetwork:
         the bundle's ``init_ustate`` builds the combined structure."""
         from jax.sharding import PartitionSpec as P
 
+        from deeplearning4j_tpu.nn.layers.extras import bn_collective
         from deeplearning4j_tpu.parallel import sharded_fit
         from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
 
@@ -661,13 +662,21 @@ class MultiLayerNetwork:
             (the unit both the accumulation scan and the shard psum
             combine linearly).  Under mixed precision the fp32 masters
             are cast to bf16 HERE — inside the differentiated function —
-            so the backward re-casts gradients to fp32."""
+            so the backward re-casts gradients to fp32.
+
+            The forward traces under ``bn_collective``: every BatchNorm
+            layer normalizes with masked GLOBAL moments (psum over the
+            data axis under a mesh) instead of per-shard/pad-
+            contaminated batch statistics — cross-replica BN, the
+            second half of ROADMAP item 5."""
             n = len(net.layers)
             if mp_on:
                 params = sharded_fit.mp_cast(params)
                 if jnp.issubdtype(x.dtype, jnp.floating):
                     x = x.astype(jnp.bfloat16)
-            acts = net.feed_forward(params, x, key, train=True, upto=n - 1)
+            with bn_collective(axis, mask):
+                acts = net.feed_forward(params, x, key, train=True,
+                                        upto=n - 1)
             h = acts[-1]
             last = n - 1
             if last in net._in_pre:
@@ -823,18 +832,20 @@ class MultiLayerNetwork:
         """The sharded-by-default policy.  ``mesh="auto"`` (the fit
         default) picks the all-device ``data`` mesh when it can shard
         SAFELY: >1 device and every batch holds at least one row per
-        shard.  Dropout/DropConnect confs NOW auto-shard (ROADMAP item
-        5, first half): the DP step folds the shard index into the
+        shard.  Dropout/DropConnect confs auto-shard (ROADMAP item 5,
+        first half): the DP step folds the shard index into the
         per-step RNG key, so each data replica draws an INDEPENDENT
         mask over its own rows — the sampled-mask distribution over the
         global batch is unchanged, but the concrete masks differ from a
         single-device run of the same seed (MIGRATION.md documents the
-        semantics change).  Only BatchNorm still gates: its in-batch
-        normalization statistics would silently become per-shard
-        (ghost-batch) statistics, which stays an explicit-mesh decision
-        until the cross-replica-moments half of item 5 lands.  Pass an
-        explicit ``make_mesh(...)`` to shard BN anyway, or ``mesh=None``
-        to force single-device."""
+        semantics change).  BatchNorm confs auto-shard too (item 5,
+        second half): the DP forward normalizes with masked GLOBAL
+        moments psum'd in-graph (``nn/layers/extras.bn_collective``),
+        so sharding does not turn batch statistics into per-shard
+        ghost-batch statistics and padded rows are exactly excluded —
+        the old BN gate (and ``_check_bn_padding``'s refusal) became
+        unnecessary, and the vision zoo (lenet, resnet) now takes the
+        default sharded path."""
         from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS,
                                                       auto_data_mesh)
 
@@ -851,8 +862,6 @@ class MultiLayerNetwork:
         m = auto_data_mesh()
         if m is None or min_batch < m.shape[DATA_AXIS]:
             return None
-        if any(c.kind is LayerKind.BATCH_NORM for c in self.conf.confs):
-            return None
         return m
 
     @staticmethod
@@ -866,22 +875,6 @@ class MultiLayerNetwork:
     def _pad_rows(arr: Array, target: int) -> Array:
         from deeplearning4j_tpu.parallel.mesh import pad_rows
         return pad_rows(arr, target)
-
-    def _check_bn_padding(self, needs_pad: bool) -> None:
-        """Zero-padded rows are exactly masked out of loss, gradients,
-        and the BN EMA refresh — but the training forward inside a
-        BatchNormLayer normalizes with the CURRENT batch's statistics,
-        which the mask cannot reach.  Rather than silently training a
-        BN net on pad-contaminated statistics, refuse the combination
-        (auto-detection never routes BN confs here; this guards the
-        explicit-mesh and grad_accum paths)."""
-        if needs_pad and any(c.kind is LayerKind.BATCH_NORM
-                             for c in self.conf.confs):
-            raise ValueError(
-                "batch size does not divide by data_degree x grad_accum "
-                "and the conf contains BatchNorm: padded rows would "
-                "contaminate BN's in-batch normalization statistics — "
-                "use divisible batch sizes (or mesh=None, grad_accum=1)")
 
     def fit_backprop(self, data: Union[DataSet, Sequence[DataSet]],
                      num_epochs: int = 1, seed: int = 2,
@@ -1006,7 +999,6 @@ class MultiLayerNetwork:
         chunk = self._pad_chunk(rmesh, accum)
         sizes = [b.features.shape[0] for b in batches]
         pad_to = [-(-s // chunk) * chunk for s in sizes]
-        self._check_bn_padding(any(s != p for s, p in zip(sizes, pad_to)))
 
         def _nbytes(a):
             return math.prod(a.shape) * jnp.dtype(a.dtype).itemsize
@@ -1223,7 +1215,6 @@ class MultiLayerNetwork:
                             if n_valid is None:
                                 n_valid = batch.features.shape[0]
                             target = -(-int(n_valid) // chunk) * chunk
-                            self._check_bn_padding(target != int(n_valid))
                             dp_batch = (
                                 self._pad_rows(batch.features, target),
                                 self._pad_rows(batch.labels, target),

@@ -63,6 +63,57 @@ class DeviceLossChaos:
                 f"{sorted(self.lost_ids)}")
 
 
+class HostLossChaos:
+    """Step-boundary HOST-loss injector for ``ResilientFit``'s
+    ``fault_hook``: raises :class:`DeviceLossError` for EVERY device of
+    one host, exactly once.  The host's devices come from the real
+    process topology when the fleet spans processes
+    (``device.process_index == host_index``), else from partitioning
+    the device list into ``n_hosts`` contiguous blocks — the
+    virtual-host proxy that lets a single 8-device CPU process drill
+    the "lost a whole host" recovery path (2 hosts x 4 devices).
+
+    In a multi-member drill every member installs the SAME injector
+    arguments, so all members raise at the same boundary and the
+    cluster's lost-id agreement sees one consistent finding — the
+    signal-free stand-in for a real host death (which the heartbeat
+    detector covers instead)."""
+
+    def __init__(self, at_step: int, host_index: int,
+                 n_hosts: Optional[int] = None, devices=None):
+        import jax
+
+        self.at_step = at_step
+        self.host_index = host_index
+        self.fired = False
+        devices = list(devices if devices is not None else jax.devices())
+        by_proc = {d.process_index for d in devices}
+        if len(by_proc) > 1:
+            self.lost_ids = tuple(
+                int(d.id) for d in devices
+                if d.process_index == host_index)
+        else:
+            n_hosts = n_hosts or max(len(by_proc), 2)
+            per = len(devices) // n_hosts
+            if per < 1:
+                raise ValueError(
+                    f"{len(devices)} device(s) cannot form {n_hosts} "
+                    "virtual hosts")
+            block = devices[host_index * per:(host_index + 1) * per]
+            self.lost_ids = tuple(int(d.id) for d in block)
+        if not self.lost_ids:
+            raise ValueError(
+                f"host {host_index} owns no devices in this fleet")
+
+    def __call__(self, step: int) -> None:
+        if not self.fired and step >= self.at_step:
+            self.fired = True
+            raise DeviceLossError(
+                self.lost_ids,
+                f"injected loss of host {self.host_index} at step "
+                f"{step}: device ids {sorted(self.lost_ids)}")
+
+
 class PreemptionChaos:
     """Step-boundary preemption drill for ``ResilientFit``'s
     ``fault_hook``: flags the driver's PreemptionGuard at ``at_step`` —

@@ -114,24 +114,17 @@ def initialize_from_env() -> bool:
     ``DL4J_TPU_NUM_PROCESSES``, ``DL4J_TPU_PROCESS_ID`` — the MASTER_URL
     role of the reference's worker env (DeepLearning4jDistributed).
     Returns False (no-op) when no wiring is present; on real TPU pods
-    the launch may instead rely on jax's own pod auto-detection."""
-    import os
+    the launch may instead rely on jax's own pod auto-detection.
 
-    coord = os.environ.get("DL4J_TPU_COORDINATOR")
-    if not coord:
-        return False
-    missing = [k for k in ("DL4J_TPU_NUM_PROCESSES", "DL4J_TPU_PROCESS_ID")
-               if k not in os.environ]
-    if missing:
-        raise ValueError(
-            f"DL4J_TPU_COORDINATOR is set but {missing} missing — the "
-            f"wiring trio (DL4J_TPU_COORDINATOR, DL4J_TPU_NUM_PROCESSES, "
-            f"DL4J_TPU_PROCESS_ID) must be exported together")
-    initialize_distributed(
-        coord,
-        int(os.environ["DL4J_TPU_NUM_PROCESSES"]),
-        int(os.environ["DL4J_TPU_PROCESS_ID"]))
-    return True
+    Thin delegate: ``parallel/multihost.py`` owns the ONE
+    implementation of the env/flag contract (``resolve_cluster_config``
+    merges the trio with the ``cli.py train`` launcher flags, flags >
+    env; ``multihost.initialize`` adds bounded join retry/backoff with
+    typed timeout errors on top of the plain ``initialize_distributed``
+    wrapper above)."""
+    from deeplearning4j_tpu.parallel import multihost
+
+    return multihost.initialize_from_env()
 
 
 def local_batch_size(global_batch: int, mesh: Mesh, *,

@@ -1,0 +1,732 @@
+"""Multi-host (multi-process) training runtime: launcher + control plane.
+
+ROADMAP item 2: the production spine (``parallel/sharded_fit.py``,
+``ResilientFit``, ``AsyncCheckpointer``, ``PreemptionGuard``,
+``elastic_remesh``) was strictly single-process — the bench already
+measured a 2-process DCN grad-psum, but nothing a user runs could span
+hosts.  This module is the host-level half of that story, the
+fault-tolerance + scale design of TensorFlow (arXiv 1605.08695) applied
+at the process level and the operational regime Gemma-class pod training
+assumes (arXiv 2605.25645):
+
+- **Launcher**: :func:`resolve_cluster_config` (ONE source of truth for
+  the ``--coordinator/--num-processes/--process-id`` CLI flags and the
+  ``DL4J_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID`` env trio that
+  ``cloud/provision.py`` launch scripts export — flags win over env) and
+  :func:`initialize` (``jax.distributed.initialize`` under a bounded
+  join retry/backoff loop with TYPED timeout errors, because a pod
+  bring-up where one host races ahead of the coordinator is the normal
+  case, not the exception).
+
+- **Control plane**: :class:`Cluster` — barriers, cluster-wide flag OR,
+  and lost-member agreement built on the jax.distributed coordination
+  service's KEY-VALUE store (host-side gRPC), NOT on device
+  collectives.  Device collectives need every member's devices healthy
+  and hang when a host dies; the KV store keeps working for the
+  survivors, times out with a typed :class:`ClusterSyncTimeout` when a
+  peer goes silent, and — unlike ``multihost_utils
+  .sync_global_devices`` — is safe to call from the async checkpoint
+  writer thread without interleaving with training collectives.  An
+  in-process backend (:class:`InProcessKV`) lets tests and the CI gate
+  run REAL multi-member protocol drills inside one process.
+
+- **Failure detection**: :class:`HostHeartbeat` — per-process heartbeat
+  files on the shared filesystem the checkpoint dir already requires;
+  a member whose heartbeat goes stale (SIGKILL, kernel panic, fabric
+  partition) is translated into a cross-host
+  ``runtime.resilience.DeviceLossError`` naming its devices, which
+  drives the coordinated ``elastic_remesh`` + restore-from-committed
+  recovery in ``ResilientFit``.
+
+- **Data/mesh plumbing**: :func:`global_data_mesh` (data axis spanning
+  hosts over DCN per ``parallel/mesh.py``'s layout contract — model
+  groups stay inside a host's ICI domain), per-process worker splits of
+  a ``StoreDataSetIterator`` stream, and :func:`stage_global_batch`
+  (each process contributes only ITS shard's rows of a global batch via
+  ``jax.make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+
+from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.runtime.metrics import multihost_metrics
+
+log = logging.getLogger(__name__)
+
+# -- cluster wiring: ONE source of truth ------------------------------------
+# The env trio the cloud/provision.py launch scripts export on every pod
+# host, and the cli.py launcher flags that override it (flags > env).
+# Everything that consumes or documents the wiring (parallel/mesh
+# .initialize_from_env, cloud/provision.py, cli.py train) references
+# THESE names — a renamed variable cannot silently fork the contract.
+ENV_COORDINATOR = "DL4J_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "DL4J_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "DL4J_TPU_PROCESS_ID"
+ENV_TRIO = (ENV_COORDINATOR, ENV_NUM_PROCESSES, ENV_PROCESS_ID)
+FLAG_COORDINATOR = "--coordinator"
+FLAG_NUM_PROCESSES = "--num-processes"
+FLAG_PROCESS_ID = "--process-id"
+FLAG_TRIO = (FLAG_COORDINATOR, FLAG_NUM_PROCESSES, FLAG_PROCESS_ID)
+
+
+class ClusterJoinError(RuntimeError):
+    """``jax.distributed.initialize`` failed for a non-timeout reason
+    (bad address, version skew, duplicate process id) after the bounded
+    retry budget."""
+
+
+class ClusterJoinTimeout(ClusterJoinError):
+    """The cluster never formed within the join deadline — some host
+    did not show up.  Typed separately because the launcher's correct
+    reaction differs: a timeout usually means re-run the launch (a
+    peer is still booting), other join errors mean fix the wiring."""
+
+
+class ClusterSyncTimeout(RuntimeError):
+    """A LIVE cluster's control-plane operation (barrier, flag sync,
+    agreement) timed out — a peer has stopped participating.  The
+    training driver translates this into a host-loss event via
+    :class:`HostHeartbeat` staleness (``ResilientFit``'s elastic path)
+    rather than treating it as a crash."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Resolved multi-process wiring (the reference's MASTER_URL role,
+    DeepLearning4jDistributed.setup:301-315)."""
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError(
+                f"num_processes must be >= 1, got {self.num_processes}")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id {self.process_id} not in "
+                f"[0, {self.num_processes})")
+
+
+def resolve_cluster_config(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None,
+                           env: Optional[Dict[str, str]] = None
+                           ) -> Optional[ClusterConfig]:
+    """Merge launcher flags with the ``DL4J_TPU_*`` env trio — flags
+    win PER FIELD (a launch script may export the trio while an
+    operator overrides just ``--process-id`` on one host).  Returns
+    None when nothing is wired (single-process run); raises ValueError
+    naming BOTH spellings when the wiring is partial — a partial trio
+    is always a launch-script bug and the error must be actionable
+    from either side (env or flags)."""
+    env = os.environ if env is None else env
+
+    def pick(flag_val, env_key, cast):
+        if flag_val is not None:
+            return cast(flag_val)
+        raw = env.get(env_key)
+        return cast(raw) if raw not in (None, "") else None
+
+    coord = pick(coordinator, ENV_COORDINATOR, str)
+    nproc = pick(num_processes, ENV_NUM_PROCESSES, int)
+    pid = pick(process_id, ENV_PROCESS_ID, int)
+    values = {"coordinator": coord, "num_processes": nproc,
+              "process_id": pid}
+    missing = [k for k, v in values.items() if v is None]
+    if len(missing) == 3:
+        return None
+    if missing:
+        raise ValueError(
+            f"partial cluster wiring: {sorted(set(values) - set(missing))} "
+            f"set but {missing} missing — the trio must be provided "
+            f"together, either as launcher flags "
+            f"({', '.join(FLAG_TRIO)}) or as environment variables "
+            f"({', '.join(ENV_TRIO)}); flags override env per field")
+    return ClusterConfig(coord, nproc, pid)
+
+
+def initialize(config: ClusterConfig, *, attempts: int = 3,
+               backoff_s: float = 2.0,
+               timeout_s: float = 300.0) -> "Cluster":
+    """``jax.distributed.initialize`` with a bounded join retry loop.
+
+    Pod bring-up is racy by nature: hosts boot at different speeds, the
+    coordinator's port may not be listening yet, a preempted VM may
+    rejoin late.  Each attempt gets ``timeout_s`` (jax's own
+    ``initialization_timeout``); failures back off exponentially from
+    ``backoff_s``.  Exhausting the budget raises
+    :class:`ClusterJoinTimeout` when the last failure was a deadline,
+    else :class:`ClusterJoinError` — both carrying the attempt count
+    and the coordinator address, so the launcher log is actionable.
+    A single-process config skips ``jax.distributed`` entirely."""
+    if config.num_processes == 1:
+        return local_cluster()
+    last: Optional[BaseException] = None
+    for attempt in range(1, max(attempts, 1) + 1):
+        try:
+            with telemetry.span("multihost.join", attempt=attempt,
+                                coordinator=config.coordinator,
+                                process_id=config.process_id):
+                jax.distributed.initialize(
+                    coordinator_address=config.coordinator,
+                    num_processes=config.num_processes,
+                    process_id=config.process_id,
+                    initialization_timeout=int(timeout_s))
+            multihost_metrics.note("joins")
+            log.info("joined %d-process cluster at %s as process %d "
+                     "(attempt %d)", config.num_processes,
+                     config.coordinator, config.process_id, attempt)
+            return active_cluster()
+        except Exception as e:  # noqa: BLE001 — backend raises several types
+            last = e
+            # a failed initialize leaves jax's distributed State half
+            # set (the client object is assigned BEFORE connect(), so a
+            # connect timeout would make every retry fail instantly
+            # with "should only be called once") — tear it down so the
+            # next attempt starts clean; if shutdown() itself refuses
+            # (an unconnected client), null the fields directly
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — nothing to tear down
+                try:
+                    from jax._src import distributed as _dist
+                    _dist.global_state.client = None
+                    _dist.global_state.service = None
+                except Exception:  # noqa: BLE001 — private-API drift
+                    pass
+            if attempt <= max(attempts, 1) - 1:
+                delay = backoff_s * (2 ** (attempt - 1))
+                multihost_metrics.note("join_retries")
+                log.warning(
+                    "cluster join attempt %d/%d failed (%s: %s); "
+                    "retrying in %.1fs", attempt, attempts,
+                    type(e).__name__, e, delay)
+                time.sleep(delay)
+    multihost_metrics.note("join_failures")
+    msg = (f"could not join {config.num_processes}-process cluster at "
+           f"{config.coordinator} as process {config.process_id} after "
+           f"{attempts} attempt(s): {type(last).__name__}: {last}")
+    if "deadline" in str(last).lower() or "timeout" in str(last).lower() \
+            or isinstance(last, TimeoutError):
+        raise ClusterJoinTimeout(msg) from last
+    raise ClusterJoinError(msg) from last
+
+
+def initialize_from_env(env: Optional[Dict[str, str]] = None,
+                        **retry) -> bool:
+    """Join from the ``DL4J_TPU_*`` env trio when present (the
+    provision-script path); no-op returning False when nothing is
+    wired.  ``parallel.mesh.initialize_from_env`` delegates here so the
+    env contract has exactly one implementation."""
+    config = resolve_cluster_config(env=env)
+    if config is None:
+        return False
+    initialize(config, **retry)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# KV backends — the substrate every cross-host protocol rides
+# ---------------------------------------------------------------------------
+
+class InProcessKV:
+    """In-memory KV store with blocking gets: the SAME protocol surface
+    as the jax.distributed coordination service, shareable between
+    threads of one process.  This is what makes the cluster-commit,
+    preemption-propagation, and eviction protocols testable tier-1:
+    N thread-"hosts" share one InProcessKV and run the real
+    :class:`Cluster` code paths, byte for byte."""
+
+    def __init__(self):
+        self._data: Dict[str, str] = {}
+        self._cond = threading.Condition()
+
+    def put(self, key: str, value: str) -> None:
+        with self._cond:
+            self._data[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout_s: float) -> str:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while key not in self._data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ClusterSyncTimeout(
+                        f"key {key!r} not published within {timeout_s}s")
+                self._cond.wait(remaining)
+            return self._data[key]
+
+    def delete(self, key: str) -> None:
+        with self._cond:
+            self._data.pop(key, None)
+
+
+class DistributedKV:
+    """The real backend: the jax.distributed coordination service's
+    key-value store (``blocking_key_value_get`` blocks SERVER-side until
+    a peer publishes — no polling traffic).  Timeouts surface as
+    :class:`ClusterSyncTimeout` so callers never have to pattern-match
+    backend exception strings."""
+
+    def __init__(self, client=None):
+        if client is None:
+            from jax._src import distributed
+            client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized — call "
+                "multihost.initialize (or initialize_from_env) first")
+        self._client = client
+
+    def put(self, key: str, value: str) -> None:
+        self._client.key_value_set(key, value)
+
+    def get(self, key: str, timeout_s: float) -> str:
+        try:
+            return self._client.blocking_key_value_get(
+                key, int(timeout_s * 1000))
+        except Exception as e:  # noqa: BLE001 — XlaRuntimeError and kin
+            raise ClusterSyncTimeout(
+                f"key {key!r} not published within {timeout_s}s "
+                f"({type(e).__name__}: {e})") from e
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(key)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Cluster — membership + host-side coordination primitives
+# ---------------------------------------------------------------------------
+
+class Cluster:
+    """Handle for THIS process's view of the training cluster.
+
+    Built on the KV store only: every primitive works for an arbitrary
+    SUBSET of the original processes, which is what host-loss recovery
+    needs — after an eviction the survivors :meth:`shrink` to a new
+    generation whose barriers/flags involve only them, while a device-
+    collective barrier would wait on the dead host forever.
+
+    Protocol discipline: every member must make the SAME sequence of
+    cluster calls (the host program is SPMD too).  Rounds are numbered
+    by a per-handle counter so repeated barriers/flags never collide,
+    and the generation id namespaces a shrunk cluster away from its
+    ancestor's keys."""
+
+    def __init__(self, process_id: int, members: Sequence[int], kv,
+                 *, timeout_s: float = 120.0, generation: int = 0,
+                 namespace: str = "dl4j",
+                 device_map: Optional[Dict[int, Tuple[int, ...]]] = None):
+        self.process_id = int(process_id)
+        self.members: Tuple[int, ...] = tuple(sorted(set(members)))
+        if self.process_id not in self.members:
+            raise ValueError(
+                f"process {process_id} is not a member of {self.members}")
+        self.kv = kv
+        self.timeout_s = timeout_s
+        self.generation = generation
+        self._namespace = namespace
+        #: per-TAG round counters: rounds must line up across members
+        #: per call SITE, and different sites run on different threads
+        #: (the step loop's flag sync vs the async writer's commit
+        #/ barriers) whose interleaving is not deterministic — a single
+        #: shared counter would hand the same round number to different
+        #: tags on different members.  Each tag's own sequence is
+        #: deterministic because every member makes the same sequence
+        #: of calls per site.
+        self._rounds: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        #: member -> global device ids.  None = read the real process
+        #: topology off jax.devices(); an explicit map is the
+        #: simulated-cluster hook (thread-"hosts" over one process's
+        #: virtual devices — the tier-1 drill substrate).
+        self.device_map = (None if device_map is None else
+                           {int(m): tuple(int(i) for i in ids)
+                            for m, ids in device_map.items()})
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def process_count(self) -> int:
+        return len(self.members)
+
+    @property
+    def coordinator(self) -> int:
+        """Lowest surviving member id — deterministic, so a shrink
+        re-elects without a message."""
+        return self.members[0]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == self.coordinator
+
+    @property
+    def member_rank(self) -> int:
+        """This process's dense rank among the CURRENT members (the
+        worker-split index — stays dense after evictions)."""
+        return self.members.index(self.process_id)
+
+    def _next_round(self, tag: str) -> int:
+        with self._lock:
+            self._rounds[tag] = self._rounds.get(tag, 0) + 1
+            return self._rounds[tag]
+
+    def _key(self, tag: str, rnd: int, pid: int) -> str:
+        return (f"{self._namespace}/g{self.generation}/{tag}/{rnd}/"
+                f"p{pid}")
+
+    def _publish(self, tag: str, rnd: int, value: str) -> None:
+        """Put this member's round key — and garbage-collect its own
+        key from round ``rnd - 2`` of the same tag.  The two-round lag
+        makes the delete safe: a member can only START round ``rnd``
+        after putting ``rnd - 1``, which requires having fully
+        COMPLETED ``rnd - 2`` — so by the time anyone deletes an
+        ``rnd - 2`` key, every member has finished reading it.
+        (Deleting the just-read ``rnd - 1`` keys would race a slower
+        peer still inside that round.)  Without this, the per-step
+        preemption flag sync would grow the coordination service's KV
+        state by members x steps over a long run."""
+        if rnd > 2:
+            self.kv.delete(self._key(tag, rnd - 2, self.process_id))
+        self.kv.put(self._key(tag, rnd, self.process_id), value)
+
+    # -- primitives --------------------------------------------------------
+    def barrier(self, tag: str,
+                timeout_s: Optional[float] = None) -> None:
+        """Host-side rendezvous of every CURRENT member.  Raises
+        :class:`ClusterSyncTimeout` when a member fails to show within
+        the deadline — the caller's cue to consult the heartbeat."""
+        if self.process_count == 1:
+            return
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        rnd = self._next_round(tag)
+        t0 = time.perf_counter()
+        self._publish(tag, rnd, "1")
+        for m in self.members:
+            if m != self.process_id:
+                self.kv.get(self._key(tag, rnd, m), timeout)
+        multihost_metrics.note("barriers")
+        multihost_metrics.note_wait((time.perf_counter() - t0) * 1e3)
+
+    def any_flag(self, flag: bool, tag: str = "flag",
+                 timeout_s: Optional[float] = None) -> bool:
+        """Cluster-wide OR of a per-member boolean — the preemption
+        propagation primitive: one host's SIGTERM flag becomes every
+        host's stop verdict in the SAME round, so all members drain at
+        the same step boundary."""
+        if self.process_count == 1:
+            return bool(flag)
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        rnd = self._next_round(tag)
+        self._publish(tag, rnd, "1" if flag else "0")
+        result = bool(flag)
+        for m in self.members:
+            if m != self.process_id:
+                result = (self.kv.get(self._key(tag, rnd, m), timeout)
+                          == "1") or result
+        multihost_metrics.note("flag_syncs")
+        return result
+
+    def agree_lost_ids(self, local_ids: Iterable[int],
+                       suspects: Iterable[int] = (),
+                       timeout_s: Optional[float] = None
+                       ) -> Tuple[int, ...]:
+        """Union of every RESPONSIVE member's lost-device view.
+        ``suspects`` (members already believed dead, e.g. from
+        heartbeat staleness) are not waited on — their silence is the
+        finding, not a protocol failure."""
+        mine = sorted(set(int(i) for i in local_ids))
+        if self.process_count == 1:
+            return tuple(mine)
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        suspects = set(int(s) for s in suspects)
+        rnd = self._next_round("lost")
+        self._publish("lost", rnd, json.dumps(mine))
+        agreed = set(mine)
+        for m in self.members:
+            if m == self.process_id or m in suspects:
+                continue
+            agreed.update(json.loads(
+                self.kv.get(self._key("lost", rnd, m), timeout)))
+        return tuple(sorted(agreed))
+
+    def gather(self, value: str, tag: str,
+               timeout_s: Optional[float] = None
+               ) -> Optional[Dict[int, str]]:
+        """Every member publishes a blob; the COORDINATOR returns the
+        full ``{member: blob}`` map, everyone else None.  The shard-crc
+        collection step of the cluster-commit protocol."""
+        if self.process_count == 1:
+            return {self.process_id: value}
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        rnd = self._next_round(tag)
+        self._publish(tag, rnd, value)
+        if not self.is_coordinator:
+            return None
+        out = {self.process_id: value}
+        for m in self.members:
+            if m != self.process_id:
+                out[m] = self.kv.get(self._key(tag, rnd, m), timeout)
+        return out
+
+    # -- device topology ---------------------------------------------------
+    def devices_of(self, member: int) -> Tuple[int, ...]:
+        """Global device ids a member owns (explicit ``device_map`` for
+        simulated clusters, else the real jax process topology)."""
+        if self.device_map is not None:
+            return self.device_map.get(int(member), ())
+        return process_device_ids(int(member))
+
+    def owners_of(self, device_ids: Iterable[int]) -> Tuple[int, ...]:
+        """Members owning any of ``device_ids`` (unknown ids — e.g. a
+        virtual-host chaos drill outside the map — own nothing)."""
+        wanted = set(int(i) for i in device_ids)
+        return tuple(sorted(
+            m for m in self.members
+            if wanted & set(self.devices_of(m))))
+
+    def shrink(self, lost_members: Iterable[int]) -> "Cluster":
+        """The surviving cluster after a host loss: same KV store, a
+        NEW generation (fresh key namespace + round counter), members
+        minus the lost.  The caller must be a survivor."""
+        lost = set(int(m) for m in lost_members)
+        survivors = [m for m in self.members if m not in lost]
+        if self.process_id in lost:
+            raise ValueError(
+                f"process {self.process_id} is itself among the lost "
+                f"members {sorted(lost)} — an evicted process exits, "
+                "it does not shrink")
+        if not survivors:
+            raise ValueError("no surviving members")
+        return Cluster(self.process_id, survivors, self.kv,
+                       timeout_s=self.timeout_s,
+                       generation=self.generation + 1,
+                       namespace=self._namespace,
+                       device_map=self.device_map)
+
+
+def local_cluster() -> Cluster:
+    """The degenerate single-process cluster: every primitive is a
+    no-op, so single-host code paths stay byte-for-byte unchanged."""
+    return Cluster(0, (0,), InProcessKV())
+
+
+def active_cluster(timeout_s: float = 120.0) -> Cluster:
+    """The cluster this process is actually in: jax.distributed wiring
+    when initialized (KV store = the coordination service), else the
+    local single-member cluster."""
+    if jax.process_count() <= 1:
+        return local_cluster()
+    return Cluster(jax.process_index(), range(jax.process_count()),
+                   DistributedKV(), timeout_s=timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# device <-> process mapping
+# ---------------------------------------------------------------------------
+
+def process_device_ids(process_index: int) -> Tuple[int, ...]:
+    """Global device ids owned by ``process_index`` — what a host
+    LOSS means in device terms (the unit ``elastic_remesh`` consumes)."""
+    return tuple(int(d.id) for d in jax.devices()
+                 if d.process_index == process_index)
+
+
+def global_data_mesh(model: int = 1,
+                     devices: Optional[Sequence[jax.Device]] = None):
+    """The multi-host training mesh: EVERY process's devices on one
+    global ``data``(×``model``) mesh.  ``parallel.mesh.make_mesh``'s
+    data-first layout puts each host's contiguous device block in the
+    same data region, so ``model`` groups stay inside a host (ICI) and
+    only the data-axis gradient reduction crosses hosts (DCN) — the
+    layout contract the module docstring of ``parallel/mesh.py``
+    promises."""
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data=-1, model=model), devices=devices)
+
+
+# ---------------------------------------------------------------------------
+# per-process data shards
+# ---------------------------------------------------------------------------
+
+def worker_store_iterator(store, prefix: str, cluster: Cluster,
+                          **kwargs):
+    """Each process's OWN shard of a serialized-minibatch stream: a
+    ``StoreDataSetIterator`` worker split keyed by the cluster's dense
+    member rank (BucketIterator's role in the reference's multi-worker
+    S3 reads).  After an eviction, re-calling with the SHRUNK cluster
+    re-splits the stream over the survivors.
+
+    Data contract: a worker split feeds PER-HOST pipelines (streaming
+    ``fit_iterator`` on a host-local mesh, per-host preprocessing).
+    It is NOT the input to ``ResilientFit`` on a mesh that SPANS
+    hosts — that path requires every process to pass the IDENTICAL
+    global batch list (``stage_global_batch`` then slices each
+    process's own rows out of it); feeding disjoint shards there would
+    silently train on a rank-slice of a shard and desynchronize the
+    members' step counts."""
+    from deeplearning4j_tpu.datasets.store_iterator import \
+        StoreDataSetIterator
+
+    return StoreDataSetIterator(store, prefix,
+                                shard_index=cluster.member_rank,
+                                num_shards=cluster.process_count,
+                                **kwargs)
+
+
+def local_rows(arr, cluster: Cluster):
+    """This process's contiguous row slice of a GLOBAL batch (rows
+    assumed divisible by member count — the padding contract upstream
+    guarantees it)."""
+    n = cluster.process_count
+    if n == 1:
+        return arr
+    per = arr.shape[0] // n
+    r = cluster.member_rank
+    return arr[r * per:(r + 1) * per]
+
+
+def stage_global_batch(x, y, mesh, cluster: Optional[Cluster] = None):
+    """Stage one padded global batch onto a (possibly multi-host) mesh
+    with the example axis over ``data``.  Single-process: a plain
+    sharded ``device_put`` (byte-identical to the existing staging).
+    Multi-process: each process contributes only ITS row slice via
+    ``jax.make_array_from_process_local_data`` — no host ever holds or
+    sends rows that land on another host's devices.
+
+    Contract: every process must pass the SAME logical global ``x``/
+    ``y`` (same values, same row order, rows divisible by the member
+    count) — this function slices rank-local rows out of it, it does
+    not gather disjoint per-host shards into a global batch."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.sharded_fit import batch_sharding
+
+    sharding = batch_sharding(mesh)
+    if cluster is None or cluster.process_count == 1:
+        return (jax.device_put(jnp.asarray(x), sharding),
+                jax.device_put(jnp.asarray(y), sharding))
+    import numpy as np
+
+    return (jax.make_array_from_process_local_data(
+                sharding, np.asarray(local_rows(x, cluster))),
+            jax.make_array_from_process_local_data(
+                sharding, np.asarray(local_rows(y, cluster))))
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-based host-loss detection
+# ---------------------------------------------------------------------------
+
+class HostHeartbeat:
+    """Shared-filesystem heartbeats: each member's background thread
+    touches ``<dir>/hb_p<pid>`` every ``interval_s``; a member whose
+    file goes ``timeout_s`` stale is presumed LOST (SIGKILLed VM,
+    kernel panic, fabric partition — failures that never get to say
+    goodbye).  The filesystem is the same one the checkpoint dir
+    already requires, so this adds no infrastructure — it is the
+    reference's Akka heartbeat reaper (MasterActor.java:139-169)
+    rebuilt on the storage layer.
+
+    ``stale_members()`` is the detector ``ResilientFit`` consults when
+    a control-plane op times out; ``lost_device_ids()`` translates the
+    finding into the device-id vocabulary ``elastic_remesh`` speaks."""
+
+    def __init__(self, directory: str, cluster: Cluster,
+                 interval_s: float = 2.0, timeout_s: float = 20.0):
+        self.directory = directory
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: grace anchor for MISSING files (reset when the monitor
+        #: starts): a peer whose first heartbeat hasn't landed yet
+        #: (slow start, NFS attribute-cache delay) must not read as
+        #: dead the instant a sync timeout sends us looking
+        self._t0 = time.time()
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, pid: int) -> str:
+        return os.path.join(self.directory, f"hb_p{pid}")
+
+    def _beat_once(self) -> None:
+        path = self._path(self.cluster.process_id)
+        with open(path + ".tmp", "w") as f:
+            f.write(str(time.time()))
+        os.replace(path + ".tmp", path)
+
+    def _runner(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._beat_once()
+            except OSError:
+                log.exception("heartbeat write failed")
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HostHeartbeat":
+        if self._thread is None:
+            self._t0 = time.time()
+            self._beat_once()          # visible before the first interval
+            self._thread = threading.Thread(
+                target=self._runner, name="host-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "HostHeartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    def stale_members(self) -> Tuple[int, ...]:
+        """Members (excluding self) whose heartbeat is older than
+        ``timeout_s`` — or missing entirely AFTER the grace of one
+        timeout from monitor start (a member that never wrote one is
+        as dead as one that stopped, but a peer whose FIRST beat just
+        hasn't landed yet must not be declared lost)."""
+        now = time.time()
+        stale = []
+        for m in self.cluster.members:
+            if m == self.cluster.process_id:
+                continue
+            try:
+                age = now - os.path.getmtime(self._path(m))
+            except OSError:
+                # missing file: age it from monitor start, not -inf
+                age = now - self._t0
+            if age > self.timeout_s:
+                stale.append(m)
+        if stale:
+            multihost_metrics.note("heartbeat_stale_events")
+        return tuple(stale)
+
+    def lost_device_ids(self) -> Tuple[int, ...]:
+        """Device ids owned by every currently-stale member."""
+        out = []
+        for m in self.stale_members():
+            out.extend(self.cluster.devices_of(m))
+        return tuple(sorted(out))

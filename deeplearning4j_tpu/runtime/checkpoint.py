@@ -28,7 +28,7 @@ import re
 import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -189,7 +189,13 @@ def load_pytree(path: str, like: Optional[PyTree] = None
 # -- multi-host sharded checkpoint (SURVEY §5.4's pod-scale upgrade) --------
 
 def save_pytree_sharded(path: str, tree: PyTree,
-                        meta: Optional[Dict] = None) -> None:
+                        meta: Optional[Dict] = None, *,
+                        sync: bool = True,
+                        process_index: Optional[int] = None,
+                        process_count: Optional[int] = None,
+                        writers: Optional[Sequence[int]] = None,
+                        write_index: Optional[bool] = None
+                        ) -> Dict[str, Dict]:
     """Per-PROCESS shard save: each process writes only the shards its
     own devices hold (``replica_id == 0`` dedups replicas), so no
     process ever gathers a full pod-sharded array to host memory — the
@@ -203,9 +209,26 @@ def save_pytree_sharded(path: str, tree: PyTree,
 
     Restore with ``load_pytree_sharded(path, like)`` where ``like``
     carries the TARGET shardings — the mesh layout may differ from the
-    one that saved (restore-with-resharding)."""
+    one that saved (restore-with-resharding).
+
+    Cluster-commit hooks (``CheckpointManager`` drives these; direct
+    callers keep the defaults): ``sync=False`` skips the trailing
+    ``sync_global_devices`` so the caller can barrier on the host-side
+    control plane instead (safe off the main thread — the async writer
+    path); ``process_index``/``writers``/``write_index`` let a SHRUNK
+    cluster (survivors after a host loss, whose coordinator need not be
+    process 0) name its shard files and index correctly.  Returns a
+    ``{filename: {"crc32", "bytes"}}`` table for the files THIS process
+    wrote — the coordinator merges every member's table into the
+    cluster manifest."""
     items = _flatten_with_paths(tree)
-    pid = jax.process_index()
+    pid = jax.process_index() if process_index is None else process_index
+    writers = (sorted(int(w) for w in writers) if writers is not None
+               else list(range(jax.process_count()
+                               if process_count is None
+                               else process_count)))
+    if write_index is None:
+        write_index = pid == writers[0]
     os.makedirs(path, exist_ok=True)
     pieces: Dict[str, np.ndarray] = {}
     table: Dict[str, Dict] = {}
@@ -222,21 +245,31 @@ def save_pytree_sharded(path: str, tree: PyTree,
                 pieces[key] = data
                 table[key] = {"leaf": i, "start": start,
                               "shape": list(data.shape)}
-        elif pid == 0:        # host-side leaf: one whole piece, proc 0
+        elif pid == writers[0]:   # host-side leaf: one piece, coordinator
             data = np.asarray(leaf)
             pieces[f"l{i}_s0"] = data
             table[f"l{i}_s0"] = {"leaf": i,
                                  "start": [0] * data.ndim,
                                  "shape": list(data.shape)}
-    shard_tmp = os.path.join(path, f"shards_p{pid}.npz.tmp")
-    with open(shard_tmp, "wb") as f:
-        np.savez(f, **pieces)
-    os.replace(shard_tmp, os.path.join(path, f"shards_p{pid}.npz"))
-    with open(os.path.join(path, f"shards_p{pid}.json.tmp"), "w") as f:
-        json.dump(table, f)
-    os.replace(os.path.join(path, f"shards_p{pid}.json.tmp"),
-               os.path.join(path, f"shards_p{pid}.json"))
-    if pid == 0:
+
+    files: Dict[str, Dict] = {}
+
+    def commit(write_fn, name: str) -> None:
+        # same tmp + fsync + replace + sequential crc re-read discipline
+        # as save_pytree: the crc table is the manifest input the
+        # cluster-commit protocol checksums against
+        dst = os.path.join(path, name)
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            write_fn(f)
+        crc, size = _crc32_file(tmp)
+        _replace_with_fsync(tmp, dst)
+        files[name] = {"crc32": crc, "bytes": size}
+
+    commit(lambda f: f.write(json.dumps(table).encode()),
+           f"shards_p{pid}.json")
+    commit(lambda f: np.savez(f, **pieces), f"shards_p{pid}.npz")
+    if write_index:
         index = {
             "format": 2,
             "paths": [p for p, _ in items],
@@ -244,16 +277,16 @@ def save_pytree_sharded(path: str, tree: PyTree,
             "dtypes": [str(leaf.dtype if hasattr(leaf, "dtype")
                            else np.asarray(leaf).dtype)
                        for _, leaf in items],
-            "n_procs": jax.process_count(),
+            "n_procs": len(writers),
+            "writers": writers,
             "meta": meta or {},
         }
-        with open(os.path.join(path, "index.json.tmp"), "w") as f:
-            json.dump(index, f, indent=1)
-        os.replace(os.path.join(path, "index.json.tmp"),
-                   os.path.join(path, "index.json"))
-    if jax.process_count() > 1:
+        commit(lambda f: f.write(json.dumps(index, indent=1).encode()),
+               "index.json")
+    if sync and jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_sharded_save")
+    return files
 
 
 def _assemble(target_index, shape, dtype, pieces):
@@ -287,17 +320,20 @@ def load_pytree_sharded(path: str, like: Optional[PyTree] = None
     dict (tools/debugging)."""
     with open(os.path.join(path, "index.json")) as f:
         index = json.load(f)
-    # read EXACTLY the n_procs shard files this save wrote: a missing one
-    # is a hard error (silently restoring zeros for its regions would
+    # read EXACTLY the shard files this save's writers wrote: a missing
+    # one is a hard error (silently restoring zeros for its regions would
     # corrupt a resume), and stale shards_p<k> files from an earlier save
-    # with more processes are ignored rather than mixed in
-    files = [os.path.join(path, f"shards_p{k}.json")
-             for k in range(index.get("n_procs", 1))]
+    # with more processes are ignored rather than mixed in.  ``writers``
+    # names the actual process ids (a shrunk cluster's survivors need
+    # not be 0..n-1); pre-writers indexes fall back to range(n_procs).
+    writer_ids = index.get("writers",
+                           list(range(index.get("n_procs", 1))))
+    files = [os.path.join(path, f"shards_p{k}.json") for k in writer_ids]
     missing = [f for f in files if not os.path.exists(f)]
     if missing:
         raise FileNotFoundError(
             f"sharded checkpoint at {path} is incomplete: expected "
-            f"{index.get('n_procs', 1)} per-process shard files, "
+            f"{len(writer_ids)} per-process shard files, "
             f"missing {missing}")
     leaf_pieces: Dict[int, list] = {}
     for tf in files:
@@ -379,13 +415,43 @@ class CheckpointManager:
     the newest is corrupt or uncommitted (a kill mid-save must cost one
     checkpoint cadence, never the run); ``restore(step=K)`` verifies
     and RAISES :class:`CorruptCheckpointError` instead — the caller
-    asked for that exact state."""
+    asked for that exact state.
+
+    Cluster commits (``cluster=`` a ``parallel.multihost.Cluster`` with
+    more than one member): a snapshot becomes CLUSTER-committed — the
+    coordinator writes the manifest only after a control-plane barrier
+    proves every member's data files are durably on the shared
+    filesystem, so a snapshot no host can restore from is never
+    "committed".  Two on-disk layouts, chosen per save from the tree
+    itself:
+
+    - *replicated* (every leaf fully addressable or fully replicated —
+      the DP-over-DCN regime): the coordinator alone serializes the one
+      logical state through the ordinary ``save_pytree`` path; the
+      barrier just proves everyone reached the same boundary.
+    - *sharded* (leaves span processes — model-sharded state): each
+      member writes its own ``ckpt_<step>.shards/shards_p<k>`` pieces
+      via ``save_pytree_sharded`` and publishes their crc table over
+      the KV store; the coordinator merges all tables into the
+      manifest.  Restores go through ``load_pytree_sharded`` (the
+      target mesh may differ — restore-with-resharding).
+
+    All barriers ride the cluster's KV store, NOT device collectives —
+    safe from the async writer thread, and still functional for the
+    SURVIVORS after a host dies (a member that stops showing up raises
+    a typed ``ClusterSyncTimeout`` the resilience layer translates
+    into host-loss recovery).  Single-member clusters (and
+    ``cluster=None``) keep the single-process path byte-for-byte."""
 
     _PAT = re.compile(r"ckpt_(\d+)\.npz$")
+    _PAT_SHARDS = re.compile(r"ckpt_(\d+)\.shards$")
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 cluster=None):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self.cluster = cluster
+        self._save_seq = 0
         os.makedirs(directory, exist_ok=True)
         # crash recovery: a kill mid-save leaves ckpt_N.*.tmp behind,
         # and if step N is never saved again nothing else removes it —
@@ -395,7 +461,9 @@ class CheckpointManager:
         # OURS runs, and the fresh-run/populated-dir refusal plus the
         # step-keyed file names make a concurrent foreign writer a
         # non-supported layout anyway.
-        for f in glob.glob(os.path.join(directory, "ckpt_*.tmp")):
+        for f in glob.glob(os.path.join(directory, "ckpt_*.tmp")) + \
+                glob.glob(os.path.join(directory, "ckpt_*.shards",
+                                       "*.tmp")):
             try:
                 os.remove(f)
                 log.info("swept orphaned checkpoint tmp file %s", f)
@@ -405,15 +473,27 @@ class CheckpointManager:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt_{step}.npz")
 
+    def _shards_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step}.shards")
+
     def _manifest_path(self, step: int) -> str:
         return self._path(step) + ".manifest.json"
 
+    @property
+    def _multi(self) -> bool:
+        return (self.cluster is not None
+                and self.cluster.process_count > 1)
+
     def all_steps(self) -> List[int]:
-        steps = []
+        steps = set()
         for f in glob.glob(os.path.join(self.directory, "ckpt_*.npz")):
             m = self._PAT.search(f)
             if m:
-                steps.append(int(m.group(1)))
+                steps.add(int(m.group(1)))
+        for f in glob.glob(os.path.join(self.directory, "ckpt_*.shards")):
+            m = self._PAT_SHARDS.search(f)
+            if m and os.path.isdir(f):
+                steps.add(int(m.group(1)))
         return sorted(steps)
 
     def latest_step(self) -> Optional[int]:
@@ -433,14 +513,13 @@ class CheckpointManager:
         t0 = time.perf_counter()
         meta = dict(meta or {})
         meta.update({"step": step, "time": time.time()})
-        path = self._path(step)
-        files = save_pytree(path, tree, meta)
-        manifest = {"format": 1, "step": step, "files": files}
-        man_tmp = self._manifest_path(step) + ".tmp"
-        with open(man_tmp, "w") as f:
-            json.dump(manifest, f, indent=1)
-        _replace_with_fsync(man_tmp, self._manifest_path(step))
-        self._gc()
+        if self._multi:
+            files = self._save_cluster(step, tree, meta)
+        else:
+            path = self._path(step)
+            files = save_pytree(path, tree, meta)
+            self._commit_manifest(step, files)
+            self._gc()
         now = time.perf_counter()
         if not _was_async:
             checkpoint_metrics.note("saves_sync")
@@ -449,7 +528,83 @@ class CheckpointManager:
             (now - t0) * 1e3,
             (now - (_t_req if _t_req is not None else t0)) * 1e3,
             was_async=_was_async)
-        return path
+        return self._path(step)
+
+    def _commit_manifest(self, step: int, files: Dict[str, Dict],
+                         cluster_info: Optional[Dict] = None) -> None:
+        manifest = {"format": 1, "step": step, "files": files}
+        if cluster_info:
+            manifest["cluster"] = cluster_info
+        man_tmp = self._manifest_path(step) + ".tmp"
+        with open(man_tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        _replace_with_fsync(man_tmp, self._manifest_path(step))
+
+    @staticmethod
+    def _needs_shards(tree: PyTree) -> bool:
+        """Whether any leaf's bytes span processes: such state can only
+        be serialized piecewise (no single host holds it)."""
+        for leaf in jax.tree.leaves(tree):
+            if isinstance(leaf, jax.Array) and not (
+                    leaf.is_fully_addressable
+                    or getattr(leaf, "is_fully_replicated", False)):
+                return True
+        return False
+
+    def _save_cluster(self, step: int, tree: PyTree,
+                      meta: Dict) -> Dict[str, Dict]:
+        """The cluster-commit protocol (class docstring).  Ordering is
+        the whole point: data files first on every member, ONE barrier
+        proving all of them durable, manifest LAST by the coordinator,
+        a second barrier so no member returns (and reports "committed")
+        before the manifest exists.  Barrier tags ride a per-manager
+        save sequence number — every member issues the same saves in
+        the same order, so the tags line up without negotiation."""
+        from deeplearning4j_tpu.runtime.metrics import multihost_metrics
+
+        cl = self.cluster
+        self._save_seq += 1
+        seq = self._save_seq
+        if self._needs_shards(tree):
+            sdir = self._shards_dir(step)
+            mine = save_pytree_sharded(
+                sdir, tree, meta, sync=False,
+                process_index=cl.process_id, writers=cl.members,
+                write_index=cl.is_coordinator)
+            rel = os.path.basename(sdir)
+            mine = {f"{rel}/{k}": v for k, v in mine.items()}
+            tables = cl.gather(json.dumps(mine), f"ckptcrc_{seq}")
+            files: Dict[str, Dict] = {}
+            if cl.is_coordinator:
+                for blob in tables.values():
+                    files.update(json.loads(blob))
+            layout = "sharded"
+        else:
+            # one logical state every member holds: the coordinator
+            # alone serializes (identical bytes from any member — the
+            # guard-skip/loss-scale verdicts that could fork replicas
+            # are collective by construction)
+            files = (save_pytree(self._path(step), tree, meta)
+                     if cl.is_coordinator else {})
+            layout = "replicated"
+        cl.barrier(f"ckpt_data_{seq}")
+        if cl.is_coordinator:
+            self._commit_manifest(step, files, cluster_info={
+                "layout": layout, "members": list(cl.members),
+                "coordinator": cl.coordinator})
+        cl.barrier(f"ckpt_commit_{seq}")
+        multihost_metrics.note("cluster_commits")
+        if cl.is_coordinator:
+            self._gc()
+        if not cl.is_coordinator:
+            # non-coordinators report the committed manifest's byte
+            # count (they wrote none themselves in replicated mode)
+            try:
+                with open(self._manifest_path(step)) as f:
+                    files = json.load(f)["files"]
+            except OSError:
+                files = {}
+        return files
 
     def verify(self, step: int) -> None:
         """Raise :class:`CorruptCheckpointError` unless ``step``'s files
@@ -495,7 +650,7 @@ class CheckpointManager:
                 self.verify(step)
             # legacy pre-manifest checkpoint: load directly (load errors
             # surface as-is — an explicit step never falls back)
-            return load_pytree(self._path(step), like)
+            return self._load_snapshot(step, like)
         steps = self.all_steps()
         if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
@@ -514,7 +669,7 @@ class CheckpointManager:
             try:
                 if os.path.exists(self._manifest_path(s)):
                     self.verify(s)
-                out = load_pytree(self._path(s), like)
+                out = self._load_snapshot(s, like)
                 if s != desc[0]:
                     checkpoint_metrics.note("restore_fallbacks")
                     log.warning(
@@ -538,10 +693,27 @@ class CheckpointManager:
             f"no restorable checkpoint in {self.directory} "
             f"(tried steps {desc})") from last_err
 
+    def _load_snapshot(self, step: int, like: Optional[PyTree]
+                   ) -> Tuple[PyTree, Dict]:
+        """Layout-dispatching load: the single-file ``.npz`` form or the
+        cluster-sharded ``.shards/`` directory, whichever this step was
+        written as (both can coexist in one dir across a cluster
+        shrink)."""
+        if os.path.exists(self._path(step)):
+            return load_pytree(self._path(step), like)
+        if os.path.isdir(self._shards_dir(step)):
+            return load_pytree_sharded(self._shards_dir(step), like)
+        raise FileNotFoundError(
+            f"no checkpoint files for step {step} in {self.directory}")
+
     def _gc(self) -> None:
         """Retention sweep.  Tolerates concurrently-deleted files — a
         second process (or the async writer racing a final sync save)
-        may have removed a step between the glob and the unlink."""
+        may have removed a step between the glob and the unlink.  In a
+        cluster only the COORDINATOR sweeps (it is also the only
+        caller); the shared filesystem makes its sweep everyone's."""
+        import shutil
+
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep] if self.max_to_keep > 0 else []:
             for suffix in (".manifest.json", ".json", ""):
@@ -549,6 +721,7 @@ class CheckpointManager:
                     os.remove(self._path(s) + suffix)
                 except OSError:
                     pass
+            shutil.rmtree(self._shards_dir(s), ignore_errors=True)
 
 
 class SnapshotHandle:

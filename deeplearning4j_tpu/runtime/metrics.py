@@ -656,6 +656,74 @@ class MfuMetrics:
 mfu_metrics = MfuMetrics()
 
 
+class MultihostMetrics:
+    """Process-wide counters for the multi-host runtime
+    (``parallel/multihost.py`` + the cluster paths in
+    runtime/{checkpoint,resilience}.py):
+
+    - ``joins`` / ``join_retries`` / ``join_failures``: bounded-retry
+      ``jax.distributed.initialize`` outcomes (the launcher);
+    - ``barriers`` / ``barrier_wait_ms``: control-plane rendezvous count
+      and cumulative wait (the cluster-commit and drain overhead the
+      host side actually pays);
+    - ``flag_syncs``: per-step cluster-wide preemption-flag ORs;
+    - ``cluster_commits``: snapshots whose manifest was written by the
+      coordinator AFTER the all-members barrier — the cluster-committed
+      count ("a snapshot no host can restore from is never committed");
+    - ``host_losses`` / ``evictions`` / ``heartbeat_stale_events``:
+      host-level failures detected, members that exited because THEIR
+      devices were lost, and heartbeat staleness observations.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.joins = 0
+            self.join_retries = 0
+            self.join_failures = 0
+            self.barriers = 0
+            self.barrier_wait_ms = 0.0
+            self.flag_syncs = 0
+            self.cluster_commits = 0
+            self.host_losses = 0
+            self.evictions = 0
+            self.heartbeat_stale_events = 0
+
+    def note(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, key, getattr(self, key) + by)
+
+    def note_wait(self, ms: float) -> None:
+        with self._lock:
+            self.barrier_wait_ms += ms
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return getattr(self, key)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "joins": self.joins,
+                "join_retries": self.join_retries,
+                "join_failures": self.join_failures,
+                "barriers": self.barriers,
+                "barrier_wait_ms": round(self.barrier_wait_ms, 3),
+                "flag_syncs": self.flag_syncs,
+                "cluster_commits": self.cluster_commits,
+                "host_losses": self.host_losses,
+                "evictions": self.evictions,
+                "heartbeat_stale_events": self.heartbeat_stale_events,
+            }
+
+
+#: process-wide singleton the multi-host launcher/control plane reports into
+multihost_metrics = MultihostMetrics()
+
+
 def device_memory_stats() -> Dict[str, Any]:
     """Per-device HBM usage where the backend reports it.
 

@@ -43,6 +43,7 @@ import collections
 import contextlib
 import dataclasses
 import logging
+import os
 import signal
 import statistics
 import threading
@@ -449,6 +450,13 @@ class ResilienceConfig:
     shuffle: bool = True
     sync: bool = False
     max_in_flight: int = 2
+    #: multi-host knobs (only read when a ``cluster`` with >1 member is
+    #: passed to ResilientFit): control-plane op deadline, and the
+    #: shared-filesystem heartbeat cadence/staleness threshold that
+    #: turns a silent peer into a host-loss finding
+    cluster_timeout_s: float = 120.0
+    hb_interval_s: float = 2.0
+    hb_timeout_s: float = 20.0
 
     def __post_init__(self) -> None:
         # fail at construction, not one `step % checkpoint_every` into
@@ -511,19 +519,49 @@ class ResilientFit:
       with ``grad_accum`` scaled to preserve the effective batch
       (``parallel.mesh.elastic_remesh`` — bit-exact vs the
       uninterrupted run), restore the last committed snapshot, and
-      continue."""
+      continue.
+
+    Multi-host (``cluster=`` a ``parallel.multihost.Cluster`` with >1
+    member — the launcher wires it from
+    ``--coordinator/--num-processes/--process-id``): the same driver
+    becomes the cluster runtime.  Snapshots are CLUSTER-committed (the
+    coordinator writes the manifest only after a barrier proves every
+    member's data durable — a snapshot no host can restore from is
+    never "committed"); one member's SIGTERM propagates through a
+    per-boundary cluster-wide flag OR so EVERY member drains at the
+    same step and the final snapshot is one cluster-consistent state;
+    guard-skip / loss-scale / rollback verdicts stay replica-consistent
+    across hosts by construction (they derive from the psum'd
+    collective score/grads).  A host LOSS — detected by the shared-fs
+    :class:`~deeplearning4j_tpu.parallel.multihost.HostHeartbeat` when
+    a control-plane sync times out, or reported as a
+    :class:`DeviceLossError` naming the dead host's devices — is
+    settled cluster-wide: survivors agree on the lost ids, shrink to a
+    new cluster generation, ``elastic_remesh`` the device mesh if it
+    contained the lost devices, restore the last cluster-committed
+    snapshot, and continue; the member whose OWN devices were lost
+    exits cleanly with ``self.evicted = True`` instead (the survivors
+    carry the run)."""
 
     def __init__(self, net, config: ResilienceConfig,
                  detector: Optional[LossSpikeDetector] = None,
                  mesh=None, fault_hook=None,
-                 preemption_guard: Optional[PreemptionGuard] = None):
+                 preemption_guard: Optional[PreemptionGuard] = None,
+                 cluster=None):
         self.net = net
         self.mesh = mesh
         self.config = config
         self.fault_hook = fault_hook
         self.preemption_guard = preemption_guard
+        #: ``parallel.multihost.Cluster`` (or None = single-process).
+        #: With >1 member the driver becomes the multi-host runtime:
+        #: cluster-committed snapshots, per-step preemption-flag OR,
+        #: and host-loss recovery (eviction / shrink-and-resume).
+        #: Shrunk in place by ``_elastic_resume`` when a host dies.
+        self.cluster = cluster
         self.manager = CheckpointManager(config.checkpoint_dir,
-                                         max_to_keep=config.max_to_keep)
+                                         max_to_keep=config.max_to_keep,
+                                         cluster=cluster)
         self.async_ckpt = None if config.sync else AsyncCheckpointer(
             self.manager, max_in_flight=config.max_in_flight)
         self.detector = detector or LossSpikeDetector(
@@ -535,9 +573,22 @@ class ResilientFit:
         self.rollbacks = 0
         self.preempted = False
         self.remeshes = 0
+        #: True when THIS member's devices were the lost ones — the
+        #: member exits the fit cleanly (exit 0; the survivors carry
+        #: the run) instead of crashing the launcher
+        self.evicted = False
+        #: shared-fs heartbeat monitor, live only inside a multi-host
+        #: fit (``_heartbeat``); consulted to translate control-plane
+        #: timeouts into host-loss findings
+        self._heartbeat = None
         #: driver-scoped grad_accum override set by elastic resume —
         #: the user's conf object is never left mutated
         self.elastic_accum: Optional[int] = None
+
+    @property
+    def _multi(self) -> bool:
+        return (self.cluster is not None
+                and self.cluster.process_count > 1)
 
     def _recycle_writer(self, suppress_errors: bool) -> None:
         """close() the async checkpointer — drain (committing every
@@ -644,18 +695,28 @@ class ResilientFit:
         finally:
             net.conf.grad_accum = orig_accum
 
+        # a mesh spanning processes needs multi-host staging: each
+        # process contributes only ITS row slice of the global batch
+        # (jax.make_array_from_process_local_data) — a host-local
+        # device_put cannot address another host's devices
+        spans_hosts = (self.mesh is not None and self._multi
+                       and len({d.process_index
+                                for d in self.mesh.devices.flat}) > 1)
+
         def dispatch(params, ustate, batch, key, at_step):
             if not dp_mode:
                 return train_step(params, ustate, batch.features,
                                   batch.labels, key, at_step)
             b = batch.features.shape[0]
             target = -(-b // pad_chunk) * pad_chunk
-            net._check_bn_padding(target != b)
-            return train_step(
-                params, ustate,
-                (net._pad_rows(batch.features, target),
-                 net._pad_rows(batch.labels, target), jnp.int32(b)),
-                key, at_step)
+            x = net._pad_rows(batch.features, target)
+            y = net._pad_rows(batch.labels, target)
+            if spans_hosts:
+                from deeplearning4j_tpu.parallel import multihost
+                x, y = multihost.stage_global_batch(
+                    x, y, self.mesh, self.cluster)
+            return train_step(params, ustate, (x, y, jnp.int32(b)),
+                              key, at_step)
 
         return dispatch, updaters
 
@@ -679,23 +740,140 @@ class ResilientFit:
         self._check_restored(params, meta.get("step"))
         return params, ustate, meta
 
+    def _translate_sync_timeout(self, err) -> DeviceLossError:
+        """A control-plane timeout on a LIVE cluster means a peer went
+        silent.  The heartbeat monitor names it: stale members become a
+        host-loss finding (their device ids); a timeout with every peer
+        still beating is a genuine infrastructure fault and re-raises
+        as-is — "recovering" from a slow-but-alive peer would fork the
+        run."""
+        hb = self._heartbeat
+        stale = hb.stale_members() if hb is not None else ()
+        if not stale:
+            raise err
+        lost = []
+        for m in stale:
+            lost.extend(self.cluster.devices_of(m))
+        log.error(
+            "cluster sync timed out and member(s) %s have stale "
+            "heartbeats — treating as host loss (devices %s)",
+            list(stale), lost)
+        return DeviceLossError(
+            lost, f"host loss: members {sorted(stale)} stopped "
+            f"heartbeating ({err})")
+
+    def _cluster_flag(self, flag: bool) -> bool:
+        """Cluster-wide OR of this member's preemption flag — every
+        member sees the verdict in the SAME round, so all of them stop
+        at the same step boundary.  Control-plane timeouts translate to
+        host loss like any other sync."""
+        if not self._multi:
+            return flag
+        from deeplearning4j_tpu.parallel.multihost import \
+            ClusterSyncTimeout
+
+        try:
+            return self.cluster.any_flag(
+                flag, "preempt",
+                timeout_s=self.config.cluster_timeout_s)
+        except ClusterSyncTimeout as e:
+            raise self._translate_sync_timeout(e) from e
+
+    def _host_loss_update(self, err: DeviceLossError):
+        """Cluster-level half of a loss event: agree on the lost ids
+        with the responsive members, evict self if OUR devices are the
+        lost ones, else shrink the cluster to the survivors (new
+        generation — fresh barrier namespace, re-elected coordinator).
+        Returns (lost_ids, evicted)."""
+        from deeplearning4j_tpu.runtime.metrics import multihost_metrics
+
+        cl = self.cluster
+        hb = self._heartbeat
+        suspects = tuple(hb.stale_members()) if hb is not None else ()
+        lost = set(cl.agree_lost_ids(
+            err.lost_ids, suspects=suspects,
+            timeout_s=self.config.cluster_timeout_s))
+        if hb is not None:
+            lost.update(hb.lost_device_ids())
+        lost_members = list(cl.owners_of(lost))
+        if suspects:
+            lost_members = sorted(set(lost_members) | set(suspects))
+        if cl.process_id in lost_members:
+            multihost_metrics.note("evictions")
+            telemetry.event("resilience.evicted",
+                            lost=sorted(lost), member=cl.process_id)
+            log.warning(
+                "this member's devices are among the lost (%s) — "
+                "exiting the fit cleanly; the survivors carry the run",
+                sorted(lost))
+            return tuple(sorted(lost)), True
+        if lost_members:
+            multihost_metrics.note("host_losses")
+            survivors = cl.shrink(lost_members)
+            log.warning(
+                "host loss: member(s) %s evicted, surviving cluster "
+                "%s (coordinator %d)", lost_members, survivors.members,
+                survivors.coordinator)
+            telemetry.event("resilience.host_loss",
+                            lost_members=lost_members,
+                            survivors=list(survivors.members))
+            self.cluster = survivors
+            self.manager.cluster = survivors
+            if hb is not None:
+                hb.cluster = survivors
+        return tuple(sorted(lost)), False
+
     def _elastic_resume(self, err: DeviceLossError, net):
-        """Device loss -> re-mesh over survivors (effective batch
+        """Device/host loss -> re-mesh over survivors (effective batch
         preserved via grad_accum scaling) -> restore last committed
-        snapshot.  Returns (dispatch, updaters, params, ustate, step).
-        Single-device runs have nothing to shrink onto — the loss
-        re-raises.  data×model meshes shrink their DATA axis only
-        (``parallel.mesh.elastic_remesh`` keeps whole model groups
-        intact — the tensor-parallel weight layout survives the
-        re-mesh verbatim; too few survivors for one group raises with
-        the surviving count and required divisor)."""
+        snapshot.  Returns (dispatch, updaters, params, ustate, step),
+        or None when THIS member was evicted (its own devices are the
+        lost ones — the caller exits the fit cleanly).
+
+        Single-process single-device runs have nothing to shrink onto —
+        the loss re-raises.  data×model meshes shrink their DATA axis
+        only (``parallel.mesh.elastic_remesh`` keeps whole model groups
+        intact — the tensor-parallel weight layout survives the re-mesh
+        verbatim; too few survivors for one group raises with the
+        surviving count and required divisor).  Under a multi-member
+        cluster the loss is first settled at HOST level
+        (``_host_loss_update``): survivors agree on the lost ids over
+        the control plane, shrink to a new cluster generation, and only
+        then shrink the device mesh — when the local mesh never
+        contained the lost devices (they were another host's), the mesh
+        survives verbatim and recovery is restore-and-continue."""
         from deeplearning4j_tpu.parallel import mesh as mesh_lib
 
         checkpoint_metrics.note("device_losses")
-        if self.mesh is None:
+        # drain in-flight snapshots FIRST, while the old cluster
+        # generation is still in place: lockstep pending saves
+        # rendezvous among all members (an injected drill keeps every
+        # process alive, so even the member about to be evicted
+        # completes them); a genuinely dead host times the drain out,
+        # the uncommitted snapshot is dropped, and the restore below
+        # falls back one cadence — the documented cost of a mid-save
+        # loss
+        try:
+            self._drain()
+        except Exception:  # noqa: BLE001 — incl. ClusterSyncTimeout
+            if not self._multi:
+                raise
+            log.warning("in-flight snapshot died with the lost host; "
+                        "restoring the previous committed step")
+            self._recycle_writer(suppress_errors=True)
+        lost_ids = tuple(err.lost_ids)
+        cluster_loss = False
+        if self._multi:
+            lost_ids, evicted = self._host_loss_update(err)
+            if evicted:
+                return None
+            cluster_loss = True
+        if self.mesh is None and not cluster_loss:
             raise err
-        members = {int(d.id) for d in self.mesh.devices.flat}
-        if not members & {int(i) for i in err.lost_ids}:
+        members = ({int(d.id) for d in self.mesh.devices.flat}
+                   if self.mesh is not None else set())
+        mesh_hit = bool(members & {int(i) for i in lost_ids})
+        if not mesh_hit and not cluster_loss:
             # stale/foreign ids (a detector re-reporting an already-
             # evicted device): "recovering" would rebuild an identical
             # mesh and retry the same step forever.  Each genuine loss
@@ -704,29 +882,40 @@ class ResilientFit:
             log.error(
                 "device loss reports ids %s, none of which are in the "
                 "current mesh %s — stale detector? re-raising",
-                sorted(set(int(i) for i in err.lost_ids)),
+                sorted(set(int(i) for i in lost_ids)),
                 sorted(members))
             raise err
-        old_degree = int(self.mesh.shape[mesh_lib.DATA_AXIS])
-        m_degree = mesh_lib.model_degree(self.mesh)
         old_accum = max(self.elastic_accum or net.conf.grad_accum, 1)
-        new_mesh, new_accum = mesh_lib.elastic_remesh(
-            self.mesh, err.lost_ids, old_accum)
-        new_degree = (int(new_mesh.shape[mesh_lib.DATA_AXIS])
-                      if new_mesh is not None else 1)
-        log.warning(
-            "device loss (ids %s): re-meshing %d->%d data shards "
-            "(model degree %d preserved), grad_accum %d->%d (effective "
-            "batch preserved); restoring last committed snapshot",
-            sorted(set(err.lost_ids)),
-            old_degree, new_degree, m_degree, old_accum, new_accum)
+        if mesh_hit:
+            old_degree = int(self.mesh.shape[mesh_lib.DATA_AXIS])
+            m_degree = mesh_lib.model_degree(self.mesh)
+            new_mesh, new_accum = mesh_lib.elastic_remesh(
+                self.mesh, lost_ids, old_accum)
+            new_degree = (int(new_mesh.shape[mesh_lib.DATA_AXIS])
+                          if new_mesh is not None else 1)
+            log.warning(
+                "device loss (ids %s): re-meshing %d->%d data shards "
+                "(model degree %d preserved), grad_accum %d->%d "
+                "(effective batch preserved); restoring last committed "
+                "snapshot", sorted(set(lost_ids)),
+                old_degree, new_degree, m_degree, old_accum, new_accum)
+            self.mesh = new_mesh
+            self.elastic_accum = new_accum
+        else:
+            # the lost devices were another host's: this member's mesh
+            # (and effective batch share) survives verbatim — recovery
+            # is cluster shrink + restore from the last cluster commit
+            new_degree = (int(self.mesh.shape[mesh_lib.DATA_AXIS])
+                          if self.mesh is not None else 1)
+            new_accum = old_accum
+            log.warning(
+                "host loss (ids %s) outside the local mesh: keeping "
+                "the mesh, restoring last committed snapshot",
+                sorted(set(lost_ids)))
         telemetry.event("resilience.device_loss",
-                        lost=sorted(set(err.lost_ids)),
-                        old_degree=old_degree, new_degree=new_degree,
-                        model_degree=m_degree, new_accum=new_accum)
-        self._drain()   # the restore below must see every commit
-        self.mesh = new_mesh
-        self.elastic_accum = new_accum
+                        lost=sorted(set(lost_ids)),
+                        new_degree=new_degree, new_accum=new_accum,
+                        cluster_loss=cluster_loss)
         dispatch, updaters = self._build_dispatch(net)
         with telemetry.span("resilience.restore", elastic=True):
             params, ustate, meta = self._restore_latest(net, updaters)
@@ -773,7 +962,27 @@ class ResilientFit:
         step = 0
         rollbacks = 0
         self.preempted = False
+        self.evicted = False
         restored = False
+        self._heartbeat = None
+        if self._multi:
+            # bound EVERY control-plane op by the config's deadline —
+            # including the manager's commit barriers on the ASYNC
+            # WRITER thread, which use the handle's default.  A dead
+            # peer must fail a pending commit within cluster_timeout_s
+            # so the recovery drain can drop it and restore, not sit
+            # out a deadline sized for healthy-pod bring-up.
+            self.cluster.timeout_s = cfg.cluster_timeout_s
+            # shared-fs heartbeat: the detector that names a host which
+            # died without saying goodbye (SIGKILL, panic, partition).
+            # Started by the fit loop's with-block below (and stopped on
+            # every exit path with it).
+            from deeplearning4j_tpu.parallel.multihost import \
+                HostHeartbeat
+            self._heartbeat = HostHeartbeat(
+                os.path.join(cfg.checkpoint_dir, "heartbeats"),
+                self.cluster, interval_s=cfg.hb_interval_s,
+                timeout_s=cfg.hb_timeout_s)
         if cfg.resume:
             latest = self.manager.latest_step()
             if latest is None:
@@ -828,6 +1037,18 @@ class ResilientFit:
                     f"holds snapshots (steps {existing}); pass "
                     "resume=True to continue that run, or point at a "
                     "fresh directory")
+        if self._multi:
+            # rendezvous BETWEEN the fresh-dir check above and the
+            # first save below: the coordinator's save lands data files
+            # in the SHARED dir before its commit barrier, so without
+            # this a slower member's check could read a faster member's
+            # half-landed initial snapshot as "another run's" and
+            # refuse — deadlocking the faster member at the commit
+            # barrier.  After this barrier every member has finished
+            # its check (or resume restore) before any member writes.
+            self.cluster.barrier("fit_start",
+                                 timeout_s=cfg.cluster_timeout_s)
+        if not restored:
             # THIS run's rollback target exists before the first cadence
             save(step)
 
@@ -840,11 +1061,45 @@ class ResilientFit:
         steps_this_call = 0
         guard = self.preemption_guard or PreemptionGuard()
 
-        with self._writer_guard(), guard:
+        def recover(e: DeviceLossError) -> bool:
+            """Shared host/device-loss recovery for every loop site.
+            True = resume the loop with rebuilt state; False = this
+            member was EVICTED (its devices were the lost ones) and the
+            fit must end cleanly."""
+            nonlocal dispatch, updaters, params, ustate, step, \
+                last_good, skips
+            resumed = self._elastic_resume(e, net)
+            if resumed is None:
+                return False
+            dispatch, updaters, params, ustate, step = resumed
+            # the restore may have fallen back below the newest
+            # requested save (corrupt-latest case) — re-anchor
+            # the rollback target to what is actually good
+            last_good = step
+            # skip flags booked so far live on the LOST mesh —
+            # pull them to host now (one sync per loss event)
+            # so the end-of-fit stack doesn't mix shardings
+            skips = [np.asarray(jax.device_get(s)) for s in skips]
+            return True
+
+        with self._writer_guard(), guard, \
+                (self._heartbeat or contextlib.nullcontext()):
             while step < total_steps:
-                if guard.requested():
+                try:
+                    # cluster-wide OR: one host's SIGTERM is every
+                    # host's stop verdict, in the same round — so the
+                    # whole cluster drains at the SAME step boundary
+                    stop = self._cluster_flag(guard.requested())
+                except DeviceLossError as e:
+                    if recover(e):
+                        continue
+                    self.evicted = True
+                    break
+                if stop:
                     # preemption notice: drain in-flight snapshots, one
-                    # final SYNC snapshot at this boundary, clean return
+                    # final SYNC snapshot at this boundary (cluster-
+                    # committed under a multi-host cluster), clean
+                    # return on EVERY member
                     self._drain()
                     save(step, sync=True)
                     checkpoint_metrics.note("preemption_snapshots")
@@ -873,17 +1128,10 @@ class ResilientFit:
                     params, ustate, score, skipped = dispatch(
                         params, ustate, batch, eff_key, step)
                 except DeviceLossError as e:
-                    dispatch, updaters, params, ustate, step = \
-                        self._elastic_resume(e, net)
-                    # the restore may have fallen back below the newest
-                    # requested save (corrupt-latest case) — re-anchor
-                    # the rollback target to what is actually good
-                    last_good = step
-                    # skip flags booked so far live on the LOST mesh —
-                    # pull them to host now (one sync per loss event)
-                    # so the end-of-fit stack doesn't mix shardings
-                    skips = [np.asarray(jax.device_get(s)) for s in skips]
-                    continue
+                    if recover(e):
+                        continue
+                    self.evicted = True
+                    break
                 skips.append(skipped)
                 loss = float(score)
                 steps_this_call += 1

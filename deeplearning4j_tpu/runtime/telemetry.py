@@ -55,6 +55,7 @@ from deeplearning4j_tpu.runtime.metrics import (checkpoint_metrics,
                                                 device_memory_stats,
                                                 dp_metrics,
                                                 mfu_metrics,
+                                                multihost_metrics,
                                                 peak_bytes_in_use,
                                                 resilience_metrics,
                                                 serving_metrics)
@@ -508,6 +509,7 @@ registry.register("decode", decode_metrics)
 registry.register("dp", dp_metrics)
 registry.register("checkpoint", checkpoint_metrics)
 registry.register("mfu", mfu_metrics)
+registry.register("multihost", multihost_metrics)
 
 
 # ---------------------------------------------------------------------------

@@ -269,13 +269,16 @@ def test_router_load_shed(params, engine):
     shed_before = decode_metrics.snapshot()["requests_shed"]
     rng = np.random.RandomState(8)
     with router:
-        keep = router.submit(rng.randint(1, 64, size=4), max_tokens=24)
+        # 56 tokens (the max_len=64 budget): the in-flight window must
+        # comfortably outlast a scheduler stall between the two submits
+        # on a loaded 1-core CI host — 24 tokens was observed flaky
+        keep = router.submit(rng.randint(1, 64, size=4), max_tokens=56)
         with pytest.raises(OverloadedError) as ei:
-            # depth >= 1 until `keep` finishes: decode of 24 tokens is
+            # depth >= 1 until `keep` finishes: decode of 56 tokens is
             # far slower than this submit
             router.submit(rng.randint(1, 64, size=4), max_tokens=4)
         assert ei.value.bound == 1 and ei.value.replicas == 1
-        assert keep.result(60).shape == (24,)
+        assert keep.result(60).shape == (56,)
     assert decode_metrics.snapshot()["requests_shed"] == shed_before + 1
 
 

@@ -229,7 +229,9 @@ def test_dropout_confs_auto_shard_with_per_replica_masks(devices):
     """Dropout no longer drops the fit to single-device: the auto mesh
     engages, each data shard folds its shard index into the step key
     (independent masks), and the run replays deterministically from the
-    seed.  BatchNorm still gates."""
+    seed.  BatchNorm auto-shards too since the cross-replica-moments
+    half of ROADMAP item 5 landed (tests/test_dp_fit.py covers its
+    numerics)."""
     from deeplearning4j_tpu.nn.conf import (LayerKind,
                                             NeuralNetConfiguration)
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -250,9 +252,11 @@ def test_dropout_confs_auto_shard_with_per_replica_masks(devices):
     net = MultiLayerNetwork(conf()).init(seed=1)
     mesh = net._resolve_fit_mesh("auto", 32)
     assert mesh is not None and mesh.shape["data"] == 8
-    # BN still refuses auto-sharding (in-batch stats would go per-shard)
-    assert MultiLayerNetwork(conf(bn=True)).init(
-        seed=1)._resolve_fit_mesh("auto", 32) is None
+    # BN confs auto-shard now: cross-replica masked global moments
+    # (nn/layers/extras.bn_collective) replaced the per-shard gate
+    bn_mesh = MultiLayerNetwork(conf(bn=True)).init(
+        seed=1)._resolve_fit_mesh("auto", 32)
+    assert bn_mesh is not None and bn_mesh.shape["data"] == 8
 
     rng = np.random.RandomState(3)
     data = [DataSet(jnp.asarray(rng.randn(32, 4).astype(np.float32)),

@@ -269,13 +269,15 @@ def test_registry_snapshot_structure_and_deltas():
 def test_process_registry_has_all_counter_families():
     snap = registry.snapshot()
     assert set(registry.sources()) == {"compile", "resilience", "serving",
-                                       "decode", "dp", "checkpoint", "mfu"}
+                                       "decode", "dp", "checkpoint", "mfu",
+                                       "multihost"}
     assert "compile_count" in snap["counters"]["compile"]
     assert "requests" in snap["counters"]["serving"]
     assert "tokens_out" in snap["counters"]["decode"]
     assert "dispatches" in snap["counters"]["dp"]
     assert "snapshots_committed" in snap["counters"]["checkpoint"]
     assert "estimates" in snap["counters"]["mfu"]
+    assert "cluster_commits" in snap["counters"]["multihost"]
 
 
 def test_registry_reports_run_id_and_span_counts_when_enabled():

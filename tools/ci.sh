@@ -46,9 +46,20 @@ JAX_PLATFORMS=cpu python -m tools.autotune_gate || exit 1
 # Preemption drill: SIGTERM against a live ResilientFit subprocess must
 # produce a committed (manifest-verified) final snapshot, a clean exit
 # 0, and a resumable checkpoint dir — the fault-tolerance contract
-# ROADMAP item 4 exists for.  Seconds on CPU.
+# ROADMAP item 4 exists for — plus the 2-process cluster drill (one
+# member's SIGTERM drains BOTH at the same boundary; skip-aware).
+# Seconds on CPU.
 echo "[ci] preemption drill"
 JAX_PLATFORMS=cpu python -m tools.preemption_drill || exit 1
+
+# Multi-host gate: virtual 2-host drill (warmed sharded ResilientFit
+# compile_delta==0, committed snapshot verify, injected host loss ->
+# re-mesh resume bit-exact) + a REAL 2-process jax.distributed drill
+# (join, control plane, cluster-committed snapshots, SIGKILLed host ->
+# survivor restore) — skipping the 2-process half cleanly where
+# bring-up is unavailable.  The ROADMAP item 2 contract.
+echo "[ci] multihost gate"
+JAX_PLATFORMS=cpu python -m tools.multihost_gate || exit 1
 
 if [ "${1:-}" = "--slow" ]; then
   python -m pytest tests/ -q
