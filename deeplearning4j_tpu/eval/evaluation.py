@@ -166,6 +166,27 @@ class Evaluation:
         p, r = self.precision(i), self.recall(i)
         return 2 * p * r / (p + r) if p + r else 0.0
 
+    # -- quantization acceptance (serving tier 2) --------------------------
+    def accuracy_delta(self, other: "Evaluation") -> float:
+        """|accuracy(self) - accuracy(other)| — the quantized-vs-fp32
+        acceptance number the serving tier asserts on (both sides
+        evaluated against the SAME labels)."""
+        return abs(self.accuracy() - other.accuracy())
+
+    def assert_accuracy_within(self, other: "Evaluation", tol: float,
+                               label: str = "quantized") -> float:
+        """Assert the accuracy delta vs ``other`` is within ``tol``;
+        returns the delta so bench rows can report the measured number.
+        Raises with both accuracies spelled out — a failed quantization
+        rollout should name its numbers."""
+        delta = self.accuracy_delta(other)
+        if delta > tol:
+            raise AssertionError(
+                f"{label} accuracy delta {delta:.4f} exceeds tolerance "
+                f"{tol} (reference {self.accuracy():.4f} vs {label} "
+                f"{other.accuracy():.4f})")
+        return delta
+
     # -- report (stats():97 parity) ----------------------------------------
     def stats(self) -> str:
         cm = self.confusion

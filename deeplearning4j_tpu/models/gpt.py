@@ -63,13 +63,19 @@ def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
     return tfm.shard_specs(cfg, model_degree)
 
 
-def slot_specs(cfg: TransformerConfig) -> "DecodeSlots":
+def slot_specs(cfg: TransformerConfig,
+               kv_dtype: Optional[str] = None) -> "DecodeSlots":
     """PartitionSpecs for ``DecodeSlots`` under a model-sharded decode
     engine: the KV cache [L, S, T_max, NH, D] shards its HEAD axis over
     ``model`` (each chip holds only its heads' cache — the serving-side
     HBM win that lets a model bigger than one chip serve), tokens and
-    positions replicated (tiny, and every shard needs them)."""
+    positions replicated (tiny, and every shard needs them).  int8 KV
+    adds replicated per-token-row scale specs (scales [L, S, T_max]
+    carry no head axis and cost 8 bytes per row)."""
     h = P(None, None, None, MODEL_AXIS, None)
+    if kv_dtype == "int8":
+        return DecodeSlots(k=h, v=h, tokens=P(), pos=P(),
+                           k_scale=P(), v_scale=P())
     return DecodeSlots(k=h, v=h, tokens=P(), pos=P())
 
 
@@ -161,6 +167,41 @@ class KVCache(NamedTuple):
     v: Array
 
 
+class QKVCache(NamedTuple):
+    """int8 KV cache: same geometry as :class:`KVCache` but the values
+    are symmetric int8 with one fp32 scale per WRITTEN TOKEN ROW
+    (amax over that row's heads x head_dim) — ``k_scale``/``v_scale``
+    [L, B, T_max].  4x the cache rows per byte vs fp32 (2x vs bf16) at
+    a scale overhead of 8 bytes per token row; attention dequantizes
+    the rows it reads in-program (the multiply fuses into the score/
+    value matmuls), so no fp32 cache copy ever materializes."""
+    k: Array            # int8 [L, B, T_max, NH, D]
+    v: Array
+    k_scale: Array      # fp32 [L, B, T_max]
+    v_scale: Array
+
+
+def _kv_quant(x: Array) -> Tuple[Array, Array]:
+    """Quantize fresh K/V rows [..., NH, D] -> (int8 rows, fp32 scale
+    [...]) with one symmetric scale per row (amax over NH x D) — the
+    same grid as the weight quantizer (runtime/quantize.py QMAX /
+    SCALE_EPS), so the two paths can never drift apart."""
+    from deeplearning4j_tpu.runtime.quantize import QMAX, SCALE_EPS
+
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.maximum(amax, SCALE_EPS) / QMAX
+    q = jnp.clip(jnp.round(x / scale[..., None, None]),
+                 -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def _kv_load(q: Array, scale: Array, cdt) -> Array:
+    """Dequantize cache rows back to the compute dtype (fused into the
+    consuming attention matmul under jit)."""
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(cdt)
+
+
 def init_cache(cfg: TransformerConfig, batch: int,
                max_len: Optional[int] = None) -> KVCache:
     T = max_len or cfg.max_len
@@ -231,8 +272,12 @@ def _prefill_chunk(cfg: TransformerConfig, params: PyTree, cache: KVCache,
     the cached prefix + the chunk itself.  Returns (cache', logits
     [B, C, vocab]) — the C-token generalization of ``_decode_step``
     (C=1 reduces to it), so prompt ingestion is matmul-bound instead of
-    T_prompt sequential steps."""
+    T_prompt sequential steps.  ``cache`` may be a :class:`QKVCache`:
+    the slab then quantizes to int8 on write (one scale per token row)
+    and attention dequantizes the rows it reads in-program — same
+    interface, 1/4 the cache bytes."""
     cdt = jnp.dtype(cfg.compute_dtype)
+    quant = isinstance(cache, QKVCache)
     B, C = toks.shape
     T_max = cache.k.shape[2]
     x = tfm.embed(cfg, params, toks, None, start)             # [B, C, H]
@@ -243,7 +288,7 @@ def _prefill_chunk(cfg: TransformerConfig, params: PyTree, cache: KVCache,
     # attended; garbage WITHIN the slab from padded prompt rows is
     # excluded the same way (pad rows only ever follow real rows).
     valid = pos_q[:, None] >= jnp.arange(T_max)[None, :]      # [C, T_max]
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     blocks = params["blocks"]
     for layer in range(cfg.n_layers):
         p = jax.tree.map(lambda a, l=layer: a[l], blocks)
@@ -254,19 +299,36 @@ def _prefill_chunk(cfg: TransformerConfig, params: PyTree, cache: KVCache,
                         preferred_element_type=jnp.float32) + p["bk"]
         v1 = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
                         preferred_element_type=jnp.float32) + p["bv"]
-        k_cache = lax.dynamic_update_slice(
-            cache.k[layer], k1.astype(cdt), (0, start, 0, 0))
-        v_cache = lax.dynamic_update_slice(
-            cache.v[layer], v1.astype(cdt), (0, start, 0, 0))
+        if quant:
+            kq, ks = _kv_quant(k1)                  # [B,C,NH,D]i8, [B,C]
+            vq, vs = _kv_quant(v1)
+            k_cache = lax.dynamic_update_slice(
+                cache.k[layer], kq, (0, start, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                cache.v[layer], vq, (0, start, 0, 0))
+            ks_cache = lax.dynamic_update_slice(
+                cache.k_scale[layer], ks, (0, start))
+            vs_cache = lax.dynamic_update_slice(
+                cache.v_scale[layer], vs, (0, start))
+            new_ks.append(ks_cache)
+            new_vs.append(vs_cache)
+            k_read = _kv_load(k_cache, ks_cache, cdt)
+            v_read = _kv_load(v_cache, vs_cache, cdt)
+        else:
+            k_cache = lax.dynamic_update_slice(
+                cache.k[layer], k1.astype(cdt), (0, start, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                cache.v[layer], v1.astype(cdt), (0, start, 0, 0))
+            k_read, v_read = k_cache, v_cache
         new_k.append(k_cache)
         new_v.append(v_cache)
 
         scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
-        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_cache,
+        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_read,
                        preferred_element_type=jnp.float32) * scale
         s = jnp.where(valid[None, None, :, :], s, -1e9)
         probs = jax.nn.softmax(s, axis=-1).astype(cdt)
-        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_cache,
+        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_read,
                        preferred_element_type=jnp.float32)
         a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
                        preferred_element_type=jnp.float32) + p["bo"]
@@ -281,6 +343,9 @@ def _prefill_chunk(cfg: TransformerConfig, params: PyTree, cache: KVCache,
         x = tfm.layer_norm(x + f, p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
 
     logits = lm_logits(cfg, params, x)                        # [B, C, V]
+    if quant:
+        return QKVCache(jnp.stack(new_k), jnp.stack(new_v),
+                        jnp.stack(new_ks), jnp.stack(new_vs)), logits
     return KVCache(jnp.stack(new_k), jnp.stack(new_v)), logits
 
 
@@ -380,22 +445,49 @@ class DecodeSlots(NamedTuple):
     - ``k``/``v``: slot-structured KV cache [L, S, T_max, NH, D];
     - ``tokens`` [S] int32: each slot's CURRENT token — sampled last
       step (or at prefill), not yet written to the cache;
-    - ``pos`` [S] int32: the position that token will occupy.
+    - ``pos`` [S] int32: the position that token will occupy;
+    - ``k_scale``/``v_scale``: ``None`` for a full-precision cache, or
+      fp32 [L, S, T_max] per-token-row scales when ``k``/``v`` are int8
+      (``init_slots(kv_dtype="int8")``) — 4x the slots per byte vs
+      fp32, ~2x vs bf16, which is the per-chip concurrency the serving
+      tier buys with them.
     """
     k: Array
     v: Array
     tokens: Array
     pos: Array
+    k_scale: Optional[Array] = None
+    v_scale: Optional[Array] = None
 
 
 def init_slots(cfg: TransformerConfig, n_slots: int,
-               max_len: Optional[int] = None) -> DecodeSlots:
+               max_len: Optional[int] = None,
+               kv_dtype: Optional[str] = None) -> DecodeSlots:
     T = max_len or cfg.max_len
     shape = (cfg.n_layers, n_slots, T, cfg.n_heads, cfg.head_dim)
-    cdt = jnp.dtype(cfg.compute_dtype)
-    return DecodeSlots(jnp.zeros(shape, cdt), jnp.zeros(shape, cdt),
-                       jnp.zeros((n_slots,), jnp.int32),
-                       jnp.zeros((n_slots,), jnp.int32))
+    idx = (jnp.zeros((n_slots,), jnp.int32), jnp.zeros((n_slots,), jnp.int32))
+    if kv_dtype is None:
+        cdt = jnp.dtype(cfg.compute_dtype)
+        return DecodeSlots(jnp.zeros(shape, cdt), jnp.zeros(shape, cdt),
+                           *idx)
+    if kv_dtype != "int8":
+        raise ValueError(f"kv_dtype must be None or 'int8': {kv_dtype!r}")
+    sshape = (cfg.n_layers, n_slots, T)
+    return DecodeSlots(jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape, jnp.int8), *idx,
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+
+
+def slots_bytes_per_slot(cfg: TransformerConfig, t_max: int,
+                         kv_dtype: Optional[str] = None) -> int:
+    """KV-cache bytes one slot of a ``t_max`` bucket costs — the
+    denominator of 'slots per chip' capacity planning (bench row
+    ``kv_bytes_per_slot``)."""
+    elems = cfg.n_layers * t_max * cfg.n_heads * cfg.head_dim
+    if kv_dtype == "int8":
+        return 2 * elems + 2 * cfg.n_layers * t_max * 4   # + scale rows
+    return 2 * elems * jnp.dtype(cfg.compute_dtype).itemsize
 
 
 def _slot_key(seed: Array, pos: Array) -> Array:
@@ -421,13 +513,22 @@ def slot_prefill(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
     function records)."""
     L = cfg.n_layers
     T_max = slots.k.shape[2]
+    quant = slots.k_scale is not None
     k_slot = lax.dynamic_slice(
         slots.k, (0, slot, 0, 0, 0),
         (L, 1, T_max, cfg.n_heads, cfg.head_dim))
     v_slot = lax.dynamic_slice(
         slots.v, (0, slot, 0, 0, 0),
         (L, 1, T_max, cfg.n_heads, cfg.head_dim))
-    cache, logits = _prefill_chunk(cfg, params, KVCache(k_slot, v_slot),
+    if quant:
+        ks_slot = lax.dynamic_slice(slots.k_scale, (0, slot, 0),
+                                    (L, 1, T_max))
+        vs_slot = lax.dynamic_slice(slots.v_scale, (0, slot, 0),
+                                    (L, 1, T_max))
+        cache_in = QKVCache(k_slot, v_slot, ks_slot, vs_slot)
+    else:
+        cache_in = KVCache(k_slot, v_slot)
+    cache, logits = _prefill_chunk(cfg, params, cache_in,
                                    toks[None, :], start)
     last = lax.dynamic_slice_in_dim(logits[0], n_valid - 1, 1, axis=0)[0]
     end = start + n_valid
@@ -437,6 +538,10 @@ def slot_prefill(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
         lax.dynamic_update_slice(slots.v, cache.v, (0, slot, 0, 0, 0)),
         slots.tokens.at[slot].set(first),
         slots.pos.at[slot].set(end),
+        k_scale=lax.dynamic_update_slice(
+            slots.k_scale, cache.k_scale, (0, slot, 0)) if quant else None,
+        v_scale=lax.dynamic_update_slice(
+            slots.v_scale, cache.v_scale, (0, slot, 0)) if quant else None,
     ), first
 
 
@@ -455,6 +560,7 @@ def slot_decode(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
     newly sampled token for active slots and the unchanged current token
     for inactive ones."""
     cdt = jnp.dtype(cfg.compute_dtype)
+    quant = slots.k_scale is not None
     S = slots.tokens.shape[0]
     T_max = slots.k.shape[2]
     pos = slots.pos
@@ -466,7 +572,7 @@ def slot_decode(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
 
     rows = jnp.arange(S)
     valid = jnp.arange(T_max)[None, :] <= pos[:, None]        # [S, T_max]
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     blocks = params["blocks"]
     for layer in range(cfg.n_layers):
         p = jax.tree.map(lambda a, l=layer: a[l], blocks)
@@ -478,19 +584,34 @@ def slot_decode(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
         v1 = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
                         preferred_element_type=jnp.float32) + p["bv"]
         # per-slot-position scatter (out-of-range positions drop)
-        k_cache = slots.k[layer].at[rows, pos].set(k1[:, 0].astype(cdt),
-                                                   mode="drop")
-        v_cache = slots.v[layer].at[rows, pos].set(v1[:, 0].astype(cdt),
-                                                   mode="drop")
+        if quant:
+            kq, ks = _kv_quant(k1[:, 0])            # [S,NH,D]i8, [S]
+            vq, vs = _kv_quant(v1[:, 0])
+            k_cache = slots.k[layer].at[rows, pos].set(kq, mode="drop")
+            v_cache = slots.v[layer].at[rows, pos].set(vq, mode="drop")
+            ks_cache = slots.k_scale[layer].at[rows, pos].set(
+                ks, mode="drop")
+            vs_cache = slots.v_scale[layer].at[rows, pos].set(
+                vs, mode="drop")
+            new_ks.append(ks_cache)
+            new_vs.append(vs_cache)
+            k_read = _kv_load(k_cache, ks_cache, cdt)
+            v_read = _kv_load(v_cache, vs_cache, cdt)
+        else:
+            k_cache = slots.k[layer].at[rows, pos].set(
+                k1[:, 0].astype(cdt), mode="drop")
+            v_cache = slots.v[layer].at[rows, pos].set(
+                v1[:, 0].astype(cdt), mode="drop")
+            k_read, v_read = k_cache, v_cache
         new_k.append(k_cache)
         new_v.append(v_cache)
 
         scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
-        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_cache,
+        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_read,
                        preferred_element_type=jnp.float32) * scale
         s = jnp.where(valid[:, None, None, :], s, -1e9)
         probs = jax.nn.softmax(s, axis=-1).astype(cdt)
-        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_cache,
+        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_read,
                        preferred_element_type=jnp.float32)
         a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
                        preferred_element_type=jnp.float32) + p["bo"]
@@ -512,7 +633,49 @@ def slot_decode(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
         jnp.stack(new_k), jnp.stack(new_v),
         jnp.where(active, nxt, slots.tokens),
         pos + act,
+        k_scale=jnp.stack(new_ks) if quant else None,
+        v_scale=jnp.stack(new_vs) if quant else None,
     ), jnp.where(active, nxt, slots.tokens)
+
+
+def slot_read_pages(slots: DecodeSlots, slot: Array):
+    """Read one slot's full KV rows — ``(k, v)`` [L, T_max, NH, D]
+    (plus ``(k_scale, v_scale)`` [L, T_max] for an int8 cache) — for
+    the serving prefix store.  Pure read: the caller must NOT donate
+    ``slots`` into this one."""
+    L, S, T, NH, D = slots.k.shape
+    k = lax.dynamic_slice(slots.k, (0, slot, 0, 0, 0),
+                          (L, 1, T, NH, D))[:, 0]
+    v = lax.dynamic_slice(slots.v, (0, slot, 0, 0, 0),
+                          (L, 1, T, NH, D))[:, 0]
+    if slots.k_scale is None:
+        return k, v
+    ks = lax.dynamic_slice(slots.k_scale, (0, slot, 0), (L, 1, T))[:, 0]
+    vs = lax.dynamic_slice(slots.v_scale, (0, slot, 0), (L, 1, T))[:, 0]
+    return k, v, ks, vs
+
+
+def slot_write_pages(slots: DecodeSlots, slot: Array, k: Array, v: Array,
+                     k_scale: Optional[Array] = None,
+                     v_scale: Optional[Array] = None) -> DecodeSlots:
+    """Copy cached prefix KV pages (full-row [L, T_max, NH, D] arrays;
+    rows past the cached prefix are zeros) over ``slot`` — the prefix
+    HIT path.  Zero tail rows are safe for the same reason ``release``
+    needs no scrubbing: a row is only ever attended at positions ``<=
+    pos``, and every position up to ``pos`` is (re)written by the
+    remaining prefill chunks / decode steps before it is reached.
+    ``tokens``/``pos`` are untouched (the final prefill chunk sets
+    them)."""
+    sk = lax.dynamic_update_slice(slots.k, k[:, None], (0, slot, 0, 0, 0))
+    sv = lax.dynamic_update_slice(slots.v, v[:, None], (0, slot, 0, 0, 0))
+    if slots.k_scale is None:
+        return slots._replace(k=sk, v=sv)
+    return slots._replace(
+        k=sk, v=sv,
+        k_scale=lax.dynamic_update_slice(slots.k_scale, k_scale[:, None],
+                                         (0, slot, 0)),
+        v_scale=lax.dynamic_update_slice(slots.v_scale, v_scale[:, None],
+                                         (0, slot, 0)))
 
 
 def make_slot_fns(cfg: TransformerConfig):

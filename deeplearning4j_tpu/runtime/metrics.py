@@ -268,6 +268,22 @@ class DecodeMetrics:
       ``ttft_p50_ms``/``ttft_p99_ms`` and ``tok_p50_ms``/``tok_p99_ms``;
     - ``mark_compiles()`` / ``compile_delta_since_mark``: same
       steady-state zero-compile assertion primitive as ServingMetrics.
+
+    Serving tier 2 (quantization + prefix reuse + autoscaling):
+
+    - ``prefix_hits`` / ``prefix_misses`` / ``prefill_tokens_saved``:
+      prompt prefixes served from the engine's content-hashed prefix
+      store vs prefilled cold, and the prompt tokens whose prefill
+      compute the hits skipped;
+    - ``kv_bytes_per_slot``: gauge — KV-cache bytes per slot of the
+      most recently constructed engine's largest bucket (int8 KV is
+      the 'slots per chip' capacity lever);
+    - ``replicas_added`` / ``replicas_removed``: autoscaling router
+      scale events;
+    - ``shed_by_policy``: requests shed by the AUTOSCALING router
+      (already at max replicas and over the depth bound) — disjoint
+      from ``requests_shed``-only sheds of the static router
+      (``note_shed(by_policy=True)`` books both).
     """
 
     MAX_SAMPLES = 8192
@@ -290,6 +306,13 @@ class DecodeMetrics:
             self.slot_capacity_steps = 0
             self.queue_depth = 0
             self.max_queue_depth = 0
+            self.prefix_hits = 0
+            self.prefix_misses = 0
+            self.prefill_tokens_saved = 0
+            self.kv_bytes_per_slot = 0
+            self.replicas_added = 0
+            self.replicas_removed = 0
+            self.shed_by_policy = 0
             self._ttft_ms: List[float] = []
             self._tok_ms: List[float] = []
             self._compile_mark: Optional[int] = None
@@ -303,9 +326,29 @@ class DecodeMetrics:
         with self._lock:
             self.joins += 1
 
-    def note_shed(self) -> None:
+    def note_shed(self, by_policy: bool = False) -> None:
         with self._lock:
             self.requests_shed += 1
+            if by_policy:
+                self.shed_by_policy += 1
+
+    def note_prefix_hit(self, tokens_saved: int) -> None:
+        with self._lock:
+            self.prefix_hits += 1
+            self.prefill_tokens_saved += int(tokens_saved)
+
+    def note_prefix_miss(self) -> None:
+        with self._lock:
+            self.prefix_misses += 1
+
+    def note_kv_bytes_per_slot(self, nbytes: int) -> None:
+        with self._lock:
+            self.kv_bytes_per_slot = int(nbytes)
+
+    def note_replicas(self, added: int = 0, removed: int = 0) -> None:
+        with self._lock:
+            self.replicas_added += added
+            self.replicas_removed += removed
 
     def note_complete(self, tokens: int) -> None:
         with self._lock:
@@ -362,6 +405,13 @@ class DecodeMetrics:
                 "slot_occupancy": round(occ, 4),
                 "queue_depth": self.queue_depth,
                 "max_queue_depth": self.max_queue_depth,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefill_tokens_saved": self.prefill_tokens_saved,
+                "kv_bytes_per_slot": self.kv_bytes_per_slot,
+                "replicas_added": self.replicas_added,
+                "replicas_removed": self.replicas_removed,
+                "shed_by_policy": self.shed_by_policy,
                 "ttft_p50_ms": ServingMetrics._pct(ttft, 0.50),
                 "ttft_p99_ms": ServingMetrics._pct(ttft, 0.99),
                 "tok_p50_ms": ServingMetrics._pct(tok, 0.50),

@@ -1,0 +1,221 @@
+"""Post-training quantization for serving: per-channel int8 (and bf16).
+
+Serving economics on accelerators are HBM economics: batch-1 decode and
+low-occupancy inference re-read every weight per dispatch, so the
+cheapest tokens come from smaller numbers, not more chips — the serving
+half of arXiv:2605.25645 (quantized replicated decode) and the TPU
+int8-throughput characterization of arXiv:2309.08918.  This module is
+the weight half of that story (the int8 KV cache lives with the slot
+substrate in models/gpt.py):
+
+- ``quantize_tree(params, "int8")`` maps >=2-D floating MATMUL weights
+  to a :class:`QTensor` — int8 values at the original shape plus fp32
+  PER-CHANNEL scales (one scale per last-axis channel; stacked-per-layer
+  leaves [L, ...] keep a per-(layer, channel) grid so layers never share
+  a range).  1-D leaves AND bias/normalization leaves (recognized by
+  their conventional tree names — ``b*``, ``*_b``, ``*_g``, ``*ln*``,
+  ``*norm*``, ``*bias*``, gamma/beta) stay fp32: they are noise in the
+  byte budget and disproportionate in error — in particular, per-layer
+  vectors ride the blocks tree STACKED as 2-D ``[L, H]`` leaves, where
+  a shape-only rule would share one scale across all layers and a
+  layer whose gains are tiny relative to another's would round-trip to
+  zeros.
+- ``dequantize_tree`` is the inverse and is designed to be called
+  INSIDE a jitted forward: dequant then fuses into the consuming
+  matmuls, so the executable streams int8 bytes from HBM and pays one
+  multiply per element — no fp32 weight copy ever materializes outside
+  the program.
+- ``quant_specs`` maps a ``PartitionSpec`` tree (``*.shard_specs``) to
+  the quantized tree's structure so int8 leaves keep their data×model
+  layout: the int8 payload inherits the leaf's spec unchanged (same
+  shape), the per-channel scale inherits the spec entry of the axis it
+  indexes.  A model-sharded engine serves int8 with zero layout churn.
+
+Mode ``"bf16"`` is the soft variant: >=2-D floating leaves cast to
+bfloat16 (halved bytes, no scales, no dequant multiply).  ``None``
+passes the tree through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Array = jax.Array
+PyTree = Any
+
+#: quantization modes the serving engines accept
+MODES = (None, "int8", "bf16")
+
+#: symmetric int8 grid: values land on [-127, 127] (−128 unused so the
+#: grid is symmetric and dequant needs no zero-point)
+QMAX = 127.0
+
+#: floor for per-channel scales — an all-zero channel must not divide
+#: by zero (its quantized values are exactly zero either way)
+SCALE_EPS = 1e-12
+
+
+class QTensor(NamedTuple):
+    """One quantized weight: ``q`` int8 at the original leaf shape,
+    ``scale`` fp32 per-channel — shape ``(C,)`` for 2-D leaves and
+    ``(d0, C)`` for stacked >=3-D leaves (first axis = the stack, e.g.
+    the layer axis of a ``blocks`` tree), broadcast against ``q`` by
+    :func:`dequantize_leaf`.  Registered as a pytree via NamedTuple, so
+    quantized trees jit/donate/shard like any other params tree."""
+    q: Array
+    scale: Array
+
+
+def check_mode(mode: Optional[str]) -> Optional[str]:
+    if mode not in MODES:
+        raise ValueError(f"quantize mode must be one of {MODES}: {mode!r}")
+    return mode
+
+
+def _quantizable(leaf: Any) -> bool:
+    return (hasattr(leaf, "ndim") and leaf.ndim >= 2
+            and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating))
+
+
+def _skip_int8_name(name: str) -> bool:
+    """Bias/normalization leaves by conventional tree name — exempt
+    from int8 (see the module docstring: per-layer vectors are stacked
+    2-D, and a cross-layer scale can zero a whole layer's gains)."""
+    n = name.lower()
+    return (n.startswith("b") or n.endswith("_b") or n.endswith("_g")
+            or "ln" in n or "norm" in n or "bias" in n
+            or n in ("gamma", "beta", "g"))
+
+
+def _leaf_name(path) -> str:
+    """Innermost dict-key/attribute name on a tree path ('' when the
+    path carries none, e.g. bare sequences)."""
+    for p in reversed(path):
+        if isinstance(p, jax.tree_util.DictKey):
+            return str(p.key)
+        if isinstance(p, jax.tree_util.GetAttrKey):
+            return p.name
+    return ""
+
+
+def _scale_axes(ndim: int):
+    """Axes reduced when computing the per-channel amax: everything but
+    the last (channel) axis, and — for stacked >=3-D leaves — also not
+    the first (stack/layer) axis, so layers keep independent ranges."""
+    keep = {ndim - 1} if ndim == 2 else {0, ndim - 1}
+    return tuple(a for a in range(ndim) if a not in keep)
+
+
+def _scale_bshape(ndim: int, scale: Array):
+    """Broadcast shape re-expanding a reduced scale against the leaf."""
+    if ndim == 2:
+        return (1, scale.shape[-1])
+    return (scale.shape[0],) + (1,) * (ndim - 2) + (scale.shape[-1],)
+
+
+def quantize_leaf(w: Array) -> QTensor:
+    """Symmetric per-channel int8: ``scale = amax/127`` per channel,
+    ``q = round(w / scale)`` clipped to the grid.  Round-trip error is
+    bounded by ``scale / 2`` per element (asserted by the tier-1
+    numerics tests)."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=_scale_axes(w32.ndim))
+    scale = jnp.maximum(amax, SCALE_EPS) / QMAX
+    sb = scale.reshape(_scale_bshape(w32.ndim, scale))
+    q = jnp.clip(jnp.round(w32 / sb), -QMAX, QMAX).astype(jnp.int8)
+    return QTensor(q, scale)
+
+
+def dequantize_leaf(qt: QTensor, dtype=jnp.float32) -> Array:
+    """Inverse of :func:`quantize_leaf`; traced inline so the multiply
+    fuses into the consuming matmul."""
+    sb = qt.scale.reshape(_scale_bshape(qt.q.ndim, qt.scale))
+    return (qt.q.astype(jnp.float32) * sb).astype(dtype)
+
+
+def quantize_tree(params: PyTree, mode: Optional[str]) -> PyTree:
+    """Post-training quantization of a params tree.  ``mode=None`` is
+    identity; ``"bf16"`` casts >=2-D floating leaves; ``"int8"`` maps
+    them to :class:`QTensor`.  1-D leaves always pass through, and
+    int8 additionally exempts bias/normalization leaves by name (bf16
+    keeps them — its dynamic range covers them safely)."""
+    check_mode(mode)
+    if mode is None:
+        return params
+
+    def f(path, w):
+        if not _quantizable(w):
+            return w
+        if mode == "bf16":
+            return jnp.asarray(w, jnp.bfloat16)
+        if _skip_int8_name(_leaf_name(path)):
+            return w
+        return quantize_leaf(w)
+
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
+def dequantize_tree(tree: PyTree, dtype=jnp.float32) -> PyTree:
+    """Map :class:`QTensor` leaves back to ``dtype``; everything else
+    (including bf16-cast leaves — the models cast to their compute dtype
+    themselves) passes through."""
+    return jax.tree.map(
+        lambda x: dequantize_leaf(x, dtype) if isinstance(x, QTensor) else x,
+        tree, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def quant_specs(specs: PyTree, params: PyTree,
+                mode: Optional[str]) -> PyTree:
+    """Rewrite a ``PartitionSpec`` tree to the structure
+    ``quantize_tree(params, mode)`` produces, so a model-sharded engine
+    lays int8 leaves out exactly like their fp32 originals: the int8
+    payload keeps the leaf's spec (same shape, same layout), the
+    per-channel scale takes the spec entry of each axis it indexes
+    (stack axis and channel axis; unsharded when the spec doesn't cover
+    that axis)."""
+    check_mode(mode)
+    if mode != "int8":
+        return specs
+
+    def f(path, s, w):
+        if not _quantizable(w) or _skip_int8_name(_leaf_name(path)):
+            return s
+        entries = tuple(s) + (None,) * (w.ndim - len(tuple(s)))
+        if w.ndim == 2:
+            return QTensor(s, P(entries[-1]))
+        return QTensor(s, P(entries[0], entries[-1]))
+
+    return jax.tree_util.tree_map_with_path(
+        f, specs, params, is_leaf=lambda x: isinstance(x, P))
+
+
+class QuantMemo:
+    """Memoized one-shot transform keyed on raw-tree IDENTITY: holds a
+    strong reference to the source tree and compares with ``is``, so a
+    weight swap always recomputes and a recycled ``id()`` can never
+    false-positive into serving stale quantized weights.  Shared by
+    the serving engines' ``current_params`` (the post-training
+    contract: quantization runs once per distinct params tree)."""
+
+    __slots__ = ("_src", "_out")
+
+    def __init__(self):
+        self._src = None
+        self._out = None
+
+    def get(self, tree: PyTree, transform) -> PyTree:
+        if self._out is None or self._src is not tree:
+            self._out = transform(tree)
+            self._src = tree
+        return self._out
+
+
+def tree_bytes(tree: PyTree) -> int:
+    """Total leaf bytes (QTensor counts payload + scales) — the
+    HBM-per-replica number the bench rows report."""
+    return sum(int(x.size) * jnp.dtype(x.dtype).itemsize
+               for x in jax.tree.leaves(tree) if hasattr(x, "dtype"))

@@ -22,6 +22,18 @@ Autoregressive decode (models/gpt.py causal LMs):
 - ``Router`` (router.py): N replicas behind least-depth dispatch with a
   queue-depth load-shed bound (typed ``OverloadedError``).
 
+Serving tier 2 (per-chip economics; runtime/quantize.py holds the
+weight quantization itself):
+
+- ``DecodeEngine(quantize=, kv_dtype=, prefix_cache=)`` /
+  ``InferenceEngine(quantize=)``: per-channel int8 (or bf16) weights
+  with dequant fused into the jitted programs, an int8 KV cache
+  (~4x/2x slots per chip), and content-hashed prompt-prefix KV reuse
+  (``PrefixCache``) — hits skip re-prefill bit-exactly.
+- ``AutoscalingRouter`` + ``AutoscalePolicy`` (router.py): replica
+  scale-up/down and load-shedding driven by live queue-depth/TTFT
+  telemetry with hysteresis, instead of the static bound.
+
 ``MultiLayerNetwork.output/predict/score`` and ``Evaluation.eval`` route
 through this layer; the per-model adapters live next to each model
 (``models/*.make_serving_apply``).  Metrics:
@@ -31,11 +43,12 @@ through this layer; the per-model adapters live next to each model
 
 from deeplearning4j_tpu.serving.batcher import DynamicBatcher  # noqa: F401
 from deeplearning4j_tpu.serving.decode import (  # noqa: F401
-    ContinuousBatcher, DecodeEngine, DecodeRequest, default_length_buckets,
+    ContinuousBatcher, DecodeEngine, DecodeRequest, PrefixCache,
+    default_length_buckets,
 )
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, default_buckets, pad_rows, pick_bucket,
 )
 from deeplearning4j_tpu.serving.router import (  # noqa: F401
-    OverloadedError, Router,
+    AutoscalePolicy, AutoscalingRouter, OverloadedError, Router,
 )

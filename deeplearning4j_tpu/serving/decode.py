@@ -47,12 +47,38 @@ cache — a model bigger than one chip's HBM serves from a group of
 chips, with per-chip param bytes ~1/model_degree of the replicated
 layout.  The engine key grows ``mesh_signature`` so two groups (or a
 sharded and a replicated engine) never share an executable.
+
+SERVING TIER 2 — the per-chip-economics knobs (the quantized-serving
+half of arXiv:2605.25645 + the int8 characterization of
+arXiv:2309.08918):
+
+- ``quantize="int8"|"bf16"``: post-training weight quantization
+  (runtime/quantize.py) computed once at construction/``warmup()`` —
+  per-channel int8 leaves with dequant fused INTO the jitted prefill/
+  decode programs, so steady state streams int8 weight bytes from HBM.
+  Quantized executables are NEW compile-cache entries (the engine key
+  includes the mode); accuracy deltas are asserted by the tier-1
+  numerics tests and the bench row.
+- ``kv_dtype="int8"``: slot KV cache stored int8 with per-token-row
+  scales riding ``DecodeSlots`` — ~4x (fp32) / ~2x (bf16) the slots
+  per chip at equal cache-length bucket (``kv_bytes_per_slot`` gauge).
+- ``prefix_cache=``: a content-hashed :class:`PrefixCache` — requests
+  sharing a chunk-aligned prompt prefix skip its re-prefill by copying
+  cached KV pages into their slot (``gpt.slot_write_pages``), the
+  chunked-prefill substrate picking up at the first uncached chunk.
+  Hits are BIT-exact vs cold prefill (the pages are exact copies) and
+  never trace: the page read/write executables are pre-traced by
+  ``warmup()`` like everything else.  The store assumes frozen params
+  (the serving contract) — call ``clear()`` after a weight swap.
 """
 
 from __future__ import annotations
 
+import hashlib
+import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -60,8 +86,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.models import gpt
-from deeplearning4j_tpu.parallel.mesh import mesh_signature, model_degree
-from deeplearning4j_tpu.runtime import compile_cache, telemetry
+from deeplearning4j_tpu.parallel.mesh import (MODEL_AXIS, mesh_signature,
+                                              model_degree)
+from deeplearning4j_tpu.runtime import compile_cache, quantize as qz, telemetry
 from deeplearning4j_tpu.runtime.metrics import decode_metrics
 
 
@@ -76,6 +103,169 @@ def default_length_buckets(max_len: int, min_bucket: int = 32
     while ladder[-1] < max_len:
         ladder.append(min(ladder[-1] * 2, max_len))
     return tuple(ladder)
+
+
+class _PrefixEntry:
+    """One stored prefix: its exact tokens, the KV *space* that
+    produced the pages (model conf + quantization modes — pages from
+    one space must never serve another), the host KV pages
+    ([L, m, NH, D] k/v — int8 plus [L, m] scales for a quantized
+    cache), and the alias keys registered for its chunk boundaries."""
+
+    __slots__ = ("tokens", "space", "pages", "nbytes", "alias_keys")
+
+    def __init__(self, tokens: np.ndarray, space: Any,
+                 pages: Tuple[np.ndarray, ...]):
+        self.tokens = tokens
+        self.space = space
+        # own the page memory: callers hand in SLICES of full
+        # bucket-length device fetches, and a stored view would retain
+        # the whole base array while nbytes accounted only the slice —
+        # max_bytes would bound a fiction
+        self.pages = tuple(np.array(p, copy=True) for p in pages)
+        self.nbytes = int(tokens.nbytes
+                          + sum(p.nbytes for p in self.pages))
+        self.alias_keys: List[bytes] = []
+
+
+class PrefixCache:
+    """Content-hashed store of chunk-aligned prompt-prefix KV pages.
+
+    Requests sharing a prompt prefix (system prompts, few-shot headers,
+    conversation history) re-run the same prefill matmuls today; this
+    store keeps the resulting KV rows host-side so a later request
+    copies them into its slot and prefills only its tail.  Design
+    points:
+
+    - keys are SHA-1 digests of the KV *space* (the engine's model
+      conf + quantize/kv_dtype — an int8 engine's pages must never
+      serve a full-precision engine sharing the store) plus the exact
+      prefix token bytes at every prefill-chunk boundary; a digest
+      match is verified against the stored tokens AND space before
+      use, so a collision can cost a miss, never a wrong hit;
+    - entries are stored once under their longest chunk-aligned prefix
+      with alias keys for every shorter boundary — a request sharing
+      only the first k chunks of a longer stored prompt still hits
+      (the page arrays are sliced views, no copy until the hit);
+    - LRU-evicted under ``max_bytes``; thread-safe, and shareable
+      across engine replicas of the same model (the pages are
+      placement-free host arrays — ``Router``/autoscaling replicas
+      warm each other);
+    - the pages are EXACT copies of what prefill wrote (int8 payload +
+      scales copy bit-for-bit), so a hit's continuation is bit-exact vs
+      the cold prefill — asserted tier-1.
+
+    Invalidation is the caller's contract: pages are only valid for the
+    params that produced them — ``clear()`` on any weight swap.
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1: {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
+        # boundary digest -> {entry key: covered length}: a MULTIMAP,
+        # because several entries can cover the same boundary (same
+        # first chunks, different continuations) — evicting one must
+        # not lose the boundary for the survivors
+        self._alias: Dict[bytes, "OrderedDict[bytes, int]"] = {}
+        self._bytes = 0
+
+    @staticmethod
+    def _boundary_digests(tokens: np.ndarray, chunk: int, n: int,
+                          space: Any) -> List[bytes]:
+        """Digests of ``tokens[:k*chunk]`` for k=1..n, computed with ONE
+        incremental hasher (sha1 ``digest()`` is non-destructive) — a
+        long prompt hashes its bytes once, not once per boundary, and
+        ``repr(space)`` renders once per call instead of per rung."""
+        tokens = np.ascontiguousarray(tokens, np.int32)
+        hasher = hashlib.sha1(repr(space).encode() + b"\x00")
+        out = []
+        for k in range(1, n + 1):
+            hasher.update(tokens[(k - 1) * chunk:k * chunk].tobytes())
+            out.append(hasher.digest())
+        return out
+
+    def lookup(self, prompt: np.ndarray, chunk: int, space: Any = None
+               ) -> Optional[Tuple[int, Tuple[np.ndarray, ...]]]:
+        """Longest stored chunk-aligned STRICT prefix of ``prompt`` in
+        ``space`` (at least one chunk always remains to prefill — it
+        produces the first-token logits).  Returns (length, pages) or
+        None."""
+        prompt = np.asarray(prompt, np.int32)
+        digs = self._boundary_digests(prompt, chunk,
+                                      (prompt.size - 1) // chunk, space)
+        for k in range(len(digs), 0, -1):
+            m = k * chunk
+            h = digs[k - 1]
+            with self._lock:
+                refs = self._alias.get(h)
+                if not refs:
+                    continue
+                for full_key in reversed(list(refs)):   # newest first
+                    e = self._entries.get(full_key)
+                    if (e is None or refs[full_key] != m
+                            or e.space != space
+                            or e.tokens.size < m
+                            or not np.array_equal(e.tokens[:m],
+                                                  prompt[:m])):
+                        continue
+                    self._entries.move_to_end(full_key)
+                    return m, tuple(p[:, :m] for p in e.pages)
+        return None
+
+    def insert(self, prefix: np.ndarray, pages: Tuple[np.ndarray, ...],
+               chunk: int, space: Any = None) -> bool:
+        """Store ``pages`` for ``prefix`` (length a chunk multiple) in
+        ``space`` and register alias keys at every chunk boundary.
+        Returns False when the exact prefix is already stored or it
+        alone exceeds ``max_bytes``."""
+        prefix = np.ascontiguousarray(prefix, np.int32)
+        m = prefix.size
+        if m < chunk or m % chunk:
+            raise ValueError(
+                f"prefix length {m} is not a positive multiple of the "
+                f"prefill chunk {chunk}")
+        entry = _PrefixEntry(prefix, space, pages)
+        if entry.nbytes > self.max_bytes:
+            return False
+        digs = self._boundary_digests(prefix, chunk, m // chunk, space)
+        full_key = digs[-1]
+        with self._lock:
+            if full_key in self._entries:
+                return False
+            while self._bytes + entry.nbytes > self.max_bytes \
+                    and self._entries:
+                evicted_key, old = self._entries.popitem(last=False)
+                for a in old.alias_keys:
+                    refs = self._alias.get(a)
+                    if refs is not None:
+                        refs.pop(evicted_key, None)
+                        if not refs:
+                            del self._alias[a]
+                self._bytes -= old.nbytes
+            self._entries[full_key] = entry
+            self._bytes += entry.nbytes
+            for k in range(1, m // chunk + 1):
+                h = digs[k - 1]
+                refs = self._alias.setdefault(h, OrderedDict())
+                refs[full_key] = k * chunk
+                refs.move_to_end(full_key)      # newest registrant wins
+                entry.alias_keys.append(h)
+        return True
+
+    def clear(self) -> None:
+        """Drop every entry — REQUIRED after any weight update: pages
+        are only valid for the params that produced them."""
+        with self._lock:
+            self._entries.clear()
+            self._alias.clear()
+            self._bytes = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
 
 
 class _Bucket:
@@ -115,18 +305,42 @@ class DecodeEngine:
     compile engine with the slot state DONATED, so the cache updates in
     place (no 2x HBM) and identically-configured replicas share one
     compile per bucket.
+
+    Tier-2 knobs (see the module docstring): ``quantize`` post-training
+    weight quantization (``"int8"``/``"bf16"``, computed once per
+    distinct params tree and memoized), ``kv_dtype="int8"`` for the
+    quantized KV cache, ``prefix_cache`` (True for a private store, or
+    a shared :class:`PrefixCache` instance so replicas warm each
+    other).  Each knob keys its own compile-cache entries; a quantized
+    engine never shares an executable with a full-precision one.
     """
 
     def __init__(self, cfg, params: Any, *, n_slots: int = 8,
                  buckets: Optional[Sequence[int]] = None,
                  prefill_chunk: int = gpt.PREFILL_CHUNK,
-                 label: str = "decode", mesh=None):
+                 label: str = "decode", mesh=None,
+                 quantize: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache: Any = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1: {n_slots}")
         self.cfg = cfg
         self._params = params
         self.mesh = mesh
         self.n_slots = int(n_slots)
+        self.quantize = qz.check_mode(quantize)
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8': {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        if prefix_cache is True:
+            prefix_cache = PrefixCache()
+        self._prefix: Optional[PrefixCache] = prefix_cache or None
+        # the KV space the engine's pages live in: a store shared
+        # across replicas only serves hits between engines whose pages
+        # are interchangeable (same conf, same quantization modes)
+        self._prefix_space = (repr(cfg), quantize, kv_dtype)
+        self._qmemo = qz.QuantMemo()
+        self._static_quantized = False
         self.prefill_chunk = int(prefill_chunk)
         self.buckets = tuple(sorted(set(
             buckets if buckets is not None
@@ -156,15 +370,33 @@ class DecodeEngine:
         self._buckets: Dict[int, _Bucket] = {
             t: _Bucket(t, self.n_slots) for t in self.buckets}
         prefill_fn, decode_fn, key = gpt.make_slot_fns(cfg)
-        # one executable pair per (conf, slot-geometry, mesh): the
-        # shapes traced differ only in T_max across buckets, so the
-        # compile count is bounded by 2 x len(buckets); the mesh
-        # signature keeps a sharded engine (or a second device group)
-        # from hitting a replicated engine's executable
-        geo = (self.n_slots, self.prefill_chunk, mesh_signature(mesh))
+        if self.quantize is not None:
+            # dequant fused INTO the jitted programs: the executables
+            # take the quantized tree and stream int8 bytes from HBM
+            base_prefill, base_decode = prefill_fn, decode_fn
+
+            def prefill_fn(params, *a):
+                return base_prefill(qz.dequantize_tree(params), *a)
+
+            def decode_fn(params, *a):
+                return base_decode(qz.dequantize_tree(params), *a)
+        # one executable pair per (conf, slot-geometry, mesh,
+        # quantization mode, kv dtype): the shapes traced differ only in
+        # T_max across buckets, so the compile count is bounded by 2 x
+        # len(buckets) — 4 x with a prefix store, since the page
+        # read/write pair also traces per bucket shape; the mesh signature
+        # keeps a sharded engine (or a second device group) from
+        # hitting a replicated engine's executable, and the quant modes
+        # key their own entries — a dequant-fused program must never be
+        # served to a full-precision engine or vice versa
+        geo = (self.n_slots, self.prefill_chunk, mesh_signature(mesh),
+               self.quantize, self.kv_dtype)
         shard_kw_prefill: Dict[str, Any] = {}
         shard_kw_decode: Dict[str, Any] = {}
+        shard_kw_read: Dict[str, Any] = {}
+        shard_kw_write: Dict[str, Any] = {}
         self._slot_shardings = None
+        self._param_shardings = None
         if mesh is not None:
             from deeplearning4j_tpu.parallel.sharded_fit import \
                 named_shardings
@@ -175,11 +407,17 @@ class DecodeEngine:
                     f"n_heads={cfg.n_heads} not divisible by model "
                     f"degree {m_deg}: the slot KV cache shards over "
                     f"heads (gpt.slot_specs)")
-            psh = named_shardings(mesh, gpt.shard_specs(
-                cfg, model_degree=m_deg))
-            ssh = named_shardings(mesh, gpt.slot_specs(cfg))
+            pspecs = gpt.shard_specs(cfg, model_degree=m_deg)
+            if self.quantize is not None:
+                # int8 leaves keep the fp32 layout; per-channel scales
+                # take the spec entry of the axis they index
+                pspecs = qz.quant_specs(pspecs, self._raw_params(),
+                                        self.quantize)
+            psh = named_shardings(mesh, pspecs)
+            ssh = named_shardings(mesh, gpt.slot_specs(cfg, self.kv_dtype))
             repl = NamedSharding(mesh, P())
             self._slot_shardings = ssh
+            self._param_shardings = psh
             # prefill(params, slots, toks, slot, start, n_valid, temp,
             # seed) / decode(params, slots, active, temps, seeds): only
             # params and the slot state carry a layout
@@ -189,6 +427,16 @@ class DecodeEngine:
             shard_kw_decode = dict(
                 in_shardings=(psh, ssh) + (repl,) * 3,
                 out_shardings=(ssh, repl))
+            # prefix pages [L, T_max, NH, D] shard over heads like the
+            # cache rows they copy; int8 scale pages replicated
+            page_sh = (NamedSharding(mesh, P(None, None, MODEL_AXIS,
+                                             None)),) * 2
+            if self.kv_dtype == "int8":
+                page_sh = page_sh + (repl, repl)
+            shard_kw_read = dict(in_shardings=(ssh, repl),
+                                 out_shardings=page_sh)
+            shard_kw_write = dict(in_shardings=(ssh, repl) + page_sh,
+                                  out_shardings=ssh)
         self._prefill = compile_cache.cached_jit(
             prefill_fn, key=(key, geo, "prefill"),
             label=f"{label}.prefill", donate_argnums=(1,),
@@ -197,11 +445,63 @@ class DecodeEngine:
             decode_fn, key=(key, geo, "step"),
             label=f"{label}.step", donate_argnums=(1,),
             **shard_kw_decode)
+        self._read = self._write = None
+        if self._prefix is not None:
+            self._read = compile_cache.cached_jit(
+                gpt.slot_read_pages, key=(key, geo, "prefix_read"),
+                label=f"{label}.prefix_read", **shard_kw_read)
+            self._write = compile_cache.cached_jit(
+                gpt.slot_write_pages, key=(key, geo, "prefix_write"),
+                label=f"{label}.prefix_write", donate_argnums=(0,),
+                **shard_kw_write)
+        #: KV bytes one slot of the largest bucket costs — the 'slots
+        #: per chip' capacity denominator (int8 KV is the ~4x/2x lever)
+        self.kv_bytes_per_slot = int(gpt.slots_bytes_per_slot(
+            cfg, self.buckets[-1], self.kv_dtype))
+        decode_metrics.note_kv_bytes_per_slot(self.kv_bytes_per_slot)
+        # prefix harvesting is ASYNC: the page read dispatches on the
+        # serving thread (cheap), but the device->host transfer +
+        # store insert run on a harvest worker so they never stall the
+        # in-flight requests' inter-token latency.  Bounded queue,
+        # drop-on-full: harvesting is opportunistic.  The worker is
+        # spawned lazily (and re-spawned after close()).
+        self._harvest_q: Optional["queue.Queue"] = None
+        self._harvest_thread: Optional[threading.Thread] = None
+        if self._prefix is not None:
+            self._harvest_q = queue.Queue(maxsize=4)
 
     # -- params ------------------------------------------------------------
-    def current_params(self) -> Any:
+    def _raw_params(self) -> Any:
         p = self._params
         return p() if callable(p) else p
+
+    def _quantize_and_place(self, raw_tree):
+        raw = jax.device_get(raw_tree) if self.mesh is not None \
+            else raw_tree
+        q = qz.quantize_tree(raw, self.quantize)
+        if self._param_shardings is not None:
+            q = jax.device_put(q, self._param_shardings)
+        return q
+
+    def current_params(self) -> Any:
+        """The params tree the executables take — quantized (and, under
+        a mesh, laid out) when ``quantize`` is set.  STATIC params are
+        quantized once and the engine's reference to the raw fp32 tree
+        is DROPPED (device memory then holds only int8 + scales once
+        the caller releases theirs — the HBM point of the knob).
+        Live-params callables are memoized per raw-tree IDENTITY and
+        re-pay quantization only when they return a new tree object
+        (the post-training contract: weights are frozen while serving;
+        a swap should also ``clear()`` any prefix cache)."""
+        if self.quantize is None:
+            return self._raw_params()
+        if not callable(self._params):
+            if not self._static_quantized:
+                self._params = self._quantize_and_place(self._params)
+                self._static_quantized = True
+            return self._params
+        return self._qmemo.get(self._raw_params(),
+                               self._quantize_and_place)
 
     # -- geometry ----------------------------------------------------------
     def pick_bucket(self, total_len: int) -> int:
@@ -224,7 +524,8 @@ class DecodeEngine:
 
     def _state(self, b: _Bucket):
         if b.slots is None:
-            slots = gpt.init_slots(self.cfg, self.n_slots, b.t_max)
+            slots = gpt.init_slots(self.cfg, self.n_slots, b.t_max,
+                                   kv_dtype=self.kv_dtype)
             if self._slot_shardings is not None:
                 # scatter the fresh cache into its head-sharded layout
                 # up front: the first donated dispatch then aliases the
@@ -233,17 +534,94 @@ class DecodeEngine:
             b.slots = slots
         return b.slots
 
+    # -- prefix harvesting -------------------------------------------------
+    def _ensure_harvester(self) -> None:
+        """(Re)spawn the harvest worker.  The loop closes over ONLY the
+        queue and the store — never the engine — so a dropped engine's
+        device state is collectable even if ``close()`` was skipped."""
+        t = self._harvest_thread
+        if t is not None and t.is_alive():
+            return
+        q, store, space = self._harvest_q, self._prefix, self._prefix_space
+
+        def loop():
+            while True:
+                item = q.get()
+                try:
+                    if item is None:
+                        return
+                    pages, prefix, chunk = item
+                    # the read executable's outputs are fresh buffers
+                    # — independent of the slot state later dispatches
+                    # donate — so fetching them here cannot race the
+                    # serving thread
+                    host = tuple(np.asarray(p)[:, :prefix.size]
+                                 for p in pages)
+                    store.insert(prefix, host, chunk, space)
+                except Exception:   # noqa: BLE001 — opportunistic path
+                    # a failed harvest must never kill the worker: the
+                    # request it served already completed; the prefix
+                    # is simply not cached
+                    pass
+                finally:
+                    q.task_done()
+
+        self._harvest_thread = threading.Thread(
+            target=loop, name="dl4j-prefix-harvest", daemon=True)
+        self._harvest_thread.start()
+
+    def flush_harvests(self) -> None:
+        """Block until every queued prefix harvest is stored.  Serving
+        itself is eventually consistent (a prefix becomes hittable
+        shortly after its cold request); this is for callers — and
+        tests — that need read-your-writes on the store."""
+        if self._harvest_q is not None:
+            self._harvest_q.join()
+
+    def close(self) -> None:
+        """Stop the harvest worker (pending harvests complete first).
+        Serving through the engine keeps working — new harvests simply
+        respawn the worker — so retiring a replica
+        (``ContinuousBatcher.close`` calls this) never leaks a thread
+        pinning the engine's device state."""
+        t = self._harvest_thread
+        if t is not None and t.is_alive():
+            self._harvest_q.put(None)
+            t.join()
+        self._harvest_thread = None
+
+    @staticmethod
+    def _pad_pages(pages: Sequence[np.ndarray], t_max: int):
+        """Zero-pad stored prefix pages [L, m, ...] up to the target
+        bucket's full row length [L, t_max, ...] (host-side: the write
+        executable takes ONE shape per bucket, so a fresh hit length
+        never costs a trace)."""
+        out = []
+        for p in pages:
+            if p.shape[1] == t_max:
+                out.append(np.ascontiguousarray(p))
+            else:
+                buf = np.zeros((p.shape[0], t_max) + p.shape[2:], p.dtype)
+                buf[:, :p.shape[1]] = p
+                out.append(buf)
+        return out
+
     # -- AOT warmup --------------------------------------------------------
     def warmup(self) -> dict:
         """Pre-trace the prefill + decode executables for every bucket
-        (AOT), then reset the slot state — steady-state traffic after
-        this is compile-free for any prompt length / join pattern.
-        Returns {"buckets": n, "compiles": traces, "warmup_ms": wall}."""
+        (AOT; plus the prefix page read/write pair when a prefix store
+        is attached — a HIT must never trace), then reset the slot
+        state — steady-state traffic after this is compile-free for any
+        prompt length / join / prefix-reuse pattern.  Returns
+        {"buckets": n, "compiles": traces, "warmup_ms": wall}."""
         from deeplearning4j_tpu.runtime.metrics import compile_metrics
 
+        labels = [f"{self.label}.prefill", f"{self.label}.step"]
+        if self._prefix is not None:
+            labels += [f"{self.label}.prefix_read",
+                       f"{self.label}.prefix_write"]
         before = sum(
-            compile_metrics.snapshot()["traces"].get(k, 0)
-            for k in (f"{self.label}.prefill", f"{self.label}.step"))
+            compile_metrics.snapshot()["traces"].get(k, 0) for k in labels)
         params = self.current_params()
         t0 = time.perf_counter()
         with telemetry.span("decode.warmup", buckets=len(self.buckets)):
@@ -254,14 +632,16 @@ class DecodeEngine:
                 slots, _ = self._prefill(
                     params, slots, toks, np.int32(0), np.int32(0),
                     np.int32(1), np.float32(0.0), np.uint32(0))
+                if self._prefix is not None:
+                    pages = self._read(slots, np.int32(0))
+                    slots = self._write(slots, np.int32(0), *pages)
                 slots, out = self._decode(
                     params, slots, b.active, b.temps, b.seeds)
                 jax.block_until_ready(out)
                 b.slots = None                  # fresh state for serving
         wall_ms = (time.perf_counter() - t0) * 1e3
         compiles = sum(
-            compile_metrics.snapshot()["traces"].get(k, 0)
-            for k in (f"{self.label}.prefill", f"{self.label}.step")
+            compile_metrics.snapshot()["traces"].get(k, 0) for k in labels
         ) - before
         decode_metrics.mark_compiles()
         return {"buckets": len(self.buckets), "compiles": compiles,
@@ -291,14 +671,28 @@ class DecodeEngine:
         slots = self._state(b)
         C = self.prefill_chunk
         n_chunks = -(-prompt.size // C)
+        hit_len, pages = 0, None
+        if self._prefix is not None:
+            hit = self._prefix.lookup(prompt, C, self._prefix_space)
+            if hit is not None:
+                hit_len, pages = hit
         tr = telemetry.get_tracer()
         sp = tr.span("decode.prefill", bucket=bucket, slot=slot,
-                     prompt_tokens=int(prompt.size), chunks=n_chunks) \
+                     prompt_tokens=int(prompt.size), chunks=n_chunks,
+                     prefix_hit_tokens=hit_len) \
             if tr is not None else telemetry.NOOP_SPAN
         with sp:
             first = None
             try:
-                for c in range(n_chunks):
+                if hit_len:
+                    # copy the cached pages over the slot's rows (zero
+                    # tail past the prefix — see slot_write_pages) and
+                    # pick chunked prefill up at the first uncached
+                    # chunk: the hit skips hit_len positions of prefill
+                    # compute and is bit-exact vs running them
+                    slots = self._write(slots, np.int32(slot),
+                                        *self._pad_pages(pages, b.t_max))
+                for c in range(hit_len // C, n_chunks):
                     lo = c * C
                     n_valid = min(C, prompt.size - lo)
                     chunk = np.zeros((C,), np.int32)
@@ -315,7 +709,33 @@ class DecodeEngine:
                 raise
             b.slots = slots
             first_tok = int(first)              # join-time sync, once
-        decode_metrics.note_prefill(n_chunks)
+        decode_metrics.note_prefill(n_chunks - hit_len // C)
+        if self._prefix is not None:
+            if hit_len:
+                decode_metrics.note_prefix_hit(hit_len)
+                if tr is not None:
+                    tr.event("decode.prefix_hit", bucket=bucket,
+                             slot=slot, tokens_saved=hit_len)
+            else:
+                decode_metrics.note_prefix_miss()
+            m_store = C * ((prompt.size - 1) // C)
+            if m_store > hit_len and m_store >= C:
+                # harvest this prompt's chunk-aligned prefix for later
+                # requests — also on PARTIAL hits, or a growing
+                # conversation would hit only its first turn's prefix
+                # and re-prefill the extension forever.  The page read
+                # dispatches here (pure read — the live slot state is
+                # untouched; its outputs are fresh buffers), but the
+                # device->host fetch + insert run on the harvest
+                # worker so in-flight decode latency never stalls on
+                # the transfer.
+                full = self._read(slots, np.int32(slot))
+                self._ensure_harvester()
+                try:
+                    self._harvest_q.put_nowait(
+                        (full, prompt[:m_store].copy(), C))
+                except queue.Full:
+                    pass            # backpressure: drop, opportunistic
         b.active[slot] = True
         b.temps[slot] = np.float32(temperature)
         b.seeds[slot] = np.uint32(seed)
@@ -591,11 +1011,14 @@ class ContinuousBatcher:
     # -- lifecycle ---------------------------------------------------------
     def close(self, timeout: float = 120.0) -> None:
         """Stop accepting, drain accepted requests to completion, join
-        the worker."""
+        the worker, and stop the engine's prefix-harvest worker (the
+        engine itself stays usable — a new batcher over it respawns
+        harvesting on demand)."""
         with self._cv:
             self._open = False
             self._cv.notify_all()
         self._thread.join(timeout)
+        self.engine.close()
 
     def __enter__(self) -> "ContinuousBatcher":
         return self

@@ -110,26 +110,52 @@ class InferenceEngine:
     replicas compile once.  ``apply_fn`` may also already be an
     engine-wrapped callable (``cached_jit`` result); it is then used
     as-is.
+
+    ``quantize="int8"|"bf16"`` enables post-training weight
+    quantization (runtime/quantize.py): params are quantized ONCE
+    (memoized per raw-tree identity) and the dequant is fused into the
+    jitted forward, which becomes a NEW compile-cache entry keyed on
+    the mode — a quantized replica never hits a full-precision
+    replica's executable.  Accuracy deltas are the caller's contract
+    (``Evaluation.assert_accuracy_within`` is the assertion helper).
     """
 
     def __init__(self, apply_fn: Callable, params: Any = None, *,
                  buckets: Optional[Sequence[int]] = None,
                  max_batch_size: int = DEFAULT_MAX_BATCH,
                  cache_key: Optional[Hashable] = None,
-                 label: str = "serving.forward"):
+                 label: str = "serving.forward",
+                 quantize: Optional[str] = None):
+        from deeplearning4j_tpu.runtime import quantize as qz
+
         self.buckets = tuple(sorted(set(
             buckets if buckets is not None
             else default_buckets(max_batch_size))))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"bad bucket ladder: {self.buckets}")
         self._params = params
+        self.quantize = qz.check_mode(quantize)
+        self._qmemo = qz.QuantMemo()
+        self._static_quantized = False
         #: (per-example shape, dtype) the engine serves — set by
         #: warmup() / the first successful infer; lets front-ends
         #: (DynamicBatcher) reject mismatched requests at submit time
         self.input_spec: Optional[Tuple[Tuple[int, ...], Any]] = None
         if getattr(apply_fn, "engine_label", None) is not None:
+            if self.quantize is not None:
+                raise ValueError(
+                    "quantize= needs a raw apply_fn: an already "
+                    "engine-wrapped callable's traced program cannot "
+                    "be rekeyed on the quantization mode")
             self._forward = apply_fn        # already engine-wrapped
         else:
+            if self.quantize is not None:
+                raw_apply = apply_fn
+
+                def apply_fn(params, x):
+                    return raw_apply(qz.dequantize_tree(params), x)
+                if cache_key is not None:
+                    cache_key = (cache_key, "quantize", self.quantize)
             # donate the padded input (arg 1): engine-owned buffer, fresh
             # per dispatch, never seen again — params (arg 0) serve every
             # request and must survive
@@ -139,10 +165,25 @@ class InferenceEngine:
 
     # -- params ------------------------------------------------------------
     def current_params(self, params: Any = None) -> Any:
-        if params is not None:
-            return params
-        p = self._params
-        return p() if callable(p) else p
+        from deeplearning4j_tpu.runtime import quantize as qz
+
+        if params is None and not callable(self._params):
+            # static params + quantization: quantize once and DROP the
+            # raw fp32 tree — resident memory holds only int8 + scales
+            # once the caller releases theirs
+            if self.quantize is not None and self._params is not None \
+                    and not self._static_quantized:
+                self._params = qz.quantize_tree(self._params,
+                                                self.quantize)
+                self._static_quantized = True
+            return self._params
+        p = self._params if params is None else params
+        if callable(p):
+            p = p()
+        if self.quantize is None or p is None:
+            return p
+        return self._qmemo.get(
+            p, lambda raw: qz.quantize_tree(raw, self.quantize))
 
     # -- AOT warmup --------------------------------------------------------
     def warmup(self, input_shape: Optional[Sequence[int]] = None,

@@ -21,11 +21,33 @@ client's latency.
   so a model bigger than one chip's HBM still replicates for
   throughput.  ``model_degree=1`` (default) is the original one
   -device-per-replica placement.
+
+SERVING TIER 2 closes the telemetry loop the static bound leaves open:
+
+- ``AutoscalePolicy`` is a pure hysteresis state machine over live
+  signals (mean queue depth across replicas, the ``decode_metrics``
+  TTFT p99 reservoir): scale up only after ``up_after`` consecutive
+  hot observations, down only after ``down_after`` cold ones, with a
+  cooldown between actions — so an oscillating load never flaps the
+  fleet.  It is deliberately clock-injected (``observe(..., now=)``)
+  and replica-count-aware, so the tier-1 tests drive it with synthetic
+  load traces.
+- ``AutoscalingRouter`` owns a replica FACTORY instead of a fixed
+  list: it spawns/retires ``ContinuousBatcher`` replicas on the
+  policy's verdicts (a clone's ``warmup()`` hits the shared compile
+  cache — scale-up costs zero new XLA programs), drains retired
+  replicas in the background, and only SHEDS (``shed_by_policy``)
+  when it is already at ``max_replicas`` AND over the depth bound —
+  load that a fixed fleet would reject becomes a scale-up instead.
+  ``max_queue_depth`` is thereby reinterpreted as the per-replica
+  pressure bound that triggers emergency scale-up (MIGRATION.md).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -158,3 +180,320 @@ class Router:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class AutoscalePolicy:
+    """Hysteresis state machine turning live load signals into scale
+    verdicts.  Pure host logic, clock-injected, no I/O — the synthetic
+    load-trace tests drive it directly.
+
+    An observation is HOT when the mean per-replica depth exceeds
+    ``high_depth``, or when the TTFT p99 exceeds ``ttft_p99_slo_ms``
+    (when set) WHILE there is live load (depth >= ``low_depth`` — the
+    p99 reservoir is cumulative, and a past spike must not pin an idle
+    fleet at max); COLD when the depth is under ``low_depth`` (and not
+    hot).
+    ``observe`` returns ``"up"`` only after ``up_after`` CONSECUTIVE
+    hot observations, ``"down"`` after ``down_after`` consecutive cold
+    ones — mixed observations reset both streaks — and never within
+    ``cooldown_s`` of the previous action, so a load oscillating
+    around a threshold holds the fleet steady instead of flapping it.
+    Observations closer than ``interval_s`` apart are ignored (the
+    router calls ``observe`` per submit; the interval turns that into
+    a bounded sampling rate).  Replica bounds are enforced here too:
+    ``"up"`` is never returned at ``max_replicas`` nor ``"down"`` at
+    ``min_replicas``.
+
+    ``ttft_p99_slo_ms`` reads the PROCESS-GLOBAL ``decode_metrics``
+    TTFT reservoir (every counter family in this runtime is a
+    process-wide singleton): with one router per process it is this
+    router's own signal; a process hosting several routers/engines
+    should scale on the depth thresholds, which are always computed
+    from this router's own replicas."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4, *,
+                 high_depth: float = 8.0, low_depth: float = 1.0,
+                 ttft_p99_slo_ms: Optional[float] = None,
+                 up_after: int = 2, down_after: int = 6,
+                 cooldown_s: float = 5.0, interval_s: float = 0.25):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas: "
+                f"{min_replicas}, {max_replicas}")
+        if not 0 < low_depth < high_depth:
+            # low_depth = 0 would make `cold` (depth < low) unreachable
+            # — the fleet could never scale down, and the SLO signal's
+            # live-load guard (depth >= low) would be vacuous at idle
+            raise ValueError(
+                f"need 0 < low_depth < high_depth: "
+                f"{low_depth}, {high_depth}")
+        if up_after < 1 or down_after < 1:
+            raise ValueError("up_after/down_after must be >= 1")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.high_depth = float(high_depth)
+        self.low_depth = float(low_depth)
+        self.ttft_p99_slo_ms = ttft_p99_slo_ms
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self._hot_streak = 0
+        self._cold_streak = 0
+        self._last_obs: Optional[float] = None
+        self._last_action: Optional[float] = None
+
+    def due(self, now: Optional[float] = None) -> bool:
+        """Would :meth:`observe` consider an observation at ``now``?
+        Read-only — the router's hot path checks this BEFORE paying
+        for the metrics snapshot an observation consumes."""
+        now = time.monotonic() if now is None else now
+        return self._last_obs is None \
+            or now - self._last_obs >= self.interval_s
+
+    def observe(self, mean_depth: float,
+                ttft_p99_ms: Optional[float],
+                n_replicas: int,
+                now: Optional[float] = None) -> str:
+        """One load observation -> ``"up"`` / ``"down"`` / ``"hold"``.
+        Not thread-safe on its own; the router serializes calls under
+        its replica lock."""
+        now = time.monotonic() if now is None else now
+        if self._last_obs is not None \
+                and now - self._last_obs < self.interval_s:
+            return "hold"
+        self._last_obs = now
+        # the TTFT signal comes from a CUMULATIVE reservoir, so a past
+        # spike would read hot forever; it only means "add replicas"
+        # while there is live load for them to absorb — an idle fleet
+        # must be able to go cold and scale down after a breach
+        slo_hot = (self.ttft_p99_slo_ms is not None
+                   and ttft_p99_ms is not None
+                   and ttft_p99_ms > self.ttft_p99_slo_ms
+                   and mean_depth >= self.low_depth)
+        hot = mean_depth > self.high_depth or slo_hot
+        cold = not hot and mean_depth < self.low_depth
+        if hot:
+            self._hot_streak += 1
+            self._cold_streak = 0
+        elif cold:
+            self._cold_streak += 1
+            self._hot_streak = 0
+        else:
+            self._hot_streak = self._cold_streak = 0
+        cooled = self._last_action is None \
+            or now - self._last_action >= self.cooldown_s
+        if hot and self._hot_streak >= self.up_after and cooled \
+                and n_replicas < self.max_replicas:
+            self._hot_streak = self._cold_streak = 0
+            self._last_action = now
+            return "up"
+        if cold and self._cold_streak >= self.down_after and cooled \
+                and n_replicas > self.min_replicas:
+            self._hot_streak = self._cold_streak = 0
+            self._last_action = now
+            return "down"
+        return "hold"
+
+
+class AutoscalingRouter(Router):
+    """Least-depth dispatch over a DYNAMIC replica fleet: replicas are
+    spawned from ``factory`` (a zero-arg callable returning a warmed
+    ``ContinuousBatcher``) and retired on the policy's verdicts.
+
+    - every ``submit`` feeds one (rate-limited) observation to the
+      policy and applies its verdict;
+    - a submit finding even the least-loaded replica at
+      ``max_queue_depth`` triggers an EMERGENCY scale-up below
+      ``max_replicas`` (the spawn happens on the submitting thread —
+      later submitters wait on the replica lock rather than pile onto
+      an overloaded fleet) and only sheds (``OverloadedError``, booked
+      as ``shed_by_policy``) once the fleet is at its ceiling;
+    - factory clones share the engine compile cache, so scale-up
+      performs ZERO new XLA compiles after the first replica's warmup
+      (asserted by the bench row);
+    - scale-down pops the newest replica and drains it on a background
+      thread (accepted requests run to completion; ``close()`` joins
+      the drains).
+    """
+
+    def __init__(self, factory: Callable[[], ContinuousBatcher],
+                 policy: Optional[AutoscalePolicy] = None, *,
+                 max_queue_depth: int = 64):
+        self.factory = factory
+        self.policy = policy or AutoscalePolicy()
+        self._lock = threading.RLock()
+        self._drains: List[threading.Thread] = []
+        self._closed = False
+        self._spawning = False
+        super().__init__([factory()
+                          for _ in range(self.policy.min_replicas)],
+                         max_queue_depth=max_queue_depth)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def replicate(cls, *a, **kw):
+        """Not supported: the autoscaling router is built from a
+        replica FACTORY (its constructor), not a fixed replica list —
+        the inherited builder would crash confusingly."""
+        raise TypeError(
+            "AutoscalingRouter.replicate is not supported: construct "
+            "AutoscalingRouter(factory, AutoscalePolicy(...)) with a "
+            "zero-arg factory returning a warmed ContinuousBatcher "
+            "(use Router.replicate for a fixed fleet)")
+
+    # -- scaling -----------------------------------------------------------
+    def n_replicas(self) -> int:
+        with self._lock:
+            return len(self.batchers)
+
+    def depths(self) -> list:
+        with self._lock:
+            batchers = list(self.batchers)
+        return [b.depth() for b in batchers]
+
+    def tick(self, now: Optional[float] = None) -> str:
+        """Feed one observation to the policy and apply its verdict.
+        Called implicitly per submit; callable explicitly (e.g. by a
+        drain loop) so a fleet scales DOWN after traffic stops."""
+        now_v = time.monotonic() if now is None else now
+        with self._lock:
+            # interval gate FIRST: the common per-submit call returns
+            # here without paying for the metrics snapshot (which
+            # sorts the latency reservoirs under the global lock)
+            if self._closed or not self.policy.due(now_v):
+                return "hold"
+            depths = [b.depth() for b in self.batchers]
+            ttft = decode_metrics.snapshot()["ttft_p99_ms"]
+            action = self.policy.observe(
+                sum(depths) / len(depths), ttft, len(self.batchers),
+                now=now_v)
+            if action == "up":
+                self._scale_up_async()
+            elif action == "down":
+                self._scale_down()
+        return action
+
+    def _scale_up_async(self) -> None:
+        """Policy-driven scale-up, OFF the replica lock: the factory's
+        engine build + warmup take real time (device transfers; a cold
+        compile-cache miss takes seconds), and holding the lock through
+        them would stall every concurrent submit — including ones bound
+        for healthy idle replicas.  One spawn in flight at a time; a
+        spawn landing after close() closes its fresh replica instead of
+        leaking it.  (The EMERGENCY path in submit stays synchronous on
+        purpose: there the fleet is over-bound everywhere, and letting
+        submitters pile on is worse than making them wait.)"""
+        # under self._lock
+        if self._spawning:
+            return
+        self._spawning = True
+
+        def spawn():
+            try:
+                b = self.factory()
+            except Exception:
+                with self._lock:
+                    self._spawning = False
+                raise
+            with self._lock:
+                self._spawning = False
+                # re-check BOTH gates at landing time: close() may have
+                # run, and the emergency path may have filled the fleet
+                # to the ceiling while this spawn was building
+                if self._closed \
+                        or len(self.batchers) >= self.policy.max_replicas:
+                    doomed = b
+                else:
+                    self.batchers.append(b)
+                    decode_metrics.note_replicas(added=1)
+                    tr = telemetry.get_tracer()
+                    if tr is not None:
+                        tr.event("decode.scale_up",
+                                 replicas=len(self.batchers),
+                                 reason="policy")
+                    return
+            doomed.close()
+
+        t = threading.Thread(target=spawn, name="dl4j-replica-spawn",
+                             daemon=True)
+        self._drains = [d for d in self._drains if d.is_alive()]
+        self._drains.append(t)      # close() joins spawns like drains
+        t.start()
+
+    def _scale_up(self, reason: str) -> None:
+        # under self._lock.  The factory's engine construction +
+        # warmup() hit the shared compile cache: no new XLA programs.
+        self.batchers.append(self.factory())
+        decode_metrics.note_replicas(added=1)
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            tr.event("decode.scale_up", replicas=len(self.batchers),
+                     reason=reason)
+
+    def _scale_down(self) -> None:
+        # under self._lock; the drained replica finishes its accepted
+        # requests on a background thread
+        b = self.batchers.pop()
+        decode_metrics.note_replicas(removed=1)
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            tr.event("decode.scale_down", replicas=len(self.batchers))
+        t = threading.Thread(target=b.close, name="dl4j-replica-drain",
+                             daemon=True)
+        t.start()
+        # prune finished drains so a long-lived oscillating fleet
+        # doesn't accumulate dead Thread objects without bound
+        self._drains = [d for d in self._drains if d.is_alive()]
+        self._drains.append(t)
+
+    # -- dispatch ----------------------------------------------------------
+    def submit(self, prompt, **kw) -> DecodeRequest:
+        self.tick()
+        while True:
+            with self._lock:
+                if self._closed:
+                    # closing must also stop SCALING: without this a
+                    # racing submit could spawn a fresh replica close()
+                    # never sees, leaking its worker thread
+                    raise RuntimeError("AutoscalingRouter is closed")
+                depths = [b.depth() for b in self.batchers]
+                i = int(np.argmin(depths))
+                if depths[i] >= self.max_queue_depth:
+                    if len(self.batchers) < self.policy.max_replicas:
+                        self._scale_up("pressure")
+                        i = len(self.batchers) - 1
+                    else:
+                        decode_metrics.note_shed(by_policy=True)
+                        tr = telemetry.get_tracer()
+                        if tr is not None:
+                            tr.event("decode.shed", depth=depths[i],
+                                     bound=self.max_queue_depth,
+                                     replicas=len(self.batchers),
+                                     by_policy=True)
+                        raise OverloadedError(depths[i],
+                                              self.max_queue_depth,
+                                              len(self.batchers))
+                target = self.batchers[i]
+            try:
+                return target.submit(prompt, **kw)
+            except RuntimeError:
+                # the chosen replica was scaled down (and closed by its
+                # drain) between our pick and the submit — it is no
+                # longer in self.batchers, so re-pick from the live
+                # fleet rather than leak the replica's closed error to
+                # a client the fleet still has capacity for
+                with self._lock:
+                    if target in self.batchers:
+                        raise       # genuinely closed: router shutdown
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout: float = 120.0) -> None:
+        with self._lock:
+            self._closed = True          # no more submits OR scale-ups
+            batchers = list(self.batchers)
+            drains = list(self._drains)
+        for b in batchers:
+            b.close(timeout)
+        for t in drains:
+            t.join(timeout)

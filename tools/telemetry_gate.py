@@ -23,6 +23,12 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
    lengths — must dispatch only cached programs with the tracer off AND
    on (the decode path's prefill/dispatch spans and join/complete
    events are host-side only);
+6b. the same off/on zero-compile contract for the SERVING TIER 2
+   decode loop: a warmed int8-weight + int8-KV engine with a prefix
+   store must serve a mix of prefix MISSES (which read + store pages)
+   and prefix HITS (which write cached pages into a slot) without a
+   single new program — the dequant-fused executables, the page
+   read/write pair, and every hit length are covered by ``warmup()``;
 7. the same off/on zero-compile contract for a warmed DATA×MODEL fit
    (``models/lm_fit.CausalLM`` on a 2×4 mesh through the sharded_fit
    GSPMD builders): the model-sharded scanned dispatch, its staging
@@ -281,6 +287,75 @@ def _decode_gate(registry, telemetry) -> int:
     return 0
 
 
+def _tier2_decode_gate(registry, telemetry) -> int:
+    """Serving-tier-2 loop gate: a warmed int8-quantized + int8-KV +
+    prefix-cached engine must serve misses (page harvest) and hits
+    (page copy) compile-free with the tracer off AND on."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
+                                                   DecodeEngine)
+
+    cfg = gpt.gpt_tiny(vocab_size=48, max_len=32)
+    params = gpt.init_params(__import__("jax").random.key(0), cfg)
+    eng = DecodeEngine(cfg, params, n_slots=3, buckets=(16, 32),
+                       prefill_chunk=8, quantize="int8",
+                       kv_dtype="int8", prefix_cache=True,
+                       label="gate-tier2")
+    eng.warmup()
+    rng = np.random.RandomState(3)
+    shared = rng.randint(1, 48, size=16).astype(np.int32)
+
+    def mixed_requests(cb, seed):
+        r = np.random.RandomState(seed)
+        handles = []
+        for i in range(6):
+            if i % 2:                     # prefix-sharing requests
+                tail = r.randint(1, 48, size=r.randint(1, 6))
+                prompt = np.concatenate([shared, tail.astype(np.int32)])
+            else:                         # fresh prompts (misses)
+                prompt = r.randint(1, 48, size=r.randint(2, 12))
+            handles.append(cb.submit(prompt, max_tokens=3 + i % 3))
+        for h in handles:
+            h.result(120)
+
+    with ContinuousBatcher(eng, default_max_tokens=4) as cb:
+        mixed_requests(cb, seed=7)        # seed the store
+        eng.flush_harvests()              # async harvests land first
+        registry.mark()
+
+        assert not telemetry.enabled()
+        mixed_requests(cb, seed=8)
+        delta_off = registry.compile_delta_since_mark()
+        if delta_off != 0:
+            print(f"[telemetry-gate] FAIL: tracer-off tier-2 decode "
+                  f"loop compiled {delta_off} new program(s)")
+            return 1
+
+        telemetry.enable("telemetry-gate-tier2")
+        registry.mark()
+        mixed_requests(cb, seed=9)
+        delta_on = registry.compile_delta_since_mark()
+        telemetry.disable()
+        if delta_on != 0:
+            print(f"[telemetry-gate] FAIL: tracer-on tier-2 decode "
+                  f"loop compiled {delta_on} new program(s) — "
+                  "quantized/prefix instrumentation leaked into a "
+                  "jitted region")
+            return 1
+    from deeplearning4j_tpu.runtime.metrics import decode_metrics
+    hits = decode_metrics.snapshot()["prefix_hits"]
+    if hits < 2:
+        print(f"[telemetry-gate] FAIL: tier-2 loop recorded only "
+              f"{hits} prefix hit(s) — the gate did not exercise the "
+              "hit path")
+        return 1
+    print(f"[telemetry-gate] ok: tier-2 decode loop compile_delta "
+          f"off={delta_off} on={delta_on}, {hits} prefix hit(s)")
+    return 0
+
+
 def main() -> int:
     from deeplearning4j_tpu.runtime import telemetry
 
@@ -336,7 +411,10 @@ def main() -> int:
     rc = _model_parallel_gate(registry, telemetry)
     if rc:
         return rc
-    return _decode_gate(registry, telemetry)
+    rc = _decode_gate(registry, telemetry)
+    if rc:
+        return rc
+    return _tier2_decode_gate(registry, telemetry)
 
 
 if __name__ == "__main__":
