@@ -64,7 +64,7 @@ def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
 
 
 def slot_specs(cfg: TransformerConfig,
-               kv_dtype: Optional[str] = None) -> "DecodeSlots":
+               kv_dtype: Optional[str] = None) -> "DecodeSlots":  # jaxlint: disable=spec-without-divisibility-guard — degree-independent; DecodeEngine validates n_heads % model_degree before pinning these specs
     """PartitionSpecs for ``DecodeSlots`` under a model-sharded decode
     engine: the KV cache [L, S, T_max, NH, D] shards its HEAD axis over
     ``model`` (each chip holds only its heads' cache — the serving-side

@@ -100,9 +100,12 @@ def init_params(key: Array, cfg: TransformerConfig) -> PyTree:
     return {"embed": embed, "blocks": blocks}
 
 
-def param_specs(cfg: TransformerConfig) -> PyTree:
+def param_specs(cfg: TransformerConfig) -> PyTree:  # jaxlint: disable=spec-without-divisibility-guard — degree-independent rule tree; shard_specs is the validated degree-parameterized entry point
     """PartitionSpec rules: TP over `model` (heads / ffn), everything else
-    replicated over `data`/`seq`.  Matches init_params layout exactly."""
+    replicated over `data`/`seq`.  Matches init_params layout exactly.
+    Degree-independent by design — ``shard_specs`` layers the
+    divisibility validation on top and is the entry point every
+    degree-parameterized caller (sharded fit, decode engine) uses."""
     m = MODEL_AXIS
     embed = {"tok": P(None, None), "pos": P(None, None), "type": P(None, None),
              "ln_g": P(None), "ln_b": P(None)}

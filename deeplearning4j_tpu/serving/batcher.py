@@ -208,8 +208,11 @@ class DynamicBatcher:
                                             sync=True, count_request=False)
                     # materialize once, leaf-wise: single-array models
                     # resolve to np arrays, pytree outputs keep their
-                    # structure with each leaf row-sliced per request
-                    out = jax.tree.map(np.asarray, out)
+                    # structure with each leaf row-sliced per request —
+                    # host numpy results ARE this batcher's contract
+                    # (module docstring), and nothing else waits on this
+                    # thread while it fetches
+                    out = jax.tree.map(np.asarray, out)  # jaxlint: disable=host-sync-on-serving-worker — resolved futures carry host numpy by contract
                 except Exception as e:      # resolve, never wedge clients
                     for r in batch:
                         if not r.future.set_running_or_notify_cancel():

@@ -476,8 +476,15 @@ class DecodeEngine:
         return p() if callable(p) else p
 
     def _quantize_and_place(self, raw_tree):
-        raw = jax.device_get(raw_tree) if self.mesh is not None \
-            else raw_tree
+        # one-time full-tree fetch PER PARAMS TREE (memoized by QuantMemo
+        # / the static flag): quantization is already a full-tree host
+        # pass, and a weight swap must re-quantize before the next
+        # dispatch can run anyway — steady state returns the memo and
+        # never reaches this line
+        if self.mesh is not None:
+            raw = jax.device_get(raw_tree)  # jaxlint: disable=host-sync-on-serving-worker — once per params tree, memoized; not a steady-state fetch
+        else:
+            raw = raw_tree
         q = qz.quantize_tree(raw, self.quantize)
         if self._param_shardings is not None:
             q = jax.device_put(q, self._param_shardings)
@@ -555,7 +562,7 @@ class DecodeEngine:
                     # — independent of the slot state later dispatches
                     # donate — so fetching them here cannot race the
                     # serving thread
-                    host = tuple(np.asarray(p)[:, :prefix.size]
+                    host = tuple(np.asarray(p)[:, :prefix.size]  # jaxlint: disable=host-sync-on-serving-worker — the harvest worker EXISTS to absorb this fetch off the decode thread
                                  for p in pages)
                     store.insert(prefix, host, chunk, space)
                 except Exception:   # noqa: BLE001 — opportunistic path
@@ -762,7 +769,10 @@ class DecodeEngine:
                 b.slots = None                  # donated into the failure
                 raise
             b.slots = slots
-            toks = np.asarray(out)              # the per-step stream sync
+            # the per-step stream sync: each active request's next token
+            # must land on host to stream — this ONE [S]-int fetch per
+            # dispatch is the product, not a stall
+            toks = np.asarray(out)  # jaxlint: disable=host-sync-on-serving-worker — the per-step token fetch IS the stream
         decode_metrics.note_decode_dispatch(n_act, self.n_slots)
         return toks
 

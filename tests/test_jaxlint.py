@@ -1695,3 +1695,541 @@ def test_refused_save_does_not_leak_in_flight_gauge():
             ck.save(1, {"w": np.ones((4,), np.float32)})
         after = checkpoint_metrics.snapshot()["in_flight"]
         assert after == before
+
+
+# ---------------------------------------------------------------------------
+# PR 15: distributed-protocol family
+# ---------------------------------------------------------------------------
+
+def test_registry_ships_three_new_families():
+    distributed = {"cluster-sync-in-divergent-branch",
+                   "uncommitted-coordinator-write"}
+    sharding = {"unknown-axis-in-partition-spec",
+                "spec-without-divisibility-guard"}
+    stability = {"unstable-cache-key", "host-sync-on-serving-worker"}
+    assert distributed | sharding | stability <= set(REGISTRY)
+    assert len(REGISTRY) >= 17
+    for name in distributed:
+        assert REGISTRY[name].family == "distributed-protocol"
+    for name in sharding:
+        assert REGISTRY[name].family == "sharding-layout"
+    for name in stability:
+        assert REGISTRY[name].family == "compile-stability"
+
+
+def test_cli_list_rules_shows_new_families(capsys):
+    assert jaxlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for header in ("distributed-protocol:", "sharding-layout:",
+                   "compile-stability:"):
+        assert header in out
+    for name in ("cluster-sync-in-divergent-branch",
+                 "uncommitted-coordinator-write",
+                 "unknown-axis-in-partition-spec",
+                 "spec-without-divisibility-guard",
+                 "unstable-cache-key", "host-sync-on-serving-worker"):
+        assert name in out
+
+
+def test_cluster_sync_flags_coordinator_gated_barrier():
+    src = '''
+    def save(cl, files):
+        if cl.is_coordinator:
+            cl.barrier("commit")
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == [4]
+
+
+def test_cluster_sync_flags_divergent_early_return():
+    """The divergent coordinator-only commit path the PR 14 review
+    caught by hand: a non-coordinator early return makes every later
+    statement coordinator-only."""
+    src = '''
+    def commit(cl, step):
+        if not cl.is_coordinator:
+            return
+        cl.barrier("commit")
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == [5]
+
+
+def test_cluster_sync_flags_except_handler_and_heartbeat_taint():
+    src = '''
+    def recover(cl, hb, path):
+        try:
+            write(path)
+        except OSError:
+            cl.barrier("retry")
+        stale = hb.stale_members()
+        if stale:
+            cl.any_flag(True)
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == [6, 9]
+
+
+def test_cluster_sync_flags_divergent_shrink_and_mutation_taint():
+    """A receiver mutated with a divergent argument is tainted:
+    ``lost.update(hb.lost_device_ids())`` forks the shrink."""
+    src = '''
+    def heal(cl, hb, err):
+        lost = set(cl.agree_lost_ids(err.lost_ids))
+        lost.update(hb.lost_device_ids())
+        members = list(lost)
+        if members:
+            new = cl.shrink(members)
+        return new
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == [7]
+
+
+def test_cluster_sync_sanctioned_commit_shape_passes():
+    """The runtime/checkpoint.py::_save_cluster shape: gather + gated
+    WRITES + unconditional barriers, coordinator-only gc, and the
+    non-coordinator manifest read — no finding from either
+    distributed-protocol rule."""
+    src = '''
+    def _save_cluster(self, cl, step, tree, meta):
+        mine = save_pytree(self._path(step), tree, meta)
+        tables = cl.gather("crcs", "ckptcrc")
+        files = (collect(tables) if cl.is_coordinator else {})
+        cl.barrier("ckpt_data")
+        if cl.is_coordinator:
+            self._commit_manifest(step, files)
+        cl.barrier("ckpt_commit")
+        if cl.is_coordinator:
+            self._gc()
+        if not cl.is_coordinator:
+            files = read_manifest(self._manifest_path(step))
+        return files
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == []
+    assert only(src, "uncommitted-coordinator-write") == []
+
+
+def test_cluster_sync_post_agreement_decision_passes():
+    """Branching on a value that FLOWED THROUGH a cluster primitive is
+    the sanctioned pattern (the host-level post-psum rule)."""
+    src = '''
+    def drain(cl, flag):
+        stop = cl.any_flag(flag)
+        if stop:
+            cl.barrier("drain")
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == []
+
+
+def test_cluster_sync_suppression():
+    src = '''
+    def heal(cl, hb):
+        stale = hb.stale_members()
+        if stale:
+            new = cl.shrink(stale)  # jaxlint: disable=cluster-sync-in-divergent-branch — fixture
+        return new
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == []
+
+
+def test_coordinator_write_flags_ungated_manifest_and_gc():
+    src = '''
+    def save(self, cl, step, files):
+        cl.barrier("data")
+        self._commit_manifest(step, files)
+        self._gc()
+    '''
+    assert only(src, "uncommitted-coordinator-write") == [4, 5]
+
+
+def test_coordinator_write_gated_forms_pass():
+    """if-gate, not-coordinator early return, and the coordinator arm
+    of a ternary all count as gated; a function with NO cluster
+    rendezvous (the single-host save path) is out of scope."""
+    src = '''
+    def save_a(self, cl, step, files):
+        cl.barrier("data")
+        if cl.is_coordinator:
+            self._commit_manifest(step, files)
+
+    def save_b(self, cl, step, files):
+        cl.barrier("data")
+        if not cl.is_coordinator:
+            return
+        self._gc()
+
+    def save_c(self, cl, step, files):
+        cl.barrier("data")
+        out = (self._commit_manifest(step, files)
+               if cl.is_coordinator else None)
+        return out
+
+    def save_single(self, step, files):
+        self._commit_manifest(step, files)
+        self._gc()
+    '''
+    assert only(src, "uncommitted-coordinator-write") == []
+
+
+def test_coordinator_write_suppression():
+    src = '''
+    def save(self, cl, step, files):
+        cl.barrier("data")
+        self._commit_manifest(step, files)  # jaxlint: disable=uncommitted-coordinator-write — fixture
+    '''
+    assert only(src, "uncommitted-coordinator-write") == []
+
+
+# ---------------------------------------------------------------------------
+# PR 15: sharding-layout family
+# ---------------------------------------------------------------------------
+
+MODELS_PATH = "deeplearning4j_tpu/models/fixture.py"
+
+
+def test_partition_spec_flags_unknown_axis():
+    src = '''
+    from jax.sharding import PartitionSpec as P
+
+    SPEC = P(None, "modle")
+    OTHER = P(("data", "mdl"), None)
+    '''
+    assert only(src, "unknown-axis-in-partition-spec",
+                path=MODELS_PATH) == [4, 5]
+
+
+def test_partition_spec_resolves_constants_aliases_and_vocab():
+    """Vocabulary literals, the mesh axis constants THROUGH the import,
+    local aliases (incl. the IfExp idiom), and module-bound custom
+    axes all pass; unresolvable entries stay silent."""
+    src = '''
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS, DATA_AXIS
+
+    def specs(cfg, model_degree=1, axis=None):
+        m = MODEL_AXIS if model_degree > 1 else None
+        return {"w": P(None, m), "b": P(DATA_AXIS), "x": P("seq"),
+                "caller": P(axis)}
+
+    MESH = Mesh(devs, ("rows",))
+    BOUND = P("rows", None)
+    '''
+    assert only(src, "unknown-axis-in-partition-spec",
+                path=MODELS_PATH) == []
+
+
+def test_partition_spec_scope_and_suppression():
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    SPEC = P("bogus")
+    '''
+    # out of the layout scope: nothing fires
+    assert only(src, "unknown-axis-in-partition-spec",
+                path="deeplearning4j_tpu/nn/fixture.py") == []
+    sup = '''
+    from jax.sharding import PartitionSpec as P
+    SPEC = P("bogus")  # jaxlint: disable=unknown-axis-in-partition-spec — fixture
+    '''
+    assert only(sup, "unknown-axis-in-partition-spec",
+                path=MODELS_PATH) == []
+
+
+def test_divisibility_guard_flags_unguarded_model_factory():
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+    def shard_specs(cfg, model_degree=1):
+        return {"w1": P(None, MODEL_AXIS), "b1": P(MODEL_AXIS)}
+    '''
+    assert only(src, "spec-without-divisibility-guard",
+                path=MODELS_PATH) == [5]
+
+
+def test_divisibility_guard_modulo_and_delegation_pass():
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+    from deeplearning4j_tpu.models import transformer as tfm
+
+    def shard_specs(cfg, model_degree=1):
+        if cfg.n_heads % model_degree:
+            raise ValueError("n_heads not divisible")
+        return {"w": P(None, MODEL_AXIS)}
+
+    def other_specs(cfg, model_degree=1):
+        specs = tfm.shard_specs(cfg, model_degree)
+        specs["extra"] = P(MODEL_AXIS)
+        return specs
+
+    def data_specs(cfg):
+        return {"x": P("data", None)}
+    '''
+    assert only(src, "spec-without-divisibility-guard",
+                path=MODELS_PATH) == []
+
+
+def test_divisibility_guard_def_line_suppression():
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+    def slot_specs(cfg):  # jaxlint: disable=spec-without-divisibility-guard — engine validates at construction
+        return {"k": P(None, MODEL_AXIS)}
+    '''
+    assert only(src, "spec-without-divisibility-guard",
+                path=MODELS_PATH) == []
+
+
+# ---------------------------------------------------------------------------
+# PR 15: compile-stability family
+# ---------------------------------------------------------------------------
+
+def test_unstable_key_flags_planted_impurities():
+    """The planted unstable-key fixture: id(), time.*, uuid, and the
+    two f-string forms all defeat the zero-compile invariant."""
+    src = '''
+    import time
+    import uuid
+    from deeplearning4j_tpu.runtime import compile_cache
+
+    def build(fn, params, ms):
+        a = compile_cache.cached_jit(fn, key=("step", id(params)))
+        b = compile_cache.get_or_build((time.time(), "x"), fn)
+        c = compile_cache.cached_jit(fn, label=f"step[{params!r}]")
+        d = compile_cache.cached_jit(fn, key=(uuid.uuid4(), "y"))
+        e = compile_cache.cached_jit(fn, label=f"t{ms:.1f}")
+        return a, b, c, d, e
+    '''
+    assert only(src, "unstable-cache-key") == [7, 8, 9, 10, 11]
+
+
+def test_unstable_key_stable_forms_pass():
+    src = '''
+    from deeplearning4j_tpu.runtime import compile_cache
+
+    def build(fn, conf_json, mesh_sig, i):
+        a = compile_cache.cached_jit(
+            fn, key=("backprop", conf_json, mesh_sig),
+            label=f"multilayer.gd[{i}]")
+        b = compile_cache.get_or_build(("serving", conf_json), fn)
+        return a, b
+    '''
+    assert only(src, "unstable-cache-key") == []
+
+
+def test_unstable_key_suppression():
+    src = '''
+    from deeplearning4j_tpu.runtime import compile_cache
+
+    def build(fn, params):
+        return compile_cache.cached_jit(fn, key=("k", id(params)))  # jaxlint: disable=unstable-cache-key — fixture
+    '''
+    assert only(src, "unstable-cache-key") == []
+
+
+SERVING_PATH = "deeplearning4j_tpu/serving/fixture.py"
+
+
+def test_serving_worker_flags_syncs_in_worker_closure():
+    src = '''
+    import threading
+    import numpy as np
+
+    class Batcher:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            self._drain()
+
+        def _drain(self):
+            out = self._dispatch()
+            toks = np.asarray(out)
+            score = out.item()
+            return toks, score
+    '''
+    assert only(src, "host-sync-on-serving-worker",
+                path=SERVING_PATH) == [14, 15]
+
+
+def test_serving_worker_cross_class_attribution_via_typed_attr():
+    """The decode shape: the batcher worker drives the engine through a
+    typed attribute, so the ENGINE method's fetch is attributed to the
+    worker thread — and the two-arg np.asarray normalization idiom
+    stays clean."""
+    src = '''
+    import threading
+    import numpy as np
+
+    class Engine:
+        def advance(self):
+            out = self._decode()
+            return np.asarray(out)
+
+        def start(self, prompt):
+            prompt = np.asarray(prompt, np.int32)
+            return self._prefill(prompt)
+
+    class Batcher:
+        def __init__(self, engine: Engine):
+            self.engine = engine
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            self.engine.start([1])
+            self.engine.advance()
+
+        def submit(self, x):
+            return np.asarray(x)
+    '''
+    # only Engine.advance's single-arg fetch fires: start's dtype
+    # normalization and the CLIENT-side submit stay clean
+    assert only(src, "host-sync-on-serving-worker",
+                path=SERVING_PATH) == [8]
+
+
+def test_serving_worker_local_thread_target_and_bare_reference():
+    src = '''
+    import threading
+    import numpy as np
+    import jax
+
+    class Engine:
+        def _ensure(self):
+            q = self._q
+
+            def loop():
+                item = q.get()
+                return np.asarray(item)
+
+            threading.Thread(target=loop).start()
+
+    class Batcher:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            out = self._dispatch()
+            return jax.tree.map(np.asarray, out)
+    '''
+    assert only(src, "host-sync-on-serving-worker",
+                path=SERVING_PATH) == [12, 22]
+
+
+def test_serving_worker_scope_and_suppression():
+    src = '''
+    import threading
+    import numpy as np
+
+    class Batcher:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            return np.asarray(self._dispatch())
+    '''
+    # outside serving/: the rule does not apply
+    assert only(src, "host-sync-on-serving-worker",
+                path="deeplearning4j_tpu/nn/fixture.py") == []
+    sup = '''
+    import threading
+    import numpy as np
+
+    class Batcher:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            return np.asarray(self._dispatch())  # jaxlint: disable=host-sync-on-serving-worker — fixture
+    '''
+    assert only(sup, "host-sync-on-serving-worker",
+                path=SERVING_PATH) == []
+
+
+def test_jaxlint_package_typechecks_under_mypy():
+    """The linter that gates CI should not itself be type-unsound:
+    mypy over tools/jaxlint with the committed zero-error config.
+    Skips where mypy is not installed (the container gates it the same
+    way in tools/ci.sh)."""
+    if importlib.util.find_spec("mypy") is None:
+        pytest.skip("mypy not installed")
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--config-file",
+         str(REPO_ROOT / "tools" / "jaxlint" / "mypy.ini"),
+         str(REPO_ROOT / "tools" / "jaxlint")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_ci_runs_the_typecheck_and_jobs_gates():
+    """tools/ci.sh runs the grown analyzer with --jobs + --format json
+    and the (gated) mypy pass over the analyzer package."""
+    text = (REPO_ROOT / "tools" / "ci.sh").read_text()
+    assert "--format json" in text
+    assert "--jobs" in text
+    assert "mypy" in text
+
+
+# ---------------------------------------------------------------------------
+# PR 15 review hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_cluster_sync_branch_local_kill_keeps_taint():
+    """A kill inside ONE conditional branch must not clear the taint
+    for hosts that took the other path: branches scan taint copies and
+    the parent keeps the union."""
+    src = '''
+    def f(cl, hb, cond):
+        stale = hb.stale_members()
+        if cond:
+            stale = ()
+        if stale:
+            cl.barrier("x")
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == [7]
+
+
+def test_cluster_sync_loop_local_break_is_not_an_early_exit():
+    """A break absorbed by a loop nested INSIDE the divergent branch
+    exits that loop, not the enclosing suite — the barrier after the
+    branch is reached by every host."""
+    src = '''
+    def f(cl, hb, items):
+        if hb.stale_members():
+            for i in items:
+                break
+        cl.barrier("x")
+    '''
+    assert only(src, "cluster-sync-in-divergent-branch") == []
+
+
+def test_coordinator_write_and_composed_negation_is_not_a_gate():
+    """`if not cl.is_coordinator and fast: return` lets a
+    non-coordinator with fast=False through — the write after it is
+    NOT coordinator-only (only the True classification propagates
+    through `and`)."""
+    src = '''
+    def save(self, cl, step, files, fast):
+        cl.barrier("data")
+        if not cl.is_coordinator and fast:
+            return
+        self._commit_manifest(step, files)
+    '''
+    assert only(src, "uncommitted-coordinator-write") == [6]
+
+
+def test_partition_spec_param_shadows_module_binding():
+    """A function parameter sharing a name with a module binding is
+    the CALLER's value — statically unknowable, so it stays silent;
+    the module-scope use of the same binding still resolves and
+    flags."""
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    M = "modle"
+
+    def f(M):
+        return P(None, M)
+
+    SPEC = P(None, M)
+    '''
+    assert only(src, "unknown-axis-in-partition-spec",
+                path=MODELS_PATH) == [8]

@@ -209,6 +209,60 @@ def test_cluster_device_map_and_owners():
                                         1: (4, 5, 6, 7)}
 
 
+def test_host_loss_agreement_unions_heartbeat_views():
+    """Regression for the jaxlint cluster-sync-in-divergent-branch
+    harvest (PR 15): members with DIFFERENT local heartbeat findings
+    must still agree on the SAME lost set.  The whole local view
+    (dispatch-reported ids + this member's heartbeat findings) is
+    published INTO the agreement round — the previous shape agreed on
+    the dispatch ids alone and unioned the heartbeat findings locally
+    AFTER, so a member whose shared-fs view lagged computed a smaller
+    lost set than its peers, and a divergent lost set is a divergent
+    shrink(): a generation fork whose next rendezvous deadlocks."""
+    from types import SimpleNamespace
+
+    kv = mh.InProcessKV()
+    dmap = {0: (0, 1), 1: (2, 3), 2: (4, 5)}
+    cls = [mh.Cluster(p, (0, 1, 2), kv, timeout_s=10, device_map=dmap)
+           for p in range(3)]
+
+    class _HB:
+        """Stub heartbeat: member 2 reads stale on both survivors, but
+        only member 0's filesystem view has its device ids yet."""
+
+        def __init__(self, cluster, lost):
+            self.cluster = cluster
+            self._lost = tuple(lost)
+
+        def stale_members(self):
+            return (2,)
+
+        def lost_device_ids(self):
+            return self._lost
+
+    results = [None] * 2
+
+    def run(i):
+        fit = ResilientFit.__new__(ResilientFit)
+        fit.cluster = cls[i]
+        fit._heartbeat = _HB(cls[i], (4, 5) if i == 0 else ())
+        fit.config = SimpleNamespace(cluster_timeout_s=10)
+        fit.manager = SimpleNamespace(cluster=cls[i])
+        err = DeviceLossError((4,) if i == 0 else ())
+        results[i] = (fit._host_loss_update(err), fit.cluster)
+
+    _threads(run, 2)
+    (lost0, ev0), c0 = results[0]
+    (lost1, ev1), c1 = results[1]
+    assert not ev0 and not ev1
+    # identical agreed union on BOTH survivors — member 1 learned
+    # device 5 from member 0's published view, not from its own (lagged)
+    # heartbeat read
+    assert lost0 == lost1 == (4, 5)
+    assert c0.members == c1.members == (0, 1)
+    assert c0.generation == c1.generation == 1
+
+
 # -- cluster-committed checkpoints ------------------------------------------
 
 def _tree(scale=1.0):

@@ -26,7 +26,20 @@ cd "$REPO"
 # annotations; the exit code contract is identical to text mode.
 echo "[ci] jaxlint"
 python -m tools.jaxlint deeplearning4j_tpu bench.py tools \
-  --format json || exit 1
+  --format json --jobs 4 || exit 1
+
+# The analyzer's own type soundness: the linter that gates CI should
+# not itself be type-unsound.  Zero-error config committed at
+# tools/jaxlint/mypy.ini; gated on availability because the container
+# image does not bake mypy in (no ad-hoc installs in CI — the tier-1
+# test test_jaxlint_package_typechecks_under_mypy skips the same way).
+echo "[ci] jaxlint type-check"
+if python -c "import mypy" 2>/dev/null; then
+  python -m mypy --config-file tools/jaxlint/mypy.ini tools/jaxlint \
+    || exit 1
+else
+  echo "[ci] mypy not installed — skipping analyzer type-check"
+fi
 
 # Telemetry overhead gate: a tracer-off AND a tracer-on fit must show
 # compile_delta_since_mark == 0 (the span tracer is host-side only and

@@ -395,7 +395,9 @@ def resolve_axis_literal(expr: ast.AST, tree: ast.Module,
         return None                 # bound elsewhere; trust the exporter
     # innermost enclosing function that declares it as a parameter wins
     for fn in reversed(enclosing):
-        a = fn.args
+        a = getattr(fn, "args", None)
+        if a is None:
+            continue
         params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
         if name in params:
             d = _default_for_param(fn, name)
@@ -421,8 +423,9 @@ def resolve_axis_literal(expr: ast.AST, tree: ast.Module,
                 stack.append(c)
 
     scopes = [list(tree.body)]
-    scopes += [list(fn.body) for fn in enclosing if hasattr(fn, "body")
-               and isinstance(fn.body, list)]
+    scopes += [list(b) for b in (getattr(fn, "body", None)
+                                 for fn in enclosing)
+               if isinstance(b, list)]
     for body in scopes:
         for node in _own_scope_nodes(body):
             if isinstance(node, ast.Assign) \
@@ -706,3 +709,506 @@ def enclosing_class(tree: ast.Module) -> Dict[int, ast.ClassDef]:
 
     visit(tree, None)
     return out
+
+
+# ---------------------------------------------------------------------------
+# per-HOST divergence analysis (cluster-sync-in-divergent-branch,
+# uncommitted-coordinator-write) — the host-level mirror of the
+# per-replica taint above: the multi-host control plane
+# (parallel/multihost.Cluster) is SPMD over PROCESSES the same way the
+# mesh is SPMD over replicas, and the same class of bug applies — a
+# rendezvous reachable only under state that differs per host (being
+# the coordinator, a local exception, a local heartbeat finding) is a
+# cross-host deadlock.
+# ---------------------------------------------------------------------------
+
+#: Cluster control-plane operations every member must reach together.
+#: barrier/any_flag/gather/agree_lost_ids are KV rendezvous; shrink is
+#: a generation change — a member that shrinks while a peer does not
+#: namespaces itself away from every later rendezvous, which is the
+#: same deadlock one hop later.
+CLUSTER_SYNC_OPS = {"barrier", "any_flag", "gather", "agree_lost_ids",
+                    "shrink"}
+
+#: attribute reads that differ per host BY DEFINITION
+HOST_DIVERGENT_ATTRS = {"is_coordinator"}
+#: identity reads that differ per host when branched on
+HOST_ID_ATTRS = {"process_id", "process_index", "member_rank"}
+#: calls whose RESULT is a local (heartbeat/topology) finding — each
+#: host's filesystem view of its peers, not an agreed value
+HOST_FINDING_FNS = {"stale_members", "lost_device_ids"}
+
+#: receivers a bare ``.gather(...)`` must hang off to count as a
+#: Cluster op — ``gather`` alone is too generic (lax.gather is an
+#: array op); the other four op names are unambiguous.
+_CLUSTERISH_RECEIVERS = {"cl", "cluster", "survivors"}
+
+
+def _receiver_name(func: ast.Attribute) -> Optional[str]:
+    """Leaf identifier of a call receiver: ``cl.barrier`` -> ``cl``,
+    ``self.cluster.barrier`` -> ``cluster``."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return value.id
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    return None
+
+
+def is_cluster_sync_call(call: ast.Call) -> bool:
+    """Is this an ``X.barrier()``/``X.any_flag()``/... control-plane
+    rendezvous?  ``gather`` additionally requires a cluster-ish
+    receiver name so ``lax.gather`` never matches."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    op = call.func.attr
+    if op not in CLUSTER_SYNC_OPS:
+        return False
+    if op == "gather":
+        recv = _receiver_name(call.func)
+        return recv is not None and (
+            recv in _CLUSTERISH_RECEIVERS or recv.endswith("cluster"))
+    return True
+
+
+def host_divergent_read(expr: ast.AST, taint: Set[str]) -> Optional[str]:
+    """First per-host-divergent thing the expression reads, as a human
+    label — an ``.is_coordinator`` read, a process-identity read, a
+    heartbeat finding, ``jax.process_index()``, or a name tainted by
+    one of those — else None."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            if node.attr in HOST_DIVERGENT_ATTRS:
+                return node.attr
+            if node.attr in HOST_ID_ATTRS:
+                return node.attr
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None:
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf in HOST_FINDING_FNS or leaf == "process_index":
+                    return f"{leaf}()"
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in taint:
+            return node.id
+    return None
+
+
+def is_coordinator_test(expr: ast.AST) -> Optional[bool]:
+    """Classify a branch test as a COORDINATOR gate: True for a test
+    that can only be true ON the coordinator (``cl.is_coordinator``,
+    possibly ``and``-composed), False for a test that can only be
+    FALSE on the coordinator (``not cl.is_coordinator``, ``not (cl
+    .is_coordinator and x)``), None for anything else.  Only the True
+    classification propagates through ``and``: ``not cl.is_coordinator
+    and fast`` is NOT a full non-coordinator gate — a non-coordinator
+    with ``fast`` false fails the test too, so the false branch is not
+    coordinator-only."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "is_coordinator":
+        return True
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        if is_coordinator_test(expr.operand) is True:
+            return False
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.And):
+        # test-true implies EVERY conjunct true, so one coordinator
+        # conjunct makes the whole test coordinator-only; the False
+        # classification must not propagate (see docstring)
+        for v in expr.values:
+            if is_coordinator_test(v) is True:
+                return True
+    return None
+
+
+#: nodes that open a new scope — subtree walks stop at them
+SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+               ast.Lambda)
+
+
+def walk_no_scopes(node: ast.AST):
+    """Walk a subtree without descending into nested function/class
+    bodies — a nested def under a branch is not executed by it."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, SCOPE_NODES):
+                stack.append(child)
+
+
+def walk_own_body(fn):
+    """Walk a function's OWN body, nested scopes excluded.  Unlike
+    :func:`walk_no_scopes` starting from each statement, a nested def
+    that is itself a direct body statement is yielded but never
+    descended into."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def can_exit_suite(stmts: List[ast.stmt]) -> bool:
+    """Whether executing these statements can leave the ENCLOSING suite
+    early: a ``return``/``raise`` anywhere in their own scope, or a
+    ``break``/``continue`` not already absorbed by a loop nested
+    WITHIN them (a ``break`` inside an inner ``for`` exits that loop,
+    not the suite)."""
+    def walk(node: ast.AST, in_loop: bool) -> bool:
+        if isinstance(node, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return not in_loop
+        if isinstance(node, SCOPE_NODES):
+            return False
+        loop = in_loop or isinstance(node, (ast.For, ast.AsyncFor,
+                                            ast.While))
+        return any(walk(c, loop) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s, False) for s in stmts)
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec literal extraction (unknown-axis-in-partition-spec,
+# spec-without-divisibility-guard)
+# ---------------------------------------------------------------------------
+
+#: the canonical axis-constant names ``parallel/mesh.py`` exports —
+#: models spell their specs with these (``P(None, MODEL_AXIS)``), so
+#: resolving them is resolving the repo's own vocabulary, not guessing
+#: at a foreign import
+AXIS_CONSTANT_NAMES = {"DATA_AXIS": "data", "MODEL_AXIS": "model",
+                       "PIPE_AXIS": "pipe", "SEQ_AXIS": "seq",
+                       "EXPERT_AXIS": "expert"}
+
+
+def partition_spec_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to ``jax.sharding.PartitionSpec`` by import
+    (``from jax.sharding import PartitionSpec as P`` — the repo-wide
+    spelling).  ``PartitionSpec`` itself is always accepted."""
+    out = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    out.add(a.asname or a.name)
+    return out
+
+
+def partition_spec_calls(tree: ast.Module) -> List[ast.Call]:
+    """Every ``P(...)``/``PartitionSpec(...)`` call in the module."""
+    aliases = partition_spec_aliases(tree)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf == "PartitionSpec" or name in aliases:
+            out.append(node)
+    return out
+
+
+def partition_spec_entries(call: ast.Call) -> List[ast.AST]:
+    """The axis-entry expressions of a PartitionSpec literal, with
+    tuple entries flattened (``P(("data", "model"), None)`` yields both
+    names).  Starred entries are skipped — unresolvable by design."""
+    out: List[ast.AST] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            continue
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            out.extend(e for e in arg.elts
+                       if not isinstance(e, ast.Starred))
+        else:
+            out.append(arg)
+    return out
+
+
+def _axis_const_values(expr: ast.AST) -> Optional[Set[str]]:
+    """Literal axis value(s) of an expression built from string
+    constants, ``None``, the mesh axis-constant names, and ``IfExp``
+    combinations of those (``MODEL_AXIS if deg > 1 else None``) — None
+    when any part is opaque."""
+    if isinstance(expr, ast.Constant):
+        if expr.value is None:
+            return set()
+        if isinstance(expr.value, str):
+            return {expr.value}
+        return None
+    if isinstance(expr, ast.Name) and expr.id in AXIS_CONSTANT_NAMES:
+        return {AXIS_CONSTANT_NAMES[expr.id]}
+    if isinstance(expr, ast.IfExp):
+        a = _axis_const_values(expr.body)
+        b = _axis_const_values(expr.orelse)
+        if a is None or b is None:
+            return None
+        return a | b
+    return None
+
+
+def resolve_axis_entry(expr: ast.AST, tree: ast.Module,
+                       enclosing: List[ast.AST]) -> Optional[Set[str]]:
+    """Resolve one PartitionSpec entry to its axis-name value(s):
+    ``None`` entries resolve to the empty set, string literals and the
+    mesh axis constants to their names, a local alias (``m =
+    MODEL_AXIS``, including through an ``IfExp``) through the enclosing
+    scopes, anything else through :func:`resolve_axis_literal`.
+    Returns None when statically unknowable."""
+    direct = _axis_const_values(expr)
+    if direct is not None:
+        return direct
+    if isinstance(expr, ast.Name):
+        # a PARAMETER of an enclosing function shadows any same-named
+        # module binding: the value is the caller's, so only the
+        # param-default resolution of resolve_axis_literal applies
+        for fn in enclosing:
+            a = getattr(fn, "args", None)
+            if a is not None and expr.id in {
+                    p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}:
+                return resolve_axis_literal(expr, tree, enclosing)
+        # unambiguous alias binding visible from the call site (module
+        # top level + enclosing function bodies, own-scope only)
+        values: Set[str] = set()
+        opaque = False
+        scopes = [list(tree.body)]
+        scopes += [list(b) for b in (getattr(fn, "body", None)
+                                     for fn in enclosing)
+                   if isinstance(b, list)]
+        for body in scopes:
+            stack = list(body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda, ast.ClassDef)):
+                    continue
+                stack.extend(ast.iter_child_nodes(node))
+                if isinstance(node, ast.Assign) \
+                        and any(isinstance(t, ast.Name) and t.id == expr.id
+                                for t in node.targets):
+                    got = _axis_const_values(node.value)
+                    if got is None:
+                        opaque = True
+                    else:
+                        values |= got
+        if opaque:
+            return None
+        if values:
+            return values
+    return resolve_axis_literal(expr, tree, enclosing)
+
+
+# ---------------------------------------------------------------------------
+# key-expression purity (unstable-cache-key)
+# ---------------------------------------------------------------------------
+
+#: module roots whose calls vary per call/process — a compile-cache key
+#: built from them NEVER matches an existing entry, so every dispatch
+#: "misses" into a fresh executable and the zero-steady-state-compile
+#: invariant dies silently
+_KEY_IMPURE_ROOTS = {"time", "uuid", "random", "datetime"}
+_KEY_IMPURE_BUILTINS = {"id", "hash", "object"}
+
+
+def key_impurities(expr: ast.AST) -> List[Tuple[ast.AST, str]]:
+    """(node, why) for every per-process/per-call subexpression of a
+    compile-cache key or engine label:
+
+    - ``id(x)``/``hash(x)``/``object()`` — per-process (``hash`` of a
+      str is salted per interpreter, of an object is its id);
+    - ``time.*()``/``uuid.*()``/``random.*()``/``datetime.*()`` calls;
+    - f-string ``!r`` interpolation — ``repr`` of a non-literal object
+      embeds its id;
+    - f-string float interpolation (a float constant, or a float
+      format spec like ``:.3f``) — floats carry measurement noise, and
+      two "equal" keys differ in the last ulp.
+    """
+    out: List[Tuple[ast.AST, str]] = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            if name in _KEY_IMPURE_BUILTINS:
+                out.append((node, f"{name}() is per-process — a restarted "
+                                  "(or second) process never hits the entry"))
+            elif name.split(".", 1)[0] in _KEY_IMPURE_ROOTS \
+                    and "." in name:
+                out.append((node, f"{name}() varies per call/process"))
+        elif isinstance(node, ast.FormattedValue):
+            if node.conversion == ord("r"):
+                out.append((node, "f-string !r interpolation renders an "
+                                  "object repr (embeds its id)"))
+            elif isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, float):
+                out.append((node, "f-string interpolates a float literal"))
+            elif isinstance(node.format_spec, ast.JoinedStr) \
+                    and any(isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                            and v.value.rstrip("}").endswith(
+                                ("f", "e", "g", "%"))
+                            for v in node.format_spec.values):
+                out.append((node, "f-string float-formats its value "
+                                  "(measurement noise becomes key churn)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# worker-thread attribution across classes (host-sync-on-serving-worker)
+# ---------------------------------------------------------------------------
+
+def _annotation_class_name(ann: Optional[ast.AST]) -> Optional[str]:
+    """``engine: DecodeEngine`` / ``engine: "DecodeEngine"`` -> the
+    class name; subscripted/dotted annotations return None."""
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value
+    return None
+
+
+def _typed_self_attrs(info: ClassInfo,
+                      module_classes: Set[str]) -> Dict[str, str]:
+    """self.X -> class name, for attrs assigned from a ctor param whose
+    annotation names a module class (``self.engine = engine`` with
+    ``engine: DecodeEngine``) or directly from that class's ctor
+    (``self.engine = DecodeEngine(...)``)."""
+    out: Dict[str, str] = {}
+    for fn in info.methods.values():
+        ann_by_param: Dict[str, str] = {}
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            cls_name = _annotation_class_name(p.annotation)
+            if cls_name is not None and cls_name in module_classes:
+                ann_by_param[p.arg] = cls_name
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = self_attr(tgt)
+                if attr is None:
+                    continue
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id in ann_by_param:
+                    out[attr] = ann_by_param[node.value.id]
+                else:
+                    leaf = _ctor_leaf(node.value)
+                    if leaf in module_classes:
+                        out[attr] = leaf
+    return out
+
+
+def _local_thread_targets(tree: ast.Module) -> List[FunctionNode]:
+    """Nested/module function defs passed as a Thread/Timer target by
+    BARE NAME (``Thread(target=loop)`` where ``loop`` is a local def —
+    the lazy-worker idiom ``self.m`` resolution misses)."""
+    owner = enclosing_function_params(tree)
+    mod_fns = module_functions(tree)
+    out: List[FunctionNode] = []
+    seen: Set[int] = set()
+    for node in ast.walk(tree):
+        ctor = _ctor_leaf(node) if isinstance(node, ast.Call) else None
+        if ctor not in _THREAD_CTORS:
+            continue
+        target_kw = "function" if ctor == "Timer" else "target"
+        targets = [kw.value for kw in node.keywords
+                   if kw.arg == target_kw]
+        if len(node.args) > 1:
+            targets.append(node.args[1])
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            # the def visible from the spawn site: same enclosing
+            # function's own body, else a module-level def
+            fn = owner.get(node)
+            resolved = None
+            if fn is not None:
+                for stmt in ast.walk(fn):
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and stmt.name == t.id:
+                        resolved = stmt
+                        break
+            if resolved is None:
+                resolved = mod_fns.get(t.id)
+            if resolved is not None and id(resolved) not in seen:
+                seen.add(id(resolved))
+                out.append(resolved)
+    return out
+
+
+def worker_attributed_functions(tree: ast.Module
+                                ) -> List[Tuple[FunctionNode, str]]:
+    """Every function the thread-target resolver attributes to a worker
+    thread, with a human attribution label:
+
+    - worker methods of thread-owning classes (``class_infos``
+      closure over ``self.m()`` calls — the PR 10 resolver);
+    - methods of OTHER module classes those workers drive through a
+      typed attribute (``self.engine.advance()`` where ``self.engine``
+      was assigned from a param annotated ``DecodeEngine`` — closed
+      transitively over the target class's own self-call graph);
+    - local/module function defs spawned by bare name
+      (``Thread(target=loop)``).
+    """
+    infos = class_infos(tree)
+    by_name = {info.node.name: info for info in infos}
+    module_classes = set(by_name)
+    out: List[Tuple[FunctionNode, str]] = []
+    seen: Set[int] = set()
+    # BFS over (class, method) pairs so cross-class hops close
+    frontier: List[Tuple[ClassInfo, str, str]] = []
+    for info in infos:
+        for m in info.worker_methods:
+            frontier.append((info, m,
+                             f"worker thread of {info.node.name}"))
+    typed = {info.node.name: _typed_self_attrs(info, module_classes)
+             for info in infos}
+    while frontier:
+        info, mname, why = frontier.pop()
+        fn = info.methods.get(mname)
+        if fn is None or id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append((fn, why))
+        # cross-class edges: self.<attr>.m(...) with a typed attr
+        attrs = typed.get(info.node.name, {})
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            recv = node.func.value
+            attr = self_attr(recv)
+            if attr is None or attr not in attrs:
+                continue
+            target_info = by_name.get(attrs[attr])
+            if target_info is None:
+                continue
+            callee = node.func.attr
+            if callee in target_info.methods:
+                frontier.append(
+                    (target_info, callee,
+                     f"driven by {why} via self.{attr}.{callee}()"))
+                # close over the target's own self-call graph
+                sub = _self_call_edges(target_info.methods[callee])
+                stack = list(sub)
+                visited = set()
+                while stack:
+                    s = stack.pop()
+                    if s in visited or s not in target_info.methods:
+                        continue
+                    visited.add(s)
+                    frontier.append(
+                        (target_info, s,
+                         f"driven by {why} via self.{attr}.{callee}()"))
+                    stack.extend(
+                        _self_call_edges(target_info.methods[s]))
+    for fn in _local_thread_targets(tree):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append((fn, f"thread target {fn.name!r} (by bare name)"))
+    return sorted(out, key=lambda p: p[0].lineno)

@@ -356,7 +356,7 @@ def run_paths(paths: Sequence, select: Optional[Sequence[str]] = None,
     dirty = False
     for posix, file_findings, key, hit in results:
         findings.extend(file_findings)
-        if key is not None and not hit:
+        if cache is not None and key is not None and not hit:
             cache[posix] = {"key": key,
                             "findings": [vars(f) for f in file_findings]}
             dirty = True

@@ -1,6 +1,6 @@
 """Rule modules register themselves on import (see core.register).
 
-Three families:
+Six families:
 
 - tracing   (PR 4): stray-jit, use-after-donate, host-sync-in-hot-path,
               raw-shard-map, impure-jit
@@ -10,18 +10,34 @@ Three families:
 - concurrency (PR 10): unlocked-shared-mutation, blocking-under-lock,
               impure-signal-handler — the thread/drain/handler contracts
               of the PR 7 batcher and PR 8 async checkpointer
+- distributed-protocol (PR 15): cluster-sync-in-divergent-branch,
+              uncommitted-coordinator-write — the PR 13 cluster
+              barrier/commit protocols
+- sharding-layout (PR 15): unknown-axis-in-partition-spec,
+              spec-without-divisibility-guard — the PR 12 GSPMD weight
+              layout contracts
+- compile-stability (PR 15): unstable-cache-key,
+              host-sync-on-serving-worker — the zero-steady-state-
+              compile and never-stall-the-decode-worker invariants of
+              PRs 7/11/14
 """
 
 from tools.jaxlint.rules import (  # noqa: F401
     blocking_under_lock,
+    cluster_divergent,
+    coordinator_write,
     divergent_collective,
+    divisibility_guard,
     donation_across_collective,
     host_sync,
     impure_jit,
     impure_signal_handler,
+    partition_spec,
     raw_shard_map,
+    serving_worker_sync,
     stray_jit,
     unbound_axis,
     unlocked_shared_mutation,
+    unstable_cache_key,
     use_after_donate,
 )

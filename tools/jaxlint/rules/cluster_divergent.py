@@ -1,0 +1,181 @@
+"""cluster-sync-in-divergent-branch: hosts must reach cluster
+rendezvous together.
+
+The PR 13 control plane (``parallel/multihost.Cluster``) makes the
+HOST program SPMD too: every member must make the SAME sequence of
+``barrier``/``any_flag``/``gather``/``agree_lost_ids`` calls (the
+class docstring's protocol discipline), and ``shrink`` must happen on
+every survivor or the generations fork — which namespaces the
+divergent member away from every later rendezvous, the same deadlock
+one hop later.  The dangerous shapes are exactly the per-replica ones
+lifted one level up:
+
+- a rendezvous under a branch on PER-HOST state — ``is_coordinator``,
+  a ``process_id``/``process_index``/``member_rank`` compare, a
+  heartbeat finding (``stale_members``/``lost_device_ids``: each
+  host's own filesystem view of its peers), or a value tainted by one
+  of those;
+- a rendezvous lexically AFTER a divergent branch that can exit early
+  (``if not cl.is_coordinator: return`` then ``cl.barrier()`` — the
+  divergent coordinator-only path the PR 14 review caught by hand);
+- a rendezvous inside a LOCAL ``except`` handler — exceptions are
+  per-host events, so only the host that raised enters the handler.
+
+The sanctioned coordinator-commit shape
+(``runtime/checkpoint.py::_save_cluster``) passes by construction: the
+coordinator-only branch holds WRITES, and the barriers sit outside it
+with no early exit.  Values that flowed THROUGH a cluster primitive
+(``lost = set(cl.agree_lost_ids(...))``) are cluster-agreed and
+launder the taint, mirroring the post-psum rule of the per-replica
+family.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_SCOPES = astutil.SCOPE_NODES
+
+
+def _contains_sync(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and astutil.is_cluster_sync_call(n)
+               for n in astutil.walk_no_scopes(expr))
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(target)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, (ast.Store, ast.Del))}
+
+
+@register
+class ClusterSyncInDivergentBranchRule(Rule):
+    name = "cluster-sync-in-divergent-branch"
+    severity = "error"
+    family = "distributed-protocol"
+    description = ("Cluster barrier/any_flag/gather/agree_lost_ids/shrink "
+                   "reachable only under per-host-divergent state "
+                   "(is_coordinator, process-id compares, local except "
+                   "handlers, heartbeat findings) — a cross-host deadlock")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # cheap pre-filter: most functions have no cluster ops
+                if any(isinstance(n, ast.Call)
+                       and astutil.is_cluster_sync_call(n)
+                       for n in ast.walk(node)):
+                    seen: Set[int] = set()
+                    yield from self._scan(node.body, set(), posix_path,
+                                          seen, context=None)
+
+    # ``context`` carries the divergence label when the statements being
+    # scanned are only reachable by a subset of hosts (inside a
+    # divergent branch, after a divergent early exit, inside an except
+    # handler); None means all hosts reach them.
+    def _scan(self, stmts: List[ast.stmt], taint: Set[str], path: str,
+              seen: Set[int], context: Optional[str]) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, _SCOPES):
+                continue
+            if context is not None:
+                yield from self._flag(stmt, context, path, seen)
+            if isinstance(stmt, (ast.If, ast.While)):
+                hit = astutil.host_divergent_read(stmt.test, taint)
+                branch_ctx = hit if hit is not None else context
+                # each branch gets a COPY of the taint state; afterwards
+                # a name tainted on EITHER path stays tainted — a kill
+                # inside one conditional branch must not clear the taint
+                # for hosts that took the other path
+                branch_taints = []
+                for group in (stmt.body, stmt.orelse):
+                    t = set(taint)
+                    yield from self._scan(group, t, path, seen,
+                                          branch_ctx)
+                    branch_taints.append(t)
+                taint |= branch_taints[0] | branch_taints[1]
+                if hit is not None and context is None and (
+                        astutil.can_exit_suite(stmt.body)
+                        or astutil.can_exit_suite(stmt.orelse)):
+                    # the remainder of THIS suite is host-divergent too
+                    context = (f"{hit} (a branch on it above can exit "
+                               "early)")
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                names: Set[str] = set()
+                for t in targets:
+                    names |= _target_names(t)
+                value = stmt.value
+                if value is not None and _contains_sync(value):
+                    # flowed through a cluster primitive: agreed again
+                    taint -= names
+                elif value is not None and astutil.host_divergent_read(
+                        value, taint) is not None:
+                    taint |= names
+                elif not isinstance(stmt, ast.AugAssign):
+                    taint -= names
+            elif isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and isinstance(stmt.value.func, ast.Attribute) \
+                    and isinstance(stmt.value.func.value, ast.Name):
+                # receiver mutation: ``lost.update(hb.lost_device_ids())``
+                # taints the receiver when any argument is divergent
+                call = stmt.value
+                if any(astutil.host_divergent_read(a, taint) is not None
+                       for a in list(call.args)
+                       + [k.value for k in call.keywords]):
+                    taint.add(call.func.value.id)
+            elif isinstance(stmt, ast.For):
+                if astutil.host_divergent_read(stmt.iter, taint) \
+                        is not None:
+                    taint |= _target_names(stmt.target)
+                for group in (stmt.body, stmt.orelse):
+                    t = set(taint)
+                    yield from self._scan(group, t, path, seen, context)
+                    taint |= t
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan(stmt.body, taint, path, seen,
+                                      context)
+            elif isinstance(stmt, ast.Try):
+                for group in (stmt.body, stmt.orelse, stmt.finalbody):
+                    t = set(taint)
+                    yield from self._scan(group, t, path, seen, context)
+                    taint |= t
+                for handler in stmt.handlers:
+                    # only the host whose try body raised gets here
+                    t = set(taint)
+                    yield from self._scan(
+                        handler.body, t, path, seen,
+                        context or "a local except handler")
+                    taint |= t
+            elif isinstance(stmt, ast.Match):
+                hit = astutil.host_divergent_read(stmt.subject, taint)
+                for case in stmt.cases:
+                    t = set(taint)
+                    yield from self._scan(case.body, t, path, seen,
+                                          hit if hit is not None
+                                          else context)
+                    taint |= t
+
+    def _flag(self, stmt: ast.stmt, label: str, path: str,
+              seen: Set[int]) -> Iterator[Finding]:
+        for node in astutil.walk_no_scopes(stmt):
+            if isinstance(node, ast.Call) \
+                    and astutil.is_cluster_sync_call(node) \
+                    and id(node) not in seen:
+                seen.add(id(node))
+                op = node.func.attr  # type: ignore[union-attr]
+                yield self.finding(
+                    path, node,
+                    f"{op}() reachable only under per-host-divergent "
+                    f"state ({label}) — members that skip it never join "
+                    "the rendezvous and the cluster deadlocks; make the "
+                    "call unconditional (gate only the WRITES, like the "
+                    "checkpoint commit protocol) or agree the verdict "
+                    "first via any_flag/agree_lost_ids")

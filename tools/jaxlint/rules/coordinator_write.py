@@ -1,0 +1,127 @@
+"""uncommitted-coordinator-write: manifest/gc writes in cluster
+protocol code must be coordinator-gated.
+
+The PR 13 cluster-commit protocol hangs its crash-safety on WHO writes
+what: every member lands its own data shards, but the manifest (the
+commit marker) is written by the COORDINATOR alone, after a barrier
+proved every member's bytes durable — and ``_gc`` runs on the
+coordinator alone, because two members sweeping the same directory
+race each other's deletes (``runtime/checkpoint.py::_save_cluster``).
+A manifest/gc/commit-marker write that ANY member can reach either
+commits a snapshot some member hasn't finished writing (torn commit) or
+double-writes the marker with divergent contents (whichever member's
+``os.replace`` lands last wins).
+
+Scope: functions that themselves perform a cluster rendezvous (a
+``barrier``/``any_flag``/``gather``/``agree_lost_ids``/``shrink``
+call) — i.e. code actively inside a cross-host protocol.  The
+single-process ``save()`` path calls the same ``_commit_manifest``
+with no cluster in sight and stays out of scope by construction.  A
+write is GATED when it sits in the true branch of an
+``is_coordinator`` test (or the false branch of its negation, or
+after a ``if not cl.is_coordinator: return`` early exit, or in the
+coordinator arm of a ternary).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_SCOPES = astutil.SCOPE_NODES
+
+#: call leaf names that write the commit protocol's shared artifacts
+_WRITE_LEAVES = {"_commit_manifest", "commit_manifest", "_gc",
+                 "gc_checkpoints"}
+
+
+def _is_write_call(call: ast.Call) -> bool:
+    name = astutil.dotted_name(call.func)
+    return name is not None and name.rsplit(".", 1)[-1] in _WRITE_LEAVES
+
+
+@register
+class UncommittedCoordinatorWriteRule(Rule):
+    name = "uncommitted-coordinator-write"
+    severity = "error"
+    family = "distributed-protocol"
+    description = ("manifest/gc/commit-marker write in cluster protocol "
+                   "code not gated on is_coordinator — every member "
+                   "writes it, racing the commit")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not any(isinstance(n, ast.Call)
+                       and astutil.is_cluster_sync_call(n)
+                       for n in ast.walk(node)):
+                continue
+            yield from self._scan(node.body, posix_path, gated=False)
+
+    def _scan(self, stmts: List[ast.stmt], path: str,
+              gated: bool) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, _SCOPES):
+                continue
+            if isinstance(stmt, ast.If):
+                coord = astutil.is_coordinator_test(stmt.test)
+                yield from self._scan(stmt.body, path,
+                                      gated or coord is True)
+                yield from self._scan(stmt.orelse, path,
+                                      gated or coord is False)
+                if coord is False and astutil.can_exit_suite(stmt.body):
+                    # ``if not cl.is_coordinator: return`` — the rest of
+                    # this suite runs on the coordinator only
+                    gated = True
+                continue
+            groups = self._subgroups(stmt)
+            if groups:
+                for group in groups:
+                    yield from self._scan(group, path, gated)
+                continue
+            for node in astutil.walk_no_scopes(stmt):
+                if isinstance(node, ast.Call) and _is_write_call(node) \
+                        and not gated \
+                        and not self._in_coordinator_ifexp(stmt, node):
+                    leaf = (astutil.dotted_name(node.func) or "write"
+                            ).rsplit(".", 1)[-1]
+                    yield self.finding(
+                        path, node,
+                        f"{leaf}() in cluster protocol code without an "
+                        "is_coordinator gate — every member writes the "
+                        "commit artifact, so a member that hasn't landed "
+                        "its data can still commit (torn snapshot) and "
+                        "concurrent writers race the marker; gate the "
+                        "write (not the barrier) on cl.is_coordinator")
+
+    @staticmethod
+    def _subgroups(stmt: ast.stmt) -> List[List[ast.stmt]]:
+        if isinstance(stmt, (ast.For, ast.While, ast.With, ast.AsyncWith)):
+            return [stmt.body] + ([stmt.orelse]
+                                  if getattr(stmt, "orelse", None) else [])
+        if isinstance(stmt, ast.Try):
+            return [stmt.body, stmt.orelse, stmt.finalbody] \
+                + [h.body for h in stmt.handlers]
+        if isinstance(stmt, ast.Match):
+            return [c.body for c in stmt.cases]
+        return []
+
+    @staticmethod
+    def _in_coordinator_ifexp(stmt: ast.stmt, call: ast.Call) -> bool:
+        """Is ``call`` inside the coordinator arm of a ternary
+        (``files = save(...) if cl.is_coordinator else {}``)?"""
+        for node in astutil.walk_no_scopes(stmt):
+            if not isinstance(node, ast.IfExp):
+                continue
+            coord = astutil.is_coordinator_test(node.test)
+            if coord is None:
+                continue
+            arm = node.body if coord else node.orelse
+            if any(n is call for n in ast.walk(arm)):
+                return True
+        return False

@@ -1,0 +1,68 @@
+"""unknown-axis-in-partition-spec: every PartitionSpec axis must be in
+the mesh vocabulary.
+
+GSPMD never validates axis NAMES at spec-construction time: a
+``P(None, "modle")`` builds fine and fails only when a
+``NamedSharding`` over a real mesh finally consumes it — deep inside
+``jax.device_put``/compilation, on the pod, with an error that names
+neither the spec literal nor the file it came from.  The repo fixes
+its axis vocabulary package-wide (``parallel/mesh.ALL_AXES``:
+``data``/``model``/``pipe``/``seq``/``expert``) and spells specs with
+the exported constants (``P(None, MODEL_AXIS)``), so a spec literal
+can be validated statically — this is PR 12's weight-layout contract
+(``transformer.shard_specs`` and friends) as a machine check.
+
+Every entry of a ``P(...)``/``PartitionSpec(...)`` literal in the
+model zoo, the sharded-fit builders, and the decode engine is resolved
+(string literal, mesh axis constant, local alias, parameter default —
+the PR 10 axis-literal resolver plus the constant layer) and flagged
+when it resolves outside the vocabulary and nothing in the module
+binds it.  Unresolvable entries (a parameter without a default, a
+foreign import) stay silent — the caller's contract, as ever.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_SCOPE_HINTS = ("models/", "parallel/sharded_fit.py", "serving/decode.py")
+
+
+@register
+class UnknownAxisInPartitionSpecRule(Rule):
+    name = "unknown-axis-in-partition-spec"
+    severity = "error"
+    family = "sharding-layout"
+    description = ("PartitionSpec entry resolves to an axis name outside "
+                   "the parallel/mesh vocabulary — the layout fails at "
+                   "device_put on the pod, not at build time")
+
+    def applies_to(self, posix_path: str) -> bool:
+        return any(h in posix_path for h in _SCOPE_HINTS)
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        calls = astutil.partition_spec_calls(tree)
+        if not calls:
+            return
+        bound = astutil.bound_axis_names(tree)
+        chain = astutil.enclosing_chain(tree)
+        for call in calls:
+            for entry in astutil.partition_spec_entries(call):
+                values = astutil.resolve_axis_entry(
+                    entry, tree, chain.get(id(entry), []))
+                if values is None:
+                    continue
+                loose = sorted(v for v in values if v not in bound)
+                if loose:
+                    yield self.finding(
+                        posix_path, call,
+                        f"PartitionSpec names axis {loose[0]!r}, which is "
+                        "not in the parallel/mesh vocabulary "
+                        f"({', '.join(sorted(astutil.MESH_AXIS_VOCAB))}) "
+                        "and nothing in this module binds — the spec "
+                        "builds fine and fails at device_put/compile "
+                        "time on the target mesh")

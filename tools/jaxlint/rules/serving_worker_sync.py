@@ -1,0 +1,120 @@
+"""host-sync-on-serving-worker: the serving workers must not fetch.
+
+The continuous-batching decode worker advances EVERY in-flight
+request's next token per iteration — any device→host fetch on that
+thread stalls every user's inter-token latency, not just one
+request's.  This is exactly the PR 14 harvest-stall bug: the prefix
+harvester's full-bucket ``np.asarray`` ran on the decode worker and
+was moved to a dedicated harvest thread during review.  Six hardening
+passes later, the bug class is a rule.
+
+Worker attribution is the PR 10 thread-target resolver grown two
+hops (``astutil.worker_attributed_functions``): worker methods of
+thread-owning classes, methods of module classes those workers drive
+through a typed attribute (``ContinuousBatcher._advance_all`` →
+``self.engine.advance`` with ``engine: DecodeEngine``), and local
+function defs spawned by bare name (``Thread(target=loop)``).  Inside
+an attributed body the rule flags
+
+- ``.item()``,
+- single-argument ``np.asarray(x)`` — the device-fetch form (the
+  two-argument ``np.asarray(x, dtype)`` host-normalization idiom this
+  repo uses on request inputs stays clean), as a call or passed as a
+  bare callable (``jax.tree.map(np.asarray, out)``),
+- ``jax.device_get`` (call or reference),
+- ``block_until_ready`` (method or ``jax.block_until_ready``).
+
+Deliberate syncs — the decode stream's one per-step token fetch, the
+harvest worker whose whole job is absorbing the fetch, the
+DynamicBatcher's host-numpy result contract — carry inline
+suppressions with their reasons; everything else is a stall bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_NP_NAMES = {"np", "numpy", "onp"}
+
+_own_body = astutil.walk_own_body
+
+
+def _is_np_asarray(node: ast.AST) -> bool:
+    name = astutil.dotted_name(node)
+    return name is not None and "." in name \
+        and name.split(".", 1)[0] in _NP_NAMES \
+        and name.rsplit(".", 1)[-1] == "asarray"
+
+
+def _is_device_get(node: ast.AST) -> bool:
+    name = astutil.dotted_name(node)
+    return name is not None and name.rsplit(".", 1)[-1] == "device_get"
+
+
+@register
+class HostSyncOnServingWorkerRule(Rule):
+    name = "host-sync-on-serving-worker"
+    severity = "error"
+    family = "compile-stability"
+    description = ("device→host fetch (.item(), single-arg np.asarray, "
+                   "jax.device_get, block_until_ready) on a serving "
+                   "worker thread — stalls every in-flight request's "
+                   "latency (the PR 14 harvest-stall bug)")
+
+    def applies_to(self, posix_path: str) -> bool:
+        return "serving/" in posix_path
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for fn, why in astutil.worker_attributed_functions(tree):
+            for node in _own_body(fn):
+                if isinstance(node, ast.Call):
+                    func = node.func
+                    if isinstance(func, ast.Attribute) \
+                            and func.attr == "item" \
+                            and not node.args and not node.keywords:
+                        yield self.finding(
+                            posix_path, node,
+                            f".item() on {why} — a per-call device→host "
+                            "sync stalls every in-flight request")
+                    elif _is_np_asarray(func) and len(node.args) == 1 \
+                            and not node.keywords:
+                        yield self.finding(
+                            posix_path, node,
+                            f"single-arg np.asarray() on {why} — fetches "
+                            "a device value to host on the worker; move "
+                            "the fetch off-thread (the PR 14 harvest "
+                            "worker pattern) or keep it on device")
+                    elif _is_device_get(func):
+                        yield self.finding(
+                            posix_path, node,
+                            f"jax.device_get() on {why} — blocks the "
+                            "worker on the transfer")
+                    elif (isinstance(func, ast.Attribute)
+                          and func.attr == "block_until_ready"):
+                        yield self.finding(
+                            posix_path, node,
+                            f"block_until_ready on {why} — the worker "
+                            "waits out the whole dispatch; let the next "
+                            "dispatch's data dependency do the waiting")
+                elif isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and (_is_np_asarray(node) or _is_device_get(node)) \
+                        and not self._is_call_func(node, fn):
+                    yield self.finding(
+                        posix_path, node,
+                        f"{astutil.dotted_name(node)} passed as a "
+                        f"callable on {why} — applied leaf-wise it "
+                        "fetches every device leaf to host on the worker")
+
+    @staticmethod
+    def _is_call_func(attr: ast.Attribute, fn) -> bool:
+        """Is this attribute the FUNC of a call (already handled above)
+        rather than a bare reference passed along?"""
+        for node in _own_body(fn):
+            if isinstance(node, ast.Call) and node.func is attr:
+                return True
+        return False

@@ -1,0 +1,70 @@
+"""unstable-cache-key: compile-cache keys must be stable values.
+
+The whole serving/training stack hangs its zero-steady-state-compile
+invariant (CI-gated since PR 6, extended through PRs 7/11/12/14) on
+one property: two logically identical programs build EQUAL engine
+keys, so the second caller hits the first caller's executable.  Every
+shipped key is a canonical conf JSON, a ``mesh_signature``, a quant
+mode — stable across calls, threads, and processes.  A key (or engine
+label, which becomes the per-label compile counter the gates assert
+on) built from
+
+- ``id(x)``/``hash(x)``/``object()`` — per-process identity (``hash``
+  of a str is salted per interpreter),
+- ``time.*``/``uuid.*``/``random.*``/``datetime.*`` calls,
+- f-string ``!r`` interpolation (an object repr embeds its id) or
+  float interpolation (measurement noise becomes key churn)
+
+never matches an existing entry: every dispatch "misses" into a fresh
+trace+XLA compile, and the zero-compile gates read a compile storm as
+traffic.  This is lexically detectable at the ``cached_jit``/
+``get_or_build`` call site, so it is a rule
+(``astutil.key_impurities`` is the shared purity walker).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_ENGINE_CALLS = {"cached_jit", "get_or_build"}
+
+
+@register
+class UnstableCacheKeyRule(Rule):
+    name = "unstable-cache-key"
+    severity = "error"
+    family = "compile-stability"
+    description = ("compile-cache key/engine label built from id()/"
+                   "time/uuid/random or !r/float f-string interpolation "
+                   "— every dispatch misses into a fresh XLA compile, "
+                   "silently defeating the zero-compile invariant")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.dotted_name(node.func)
+            if name is None:
+                continue
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in _ENGINE_CALLS:
+                continue
+            key_exprs: List[ast.AST] = []
+            if leaf == "get_or_build" and node.args:
+                key_exprs.append(node.args[0])
+            for kw in node.keywords:
+                if kw.arg in ("key", "label"):
+                    key_exprs.append(kw.value)
+            for expr in key_exprs:
+                for bad, why in astutil.key_impurities(expr):
+                    yield self.finding(
+                        posix_path, bad,
+                        f"unstable compile-cache key for {leaf}(): {why} "
+                        "— the key never matches an existing entry, so "
+                        "steady state recompiles per call; key on stable "
+                        "identity (conf JSON, mesh_signature, mode "
+                        "strings) instead")
