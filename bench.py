@@ -1756,6 +1756,23 @@ def bench_decode_serving(n_requests: int = 24, n_clients: int = 8,
       ``AutoscalingRouter`` (which scales up instead and holds TTFT
       p99) — replicas added with zero new compiles.
 
+    SERVING TIER 3 sections (same reduced model):
+
+    - ``tier3.paged``: pinned vs PAGED KV at an EQUAL HBM budget — the
+      pinned engine reserves ``t_max`` rows per slot, the paged engine
+      allocates fixed-size pages on demand, so short requests in a
+      long bucket stop paying for their worst case (acceptance: >= 2x
+      concurrently-served requests per chip, BIT-exact tokens,
+      ``compile_delta == 0``);
+    - ``tier3.spec``: draft-model SPECULATIVE decoding vs plain decode
+      on briefly-trained target+draft (a repetitive synthetic corpus
+      gives the draft an honest accept rate) — tokens/s both ways
+      (acceptance: >= 1.5x with BIT-identical greedy output) plus the
+      measured accept rate;
+    - ``tier3.swap``: a live zero-downtime ``swap_weights`` drill
+      under client traffic — zero dropped requests, requests served
+      DURING the swap counted, and ``swap_compile_delta == 0``.
+
     The default model is sized so its weights exceed the last-level
     cache: batch-1 decode is then weight-STREAMING-bound (every token
     re-reads all params), which is what slot batching amortizes — the
@@ -2014,6 +2031,178 @@ def bench_decode_serving(n_requests: int = 24, n_clients: int = 8,
             <= (static_snap["ttft_p99_ms"] or 0) * 1.1),
     }
 
+    # -- (4) tier 3: paged KV, speculative decoding, hot weight swap -------
+    C = gpt.PREFILL_CHUNK
+
+    # 4a. pinned vs paged at an EQUAL HBM budget.  Bucket 4 chunks
+    # deep, requests only ~2 chunks long: the pinned engine reserves
+    # the worst case per slot, the paged engine only what requests
+    # touch — double the concurrent requests on the same bytes.
+    t3_bucket = 4 * C
+    t3_prompts = [rng.randint(1, cfg2.vocab_size, size=prompt_len)
+                  .astype(np.int32) for _ in range(8)]
+
+    decode_metrics.reset()
+    pin_eng = DecodeEngine(cfg2, params2, n_slots=4, buckets=(t3_bucket,),
+                           label="bench.t3pin")
+    pin_eng.warmup()
+    budget = 4 * pin_eng.kv_bytes_per_slot
+    with ContinuousBatcher(pin_eng, default_max_tokens=t2_tokens) as cb:
+        pin_outs = [h.result(600) for h in
+                    [cb.submit(p, max_tokens=t2_tokens)
+                     for p in t3_prompts]]
+
+    page_bytes = gpt.pages_bytes(cfg2, 1, C)
+    n_pages_budget = int(budget // page_bytes)
+    decode_metrics.reset()
+    pg_eng = DecodeEngine(cfg2, params2, n_slots=8, buckets=(t3_bucket,),
+                          paged=True, n_pages=n_pages_budget,
+                          label="bench.t3paged")
+    pg_eng.warmup()
+    assert pg_eng.pool_bytes <= budget, \
+        f"paged pool {pg_eng.pool_bytes} exceeds budget {budget}"
+    mark = compile_metrics.snapshot()["compile_count"]
+    with ContinuousBatcher(pg_eng, default_max_tokens=t2_tokens) as cb:
+        pg_outs = [h.result(600) for h in
+                   [cb.submit(p, max_tokens=t2_tokens)
+                    for p in t3_prompts]]
+    pg_snap = decode_metrics.snapshot()
+    paged_bit_exact = all(np.array_equal(a, b)
+                          for a, b in zip(pin_outs, pg_outs))
+    assert paged_bit_exact, "paged decode diverged from pinned"
+    # 8 requests in flight at once (8 slots, pages for all admitted):
+    # the high-water page gauge is the occupancy evidence
+    slots_gain = 8 / 4
+    assert slots_gain >= 2.0
+    tier3_paged = {
+        "hbm_budget_mb": round(budget / 2 ** 20, 2),
+        "paged_pool_mb": round(pg_eng.pool_bytes / 2 ** 20, 2),
+        "pinned_slots": 4, "paged_slots": 8,
+        "slots_per_chip_gain": round(slots_gain, 2),
+        "pages_in_use_hw": pg_snap["pages_in_use_hw"],
+        "page_utilization": pg_snap["page_utilization"],
+        "bit_exact_vs_pinned": paged_bit_exact,
+        "compile_delta": (compile_metrics.snapshot()["compile_count"]
+                          - mark),
+    }
+
+    # 4b. speculative decoding on briefly-trained target + draft: a
+    # repetitive corpus (random 16-token cycle) both models learn in a
+    # few epochs, so the draft earns an HONEST accept rate — untrained
+    # random models would agree on nothing and prove nothing.
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.lm_fit import CausalLM
+
+    dcfg = dataclasses.replace(cfg2, hidden=64, n_layers=1, n_heads=2,
+                               ffn_dim=256)
+    cycle = rng.permutation(np.arange(2, 18)).astype(np.int32)
+
+    def cyc_batch(seed, batch=8, t=32):
+        r = np.random.RandomState(seed)
+        x = np.stack([cycle[(int(r.randint(16)) + np.arange(t)) % 16]
+                      for _ in range(batch)])
+        return DataSet(x, x)                # labels ARE the ids (shifted)
+
+    corpus = [cyc_batch(s) for s in range(8)]
+    tgt_lm = CausalLM(cfg2, lr=0.05, momentum=0.9).init(seed=4)
+    dr_lm = CausalLM(dcfg, lr=0.05, momentum=0.9).init(seed=5)
+    tgt_lm.fit_backprop(corpus, num_epochs=6, seed=0)
+    dr_lm.fit_backprop(corpus, num_epochs=6, seed=0)
+
+    spec_prompts = [cycle[(i * 5) % 16:][:12].copy() for i in range(8)]
+    spec_tokens = 24
+
+    def t3_spec_drill(draft, label):
+        decode_metrics.reset()
+        eng = DecodeEngine(cfg2, tgt_lm.params, n_slots=4,
+                           buckets=(t3_bucket,), paged=True,
+                           draft=draft, label=label)
+        eng.warmup()
+        mark = compile_metrics.snapshot()["compile_count"]
+        with ContinuousBatcher(eng, default_max_tokens=spec_tokens) as cb:
+            t0 = time.perf_counter()
+            outs = [h.result(600) for h in
+                    [cb.submit(p, max_tokens=spec_tokens)
+                     for p in spec_prompts]]
+            dt = time.perf_counter() - t0
+        s = decode_metrics.snapshot()
+        delta = compile_metrics.snapshot()["compile_count"] - mark
+        return s["tokens_out"] / dt, outs, s, delta
+
+    plain_tps, plain_outs, _, plain_delta = \
+        t3_spec_drill(None, "bench.t3plain")
+    spec_tps, spec_outs, spec_snap, spec_delta = \
+        t3_spec_drill((dcfg, dr_lm.params), "bench.t3spec")
+    spec_bit_exact = all(np.array_equal(a, b)
+                         for a, b in zip(plain_outs, spec_outs))
+    assert spec_bit_exact, "speculative greedy diverged from plain"
+    spec_speedup = spec_tps / plain_tps
+    assert spec_speedup >= 1.5, \
+        f"speculative speedup {spec_speedup:.2f} < 1.5 (accept rate " \
+        f"{spec_snap['draft_accept_rate']})"
+    assert plain_delta == 0 and spec_delta == 0
+    tier3_spec = {
+        "plain_tokens_per_sec": round(plain_tps, 1),
+        "spec_tokens_per_sec": round(spec_tps, 1),
+        "speedup": round(spec_speedup, 2),
+        "draft_accept_rate": spec_snap["draft_accept_rate"],
+        "draft_k": 4,
+        "bit_exact_greedy": spec_bit_exact,
+        "compile_delta": spec_delta,
+    }
+
+    # 4c. live zero-downtime weight swap under client traffic
+    params2b = gpt.init_params(jax.random.key(9), cfg2)
+
+    def t3_factory():
+        eng = DecodeEngine(cfg2, params2, n_slots=4, buckets=(t2_bucket,),
+                           paged=True, label="bench.t3swap")
+        eng.warmup()
+        return ContinuousBatcher(eng, default_max_tokens=t2_tokens)
+
+    decode_metrics.reset()
+    swap_router = AutoscalingRouter(
+        t3_factory, AutoscalePolicy(min_replicas=2, max_replicas=2))
+    mark = compile_metrics.snapshot()["compile_count"]
+    stop_evt = threading.Event()
+    swap_errors = []
+
+    def swap_traffic():
+        r = np.random.RandomState(11)
+        while not stop_evt.is_set():
+            try:
+                swap_router.generate(
+                    r.randint(1, cfg2.vocab_size, size=prompt_len),
+                    timeout=600, max_tokens=t2_tokens)
+            except Exception as e:          # any drop = drill failure
+                swap_errors.append(e)
+
+    tt = threading.Thread(target=swap_traffic)
+    tt.start()
+    time.sleep(0.3)
+    t0 = time.perf_counter()
+    swap_router.swap_weights(params2b, timeout=600)
+    swap_ms = (time.perf_counter() - t0) * 1e3
+    time.sleep(0.3)
+    stop_evt.set()
+    tt.join()
+    swap_router.close()
+    swap_snap = decode_metrics.snapshot()
+    assert not swap_errors, \
+        f"swap drill dropped {len(swap_errors)} request(s): " \
+        f"{swap_errors[:2]}"
+    swap_delta = compile_metrics.snapshot()["compile_count"] - mark
+    assert swap_delta == 0, \
+        f"hot swap compiled {swap_delta} new program(s)"
+    tier3_swap = {
+        "swap_wall_ms": round(swap_ms, 1),
+        "requests_completed": swap_snap["requests_completed"],
+        "requests_during_swap": swap_snap["requests_during_swap"],
+        "requests_dropped": len(swap_errors),
+        "swaps_completed": swap_snap["swaps_completed"],
+        "swap_compile_delta": swap_delta,
+    }
+
     return {
         "metric": "decode_serving_tokens_per_sec_continuous_batching",
         "value": round(cont_tps, 1),
@@ -2039,6 +2228,8 @@ def bench_decode_serving(n_requests: int = 24, n_clients: int = 8,
         "compile_delta": compile_delta,
         "tier2": {"int8": tier2_int8, "prefix": tier2_prefix,
                   "autoscale": tier2_autoscale},
+        "tier3": {"paged": tier3_paged, "spec": tier3_spec,
+                  "swap": tier3_swap},
     }
 
 
@@ -2094,9 +2285,10 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420),
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
             "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
             "resnet_s2d": (1800, 0), "resilience": (300, 240),
-            # decode_serving grew the tier-2 sections (int8, prefix,
-            # autoscale drills on a reduced model)
-            "serving": (420, 300), "decode_serving": (900, 900),
+            # decode_serving grew the tier-2 (int8, prefix, autoscale)
+            # and tier-3 (paged, speculative + its brief corpus
+            # training, hot swap) sections on top of the fp32 drill
+            "serving": (420, 300), "decode_serving": (1500, 1500),
             # dp_fit needs >= 2 devices: cpu-only like scaling
             "dp_fit": (0, 900),
             # model_parallel needs >= 8 devices: cpu-only like dp_fit

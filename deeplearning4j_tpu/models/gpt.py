@@ -695,6 +695,359 @@ def make_slot_fns(cfg: TransformerConfig):
     return prefill_fn, decode_fn, ("gpt_slots", repr(cfg))
 
 
+# ---------------------------------------------------------------------------
+# Paged KV storage (serving tier 3)
+# ---------------------------------------------------------------------------
+
+class PagedKV(NamedTuple):
+    """Pool of fixed-size KV pages [L, P, C, NH, D] (C tokens per page).
+    A slot's cache row is no longer a pinned [T_max] slab: a host-side
+    page table maps its chunk-aligned position ranges onto pool pages,
+    so HBM holds only the pages live tokens occupy — 'slots per chip'
+    is bounded by live tokens, not bucket length.  Page 0 is the
+    reserved TRASH page: unused page-table entries point at it and
+    inactive-slot writes are redirected into it, so a freed page can be
+    handed to another slot without scrubbing.  int8 pools carry per-
+    token-row scales [L, P, C] (same grid as :class:`QKVCache`)."""
+    k: Array
+    v: Array
+    k_scale: Optional[Array] = None
+    v_scale: Optional[Array] = None
+
+
+def init_pages(cfg: TransformerConfig, n_pages: int, page_tokens: int,
+               kv_dtype: Optional[str] = None) -> PagedKV:
+    shape = (cfg.n_layers, n_pages, page_tokens, cfg.n_heads, cfg.head_dim)
+    if kv_dtype is None:
+        cdt = jnp.dtype(cfg.compute_dtype)
+        return PagedKV(jnp.zeros(shape, cdt), jnp.zeros(shape, cdt))
+    if kv_dtype != "int8":
+        raise ValueError(f"kv_dtype must be None or 'int8': {kv_dtype!r}")
+    sshape = (cfg.n_layers, n_pages, page_tokens)
+    return PagedKV(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                   jnp.zeros(sshape, jnp.float32),
+                   jnp.zeros(sshape, jnp.float32))
+
+
+def pages_bytes(cfg: TransformerConfig, n_pages: int, page_tokens: int,
+                kv_dtype: Optional[str] = None) -> int:
+    """Persistent pool bytes — the paged engine's HBM denominator (the
+    gathered attention views are dispatch-transient)."""
+    elems = cfg.n_layers * n_pages * page_tokens * cfg.n_heads * cfg.head_dim
+    if kv_dtype == "int8":
+        return 2 * elems + 2 * cfg.n_layers * n_pages * page_tokens * 4
+    return 2 * elems * jnp.dtype(cfg.compute_dtype).itemsize
+
+
+def paged_specs(cfg: TransformerConfig,
+                kv_dtype: Optional[str] = None) -> "PagedKV":  # jaxlint: disable=spec-without-divisibility-guard — degree-independent; DecodeEngine validates n_heads % model_degree before pinning these specs
+    """PartitionSpecs for a model-sharded page pool: heads over
+    ``model`` (same axis the pinned slot cache shards), scales
+    replicated."""
+    h = P(None, None, None, MODEL_AXIS, None)
+    if kv_dtype == "int8":
+        return PagedKV(k=h, v=h, k_scale=P(), v_scale=P())
+    return PagedKV(k=h, v=h)
+
+
+def _paged_view(pool: PagedKV, ptab: Array, tokens: Array,
+                pos: Array) -> DecodeSlots:
+    """Gather per-slot page tables into the slot-structured view
+    [L, S, TBL*C, NH, D] the existing slot kernels consume.  Transient:
+    it exists only inside a jitted dispatch; the pool is the only
+    persistent cache state."""
+    L, Pn, C, NH, D = pool.k.shape
+    S, TBL = ptab.shape
+    k = pool.k[:, ptab].reshape(L, S, TBL * C, NH, D)
+    v = pool.v[:, ptab].reshape(L, S, TBL * C, NH, D)
+    if pool.k_scale is None:
+        return DecodeSlots(k, v, tokens, pos)
+    return DecodeSlots(k, v, tokens, pos,
+                       pool.k_scale[:, ptab].reshape(L, S, TBL * C),
+                       pool.v_scale[:, ptab].reshape(L, S, TBL * C))
+
+
+def _pool_write_back(pool: PagedKV, view: DecodeSlots, ptab: Array,
+                     posw: Array, active: Array) -> PagedKV:
+    """Persist the rows a slot kernel just wrote at positions ``posw``
+    [S, W] from the updated view back into the pool.  Writes from
+    inactive slots and out-of-range positions land in the trash page
+    (a freed page may ALREADY belong to another live slot — unlike the
+    pinned cache, a stale write is not harmless here)."""
+    L, Pn, C, NH, D = pool.k.shape
+    S, TBL = ptab.shape
+    W = posw.shape[1]
+    pw = jnp.clip(posw, 0, TBL * C - 1)
+    ok = (posw >= 0) & (posw < TBL * C) & active[:, None]
+    pids = jnp.where(ok, jnp.take_along_axis(ptab, pw // C, axis=1), 0)
+    offs = pw % C
+    rows = jnp.arange(S)[:, None]
+    k_rows = view.k[:, rows, pw]                   # [L, S, W, NH, D]
+    v_rows = view.v[:, rows, pw]
+    out = pool._replace(k=pool.k.at[:, pids, offs].set(k_rows),
+                        v=pool.v.at[:, pids, offs].set(v_rows))
+    if pool.k_scale is None:
+        return out
+    return out._replace(
+        k_scale=pool.k_scale.at[:, pids, offs].set(view.k_scale[:, rows, pw]),
+        v_scale=pool.v_scale.at[:, pids, offs].set(view.v_scale[:, rows, pw]))
+
+
+def paged_prefill(cfg: TransformerConfig, params: PyTree, pool: PagedKV,
+                  ptab_s: Array, toks: Array, start: Array, n_valid: Array,
+                  temperature: Array, seed: Array) -> Tuple[PagedKV, Array]:
+    """Paged analog of :func:`slot_prefill`: one chunk ``toks`` [C]
+    (C == the pool's page width — the engine aligns its prefill chunk
+    to the page size) into the slot whose page table is ``ptab_s``
+    [TBL], at chunk-aligned ``start``.  The chunk is exactly one page,
+    so persisting it is a single page write at ``ptab_s[start//C]``.
+    Returns (pool', first_token)."""
+    L, Pn, C, NH, D = pool.k.shape
+    TBL = ptab_s.shape[0]
+    quant = pool.k_scale is not None
+    k = pool.k[:, ptab_s].reshape(L, 1, TBL * C, NH, D)
+    v = pool.v[:, ptab_s].reshape(L, 1, TBL * C, NH, D)
+    if quant:
+        cache_in = QKVCache(k, v,
+                            pool.k_scale[:, ptab_s].reshape(L, 1, TBL * C),
+                            pool.v_scale[:, ptab_s].reshape(L, 1, TBL * C))
+    else:
+        cache_in = KVCache(k, v)
+    cache, logits = _prefill_chunk(cfg, params, cache_in, toks[None, :],
+                                   start)
+    last = lax.dynamic_slice_in_dim(logits[0], n_valid - 1, 1, axis=0)[0]
+    first = sample_token(last, _slot_key(seed, start + n_valid - 1),
+                         temperature)
+    pid = ptab_s[start // C]
+    page_k = lax.dynamic_slice(cache.k, (0, 0, start, 0, 0),
+                               (L, 1, C, NH, D))[:, 0]
+    page_v = lax.dynamic_slice(cache.v, (0, 0, start, 0, 0),
+                               (L, 1, C, NH, D))[:, 0]
+    pool = pool._replace(k=pool.k.at[:, pid].set(page_k),
+                         v=pool.v.at[:, pid].set(page_v))
+    if quant:
+        ps_k = lax.dynamic_slice(cache.k_scale, (0, 0, start),
+                                 (L, 1, C))[:, 0]
+        ps_v = lax.dynamic_slice(cache.v_scale, (0, 0, start),
+                                 (L, 1, C))[:, 0]
+        pool = pool._replace(k_scale=pool.k_scale.at[:, pid].set(ps_k),
+                             v_scale=pool.v_scale.at[:, pid].set(ps_v))
+    return pool, first
+
+
+def paged_decode(cfg: TransformerConfig, params: PyTree, pool: PagedKV,
+                 ptab: Array, tokens: Array, pos: Array, active: Array,
+                 temperature: Array, seeds: Array
+                 ) -> Tuple[PagedKV, Array]:
+    """Paged analog of :func:`slot_decode`: gather the view, run the
+    pinned step on it, persist each active slot's one new row.
+    ``tokens``/``pos`` are HOST-tracked in paged mode (the host knows
+    them deterministically from the fetched stream), so only the pool
+    is device state."""
+    view = _paged_view(pool, ptab, tokens, pos)
+    view2, out = slot_decode(cfg, params, view, active, temperature, seeds)
+    pool = _pool_write_back(pool, view2, ptab, pos[:, None], active)
+    return pool, out
+
+
+def paged_read_pages(pool: PagedKV, pids: Array):
+    """Gather pages ``pids`` [TBL] out of the pool (padded with trash
+    ids to the bucket's fixed table width — one traced shape per
+    bucket) for the host prefix store.  Pure read."""
+    if pool.k_scale is None:
+        return pool.k[:, pids], pool.v[:, pids]
+    return (pool.k[:, pids], pool.v[:, pids],
+            pool.k_scale[:, pids], pool.v_scale[:, pids])
+
+
+def paged_write_pages(pool: PagedKV, pids: Array, k: Array, v: Array,
+                      k_scale: Optional[Array] = None,
+                      v_scale: Optional[Array] = None) -> PagedKV:
+    """Scatter host prefix pages into pool pages ``pids`` [TBL] — the
+    host-store HIT path when the prefix is not pool-resident.  Pad
+    entries point at the trash page."""
+    out = pool._replace(k=pool.k.at[:, pids].set(k),
+                        v=pool.v.at[:, pids].set(v))
+    if pool.k_scale is None:
+        return out
+    return out._replace(k_scale=pool.k_scale.at[:, pids].set(k_scale),
+                        v_scale=pool.v_scale.at[:, pids].set(v_scale))
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (serving tier 3)
+# ---------------------------------------------------------------------------
+
+def slot_verify(cfg: TransformerConfig, params: PyTree, slots: DecodeSlots,
+                active: Array, temperature: Array, seeds: Array,
+                drafts: Array) -> Tuple[DecodeSlots, Array, Array]:
+    """Target-model verify: score every slot's current token plus its k
+    draft proposals — W = k+1 positions — in ONE batched dispatch.
+
+    Row w consumes the token at position ``pos+w`` (w=0 the current
+    token, w>=1 draft w-1) and yields the target's own sampling
+    decision t_w at key ``_slot_key(seed, pos+w)`` — the SAME key the
+    sequential path would use at that position, so the committed chain
+    is token-for-token the non-speculative chain for ANY temperature,
+    not just greedy.  Longest-accepted-prefix: with n_acc = leading
+    matches of t vs drafts, tokens t_0..t_{n_acc} commit (drafts
+    0..n_acc-1 were consumed with exactly the committed context; row
+    n_acc's logits are the target's next step after them).  K/V rows
+    past the accepted region hold rejected-token state — overwritten
+    before ever attended (pinned), or confined to the slot's own pages
+    (paged).  Returns (slots', t [S, W], n_commit [S]) with n_commit=0
+    for inactive slots."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    quant = slots.k_scale is not None
+    S = slots.tokens.shape[0]
+    T_max = slots.k.shape[2]
+    k_spec = drafts.shape[1]
+    W = k_spec + 1
+    pos = slots.pos
+    toks_w = jnp.concatenate([slots.tokens[:, None], drafts], axis=1)
+    posw = pos[:, None] + jnp.arange(W)                       # [S, W]
+    pos_c = jnp.clip(posw, 0, cfg.max_len - 1)
+    e = params["embed"]
+    x = e["tok"][toks_w] + e["pos"][pos_c]                    # [S, W, H]
+    x = tfm.layer_norm(x, e["ln_g"], e["ln_b"], cfg.layer_norm_eps)
+
+    rows = jnp.arange(S)[:, None]
+    valid = jnp.arange(T_max)[None, None, :] <= posw[:, :, None]
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    blocks = params["blocks"]
+    for layer in range(cfg.n_layers):
+        p = jax.tree.map(lambda a, l=layer: a[l], blocks)
+        h = x.astype(cdt)
+        q = jnp.einsum("bth,hnd->btnd", h, p["wq"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bq"]
+        k1 = jnp.einsum("bth,hnd->btnd", h, p["wk"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bk"]
+        v1 = jnp.einsum("bth,hnd->btnd", h, p["wv"].astype(cdt),
+                        preferred_element_type=jnp.float32) + p["bv"]
+        if quant:
+            kq, ks = _kv_quant(k1)                  # [S,W,NH,D]i8, [S,W]
+            vq, vs = _kv_quant(v1)
+            k_cache = slots.k[layer].at[rows, posw].set(kq, mode="drop")
+            v_cache = slots.v[layer].at[rows, posw].set(vq, mode="drop")
+            ks_cache = slots.k_scale[layer].at[rows, posw].set(
+                ks, mode="drop")
+            vs_cache = slots.v_scale[layer].at[rows, posw].set(
+                vs, mode="drop")
+            new_ks.append(ks_cache)
+            new_vs.append(vs_cache)
+            k_read = _kv_load(k_cache, ks_cache, cdt)
+            v_read = _kv_load(v_cache, vs_cache, cdt)
+        else:
+            k_cache = slots.k[layer].at[rows, posw].set(
+                k1.astype(cdt), mode="drop")
+            v_cache = slots.v[layer].at[rows, posw].set(
+                v1.astype(cdt), mode="drop")
+            k_read, v_read = k_cache, v_cache
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        s = jnp.einsum("bqnd,bknd->bnqk", q.astype(cdt), k_read,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid[:, None, :, :], s, -1e9)
+        probs = jax.nn.softmax(s, axis=-1).astype(cdt)
+        a = jnp.einsum("bnqk,bknd->bqnd", probs, v_read,
+                       preferred_element_type=jnp.float32)
+        a = jnp.einsum("btnd,ndh->bth", a.astype(cdt), p["wo"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["bo"]
+        x = tfm.layer_norm(x + a, p["ln1_g"], p["ln1_b"], cfg.layer_norm_eps)
+
+        h = x.astype(cdt)
+        f = jnp.einsum("bth,hf->btf", h, p["w1"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b1"]
+        f = jax.nn.gelu(f).astype(cdt)
+        f = jnp.einsum("btf,fh->bth", f, p["w2"].astype(cdt),
+                       preferred_element_type=jnp.float32) + p["b2"]
+        x = tfm.layer_norm(x + f, p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
+
+    logits = lm_logits(cfg, params, x)                        # [S, W, V]
+    keys = jax.vmap(lambda sd, pw: jax.vmap(
+        lambda pp: _slot_key(sd, pp))(pw))(seeds, posw)       # [S, W]
+    t = jax.vmap(jax.vmap(sample_token, in_axes=(0, 0, None)))(
+        logits, keys, temperature)                            # [S, W]
+    matches = (t[:, :k_spec] == drafts).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)     # [S]
+    n_commit = jnp.where(active, n_acc + 1, 0)
+    last = jnp.take_along_axis(t, n_acc[:, None], axis=1)[:, 0]
+    return DecodeSlots(
+        jnp.stack(new_k), jnp.stack(new_v),
+        jnp.where(active, last, slots.tokens),
+        pos + n_commit,
+        k_scale=jnp.stack(new_ks) if quant else None,
+        v_scale=jnp.stack(new_vs) if quant else None,
+    ), t, n_commit
+
+
+def paged_verify(cfg: TransformerConfig, params: PyTree, pool: PagedKV,
+                 ptab: Array, tokens: Array, pos: Array, active: Array,
+                 temperature: Array, seeds: Array, drafts: Array
+                 ) -> Tuple[PagedKV, Array, Array]:
+    """:func:`slot_verify` over a paged pool: gather view, verify,
+    persist the W written rows per slot (the engine pre-allocates pages
+    through ``pos + k`` so rejected rows stay within the slot's own
+    pages)."""
+    view = _paged_view(pool, ptab, tokens, pos)
+    view2, t, n_commit = slot_verify(cfg, params, view, active,
+                                     temperature, seeds, drafts)
+    posw = pos[:, None] + jnp.arange(drafts.shape[1] + 1)
+    pool = _pool_write_back(pool, view2, ptab, posw, active)
+    return pool, t, n_commit
+
+
+def draft_propose(cfg_d: TransformerConfig, params_d: PyTree,
+                  dslots: DecodeSlots, active: Array,
+                  n_steps: int) -> Tuple[DecodeSlots, Array]:
+    """Draft-model proposal: k greedy single-token steps (a lax.scan of
+    :func:`slot_decode` at temperature 0) from the draft's mirror of
+    the committed stream.  The draft needs NO re-sync dispatch between
+    rounds: its rows at the accepted positions consumed exactly the
+    committed tokens (that is what acceptance means), so after the host
+    advances its tokens/pos to the commit frontier every row below it
+    is already correct.  Returns (dslots', proposals [S, k]) — the
+    proposals stay on device and feed straight into the verify
+    dispatch."""
+    S = dslots.tokens.shape[0]
+    zt = jnp.zeros((S,), jnp.float32)
+    zs = jnp.zeros((S,), jnp.uint32)
+
+    def body(s, _):
+        s, t = slot_decode(cfg_d, params_d, s, active, zt, zs)
+        return s, t
+
+    dslots, props = lax.scan(body, dslots, None, length=n_steps)
+    return dslots, jnp.moveaxis(props, 0, 1)
+
+
+def paged_draft_propose(cfg_d: TransformerConfig, params_d: PyTree,
+                        dpool: PagedKV, ptab: Array, tokens: Array,
+                        pos: Array, active: Array, n_steps: int
+                        ) -> Tuple[PagedKV, Array]:
+    """:func:`draft_propose` over a paged draft pool sharing the
+    TARGET's page table (same positions, same page ids — one allocator
+    covers both pools)."""
+    S = tokens.shape[0]
+    zt = jnp.zeros((S,), jnp.float32)
+    zs = jnp.zeros((S,), jnp.uint32)
+
+    def body(carry, _):
+        pool, toks, ps = carry
+        view = _paged_view(pool, ptab, toks, ps)
+        view2, t = slot_decode(cfg_d, params_d, view, active, zt, zs)
+        pool = _pool_write_back(pool, view2, ptab, ps[:, None], active)
+        return (pool,
+                jnp.where(active, t, toks),
+                ps + active.astype(jnp.int32)), t
+
+    (dpool, _, _), props = lax.scan(body, (dpool, tokens, pos), None,
+                                    length=n_steps)
+    return dpool, jnp.moveaxis(props, 0, 1)
+
+
 def make_serving_apply(cfg: TransformerConfig):
     """(apply_fn, cache_key) for serving/engine.InferenceEngine: token
     ids [B, T] -> next-token logits [B, T, vocab] via the dense forward

@@ -284,6 +284,24 @@ class DecodeMetrics:
       (already at max replicas and over the depth bound) — disjoint
       from ``requests_shed``-only sheds of the static router
       (``note_shed(by_policy=True)`` books both).
+
+    Serving tier 3 (paged KV + speculative decoding + hot swap) — same
+    ``"decode"`` family, no new registry source:
+
+    - ``pages_in_use`` / ``pages_in_use_hw``: live KV pages allocated
+      out of the paged engine's pool (gauge + high-water) — the paged
+      analog of slot occupancy;
+    - ``page_token_rows`` / ``page_capacity_rows``: live token rows vs
+      rows the allocated pages could hold, summed over dispatches —
+      ``snapshot()['page_utilization']`` is their ratio (how little of
+      each page is padding; pinned slots would score
+      live/bucket-length);
+    - ``draft_proposed`` / ``draft_accepted``: speculative draft tokens
+      proposed vs accepted by the target's verify —
+      ``snapshot()['draft_accept_rate']``;
+    - ``swaps_completed`` / ``requests_during_swap``: hot checkpoint
+      swaps finished by ``AutoscalingRouter.swap_weights`` and requests
+      accepted while one was in progress (the zero-downtime witness).
     """
 
     MAX_SAMPLES = 8192
@@ -313,6 +331,14 @@ class DecodeMetrics:
             self.replicas_added = 0
             self.replicas_removed = 0
             self.shed_by_policy = 0
+            self.pages_in_use = 0
+            self.pages_in_use_hw = 0
+            self.page_token_rows = 0
+            self.page_capacity_rows = 0
+            self.draft_proposed = 0
+            self.draft_accepted = 0
+            self.swaps_completed = 0
+            self.requests_during_swap = 0
             self._ttft_ms: List[float] = []
             self._tok_ms: List[float] = []
             self._compile_mark: Optional[int] = None
@@ -349,6 +375,27 @@ class DecodeMetrics:
         with self._lock:
             self.replicas_added += added
             self.replicas_removed += removed
+
+    def note_pages(self, in_use: int, live_rows: int,
+                   page_tokens: int) -> None:
+        with self._lock:
+            self.pages_in_use = int(in_use)
+            self.pages_in_use_hw = max(self.pages_in_use_hw, int(in_use))
+            self.page_token_rows += int(live_rows)
+            self.page_capacity_rows += int(in_use) * int(page_tokens)
+
+    def note_spec(self, proposed: int, accepted: int) -> None:
+        with self._lock:
+            self.draft_proposed += int(proposed)
+            self.draft_accepted += int(accepted)
+
+    def note_swap(self) -> None:
+        with self._lock:
+            self.swaps_completed += 1
+
+    def note_request_during_swap(self) -> None:
+        with self._lock:
+            self.requests_during_swap += 1
 
     def note_complete(self, tokens: int) -> None:
         with self._lock:
@@ -412,6 +459,18 @@ class DecodeMetrics:
                 "replicas_added": self.replicas_added,
                 "replicas_removed": self.replicas_removed,
                 "shed_by_policy": self.shed_by_policy,
+                "pages_in_use": self.pages_in_use,
+                "pages_in_use_hw": self.pages_in_use_hw,
+                "page_utilization": round(
+                    self.page_token_rows / self.page_capacity_rows, 4)
+                if self.page_capacity_rows else 0.0,
+                "draft_proposed": self.draft_proposed,
+                "draft_accepted": self.draft_accepted,
+                "draft_accept_rate": round(
+                    self.draft_accepted / self.draft_proposed, 4)
+                if self.draft_proposed else 0.0,
+                "swaps_completed": self.swaps_completed,
+                "requests_during_swap": self.requests_during_swap,
                 "ttft_p50_ms": ServingMetrics._pct(ttft, 0.50),
                 "ttft_p99_ms": ServingMetrics._pct(ttft, 0.99),
                 "tok_p50_ms": ServingMetrics._pct(tok, 0.50),

@@ -34,6 +34,22 @@ weight quantization itself):
   scale-up/down and load-shedding driven by live queue-depth/TTFT
   telemetry with hysteresis, instead of the static bound.
 
+Serving tier 3 (live tokens, live weights, raw tokens/s):
+
+- ``DecodeEngine(paged=True, n_pages=)``: the KV cache becomes a pool
+  of fixed-size pages (``KV_PAGE_TOKENS`` rows each) with per-slot
+  page tables — slots/chip bounded by LIVE tokens, not bucket length;
+  prefix hits mount pool-resident pages BY REFERENCE (refcounted
+  ``PageAllocator``); pool exhaustion stalls, then sheds with the
+  typed ``KVPagesExhausted``.
+- ``AutoscalingRouter.swap_weights(params)`` + engine
+  ``rebind_params``: zero-downtime hot checkpoint swap — drain one
+  replica at a time, requantize off the serving workers, zero dropped
+  requests, zero new compiles.
+- ``DecodeEngine(draft=(cfg_d, params_d), draft_k=)``: draft-model
+  speculative decoding — k proposed tokens verified in ONE target
+  dispatch, bit-identical to plain decode at any temperature.
+
 ``MultiLayerNetwork.output/predict/score`` and ``Evaluation.eval`` route
 through this layer; the per-model adapters live next to each model
 (``models/*.make_serving_apply``).  Metrics:
@@ -43,7 +59,8 @@ through this layer; the per-model adapters live next to each model
 
 from deeplearning4j_tpu.serving.batcher import DynamicBatcher  # noqa: F401
 from deeplearning4j_tpu.serving.decode import (  # noqa: F401
-    ContinuousBatcher, DecodeEngine, DecodeRequest, PrefixCache,
+    KV_PAGE_TOKENS, ContinuousBatcher, DecodeEngine, DecodeRequest,
+    KVPagesExhausted, PageAllocator, PrefixCache,
     default_length_buckets,
 )
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401

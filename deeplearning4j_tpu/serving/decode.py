@@ -82,6 +82,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -90,6 +91,92 @@ from deeplearning4j_tpu.parallel.mesh import (MODEL_AXIS, mesh_signature,
                                               model_degree)
 from deeplearning4j_tpu.runtime import compile_cache, quantize as qz, telemetry
 from deeplearning4j_tpu.runtime.metrics import decode_metrics
+
+
+#: tokens per KV page — ONE constant shared by the paged allocator and
+#: the PrefixCache's chunk alignment (== gpt.PREFILL_CHUNK, drift-guarded
+#: by tests/test_serving_tier3.py): harvested prefix pages mount into
+#: paged slots without re-chunking, and every prefill chunk is exactly
+#: one page write
+KV_PAGE_TOKENS = gpt.PREFILL_CHUNK
+
+
+class KVPagesExhausted(RuntimeError):
+    """Typed page-pool exhaustion: an admit/extend needed more KV pages
+    than the paged engine's pool has free.  Admission gates on
+    ``DecodeEngine.can_admit`` and in-flight slots STALL (retry next
+    dispatch) before this is raised; it reaches a request only when the
+    pool cannot make progress at all (deadlock breaker evicts the
+    youngest stalled slot) or a prompt alone exceeds the whole pool."""
+
+    def __init__(self, needed: int, free: int, total: int,
+                 bucket: Optional[int] = None, slot: Optional[int] = None):
+        super().__init__(
+            f"KV page pool exhausted: need {needed} page(s), "
+            f"{free} free of {total}")
+        self.needed = needed
+        self.free = free
+        self.total = total
+        self.bucket = bucket
+        self.slot = slot
+
+
+class PageAllocator:
+    """Host-side refcounted free-list allocator over the paged engine's
+    pool ids.  Page 0 is RESERVED (the trash page inactive-slot writes
+    are redirected into) and never handed out.  ``alloc`` is
+    all-or-nothing (typed :class:`KVPagesExhausted` on shortfall),
+    ``share`` bumps refcounts for by-reference prefix mounts, ``free``
+    releases one reference and reclaims the page at zero — a shared
+    prefix page outlives the slot that harvested it.  Not thread-safe
+    on its own: exactly the engine's driver thread mutates it (the
+    engine's single-thread contract)."""
+
+    def __init__(self, n_pages: int, n_reserved: int = 1):
+        if n_pages <= n_reserved:
+            raise ValueError(
+                f"n_pages must exceed the {n_reserved} reserved page(s): "
+                f"{n_pages}")
+        self.n_pages = int(n_pages)
+        self.n_reserved = int(n_reserved)
+        self._free: List[int] = list(range(n_pages - 1, n_reserved - 1, -1))
+        self._refs: Dict[int, int] = {}
+
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def in_use(self) -> int:
+        return self.n_pages - self.n_reserved - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n < 0:
+            raise ValueError(f"alloc count must be >= 0: {n}")
+        if n > len(self._free):
+            raise KVPagesExhausted(n, len(self._free), self.n_pages)
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def share(self, pids: Sequence[int]) -> None:
+        for p in pids:
+            if p not in self._refs:
+                raise ValueError(f"page {p} is not allocated")
+            self._refs[p] += 1
+
+    def free(self, pids: Sequence[int]) -> None:
+        for p in pids:
+            refs = self._refs.get(p)
+            if refs is None:
+                raise ValueError(f"page {p} is not allocated")
+            if refs == 1:
+                del self._refs[p]
+                self._free.append(p)
+            else:
+                self._refs[p] = refs - 1
+
+    def refcount(self, pid: int) -> int:
+        return self._refs.get(pid, 0)
 
 
 def default_length_buckets(max_len: int, min_bucket: int = 32
@@ -273,15 +360,36 @@ class _Bucket:
     state plus the occupancy/sampling arrays the decode dispatch takes
     each step."""
 
-    __slots__ = ("t_max", "slots", "active", "temps", "seeds", "owners")
+    __slots__ = ("t_max", "slots", "active", "temps", "seeds", "owners",
+                 "ptab", "n_pages", "tokens_h", "pos_h", "ran")
 
-    def __init__(self, t_max: int, n_slots: int):
+    def __init__(self, t_max: int, n_slots: int,
+                 page_tokens: Optional[int] = None):
         self.t_max = t_max
         self.slots = None                       # DecodeSlots, lazy-init
         self.active = np.zeros((n_slots,), np.bool_)
         self.temps = np.zeros((n_slots,), np.float32)
         self.seeds = np.zeros((n_slots,), np.uint32)
         self.owners: List[Any] = [None] * n_slots
+        # paged mode: per-slot page table (trash-id 0 in unused
+        # entries), allocated-page counts, and host mirrors of
+        # tokens/pos (deterministic from the fetched stream — the pool
+        # is the only per-bucket DEVICE state); ``ran`` is the last
+        # dispatch's progress mask (a slot stalls when its next page
+        # cannot be allocated)
+        self.ran = np.zeros((n_slots,), np.bool_)
+        # tokens_h/pos_h exist in EVERY mode: speculative decoding on a
+        # pinned engine also mirrors the committed stream host-side
+        # (the draft dispatch takes them — the draft's device tokens/pos
+        # are overwritten per round with the verified frontier)
+        self.tokens_h = np.zeros((n_slots,), np.int32)
+        self.pos_h = np.zeros((n_slots,), np.int32)
+        if page_tokens is not None:
+            tbl = t_max // page_tokens
+            self.ptab = np.zeros((n_slots, tbl), np.int32)
+            self.n_pages = np.zeros((n_slots,), np.int32)
+        else:
+            self.ptab = None
 
     def free_slot(self) -> Optional[int]:
         for i, o in enumerate(self.owners):
@@ -321,13 +429,21 @@ class DecodeEngine:
                  label: str = "decode", mesh=None,
                  quantize: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
-                 prefix_cache: Any = None):
+                 prefix_cache: Any = None,
+                 paged: Any = False, n_pages: Optional[int] = None,
+                 draft: Optional[Tuple[Any, Any]] = None,
+                 draft_k: int = 4):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1: {n_slots}")
         self.cfg = cfg
         self._params = params
         self.mesh = mesh
         self.n_slots = int(n_slots)
+        self.paged = bool(paged) or n_pages is not None
+        self.draft = draft
+        self.draft_k = int(draft_k)
+        if draft is not None and self.draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1: {draft_k}")
         self.quantize = qz.check_mode(quantize)
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8': {kv_dtype!r}")
@@ -337,8 +453,13 @@ class DecodeEngine:
         self._prefix: Optional[PrefixCache] = prefix_cache or None
         # the KV space the engine's pages live in: a store shared
         # across replicas only serves hits between engines whose pages
-        # are interchangeable (same conf, same quantization modes)
-        self._prefix_space = (repr(cfg), quantize, kv_dtype)
+        # are interchangeable (same conf, same quantization modes, same
+        # params GENERATION — rebind_params bumps the generation, so a
+        # freshly-swapped replica can never hit pages an old-params
+        # replica harvested into the shared store mid-swap; paged and
+        # pinned engines interop because the space is mode-free)
+        self._params_gen = 0
+        self._prefix_space = (repr(cfg), quantize, kv_dtype, 0)
         self._qmemo = qz.QuantMemo()
         self._static_quantized = False
         self.prefill_chunk = int(prefill_chunk)
@@ -367,12 +488,72 @@ class DecodeEngine:
                 f"prefill_chunk must be >= 1: {self.prefill_chunk}")
         self.prefill_chunk = chunk
         self.label = label
+        # paged geometry: the page width IS the (gcd-shrunk) prefill
+        # chunk, so every prefill chunk is exactly one page write and
+        # chunk-aligned prefix pages mount page-aligned.  The pool
+        # defaults to pinned-equivalent capacity (+ the trash page);
+        # pass n_pages to shrink it — bounding HBM by live tokens is
+        # the point of the knob.
+        self.page_tokens = chunk if self.paged else None
+        self.n_kv_pages: Optional[int] = None
+        self._alloc: Optional[PageAllocator] = None
+        self._pool = None
+        self._dpool = None
+        self._dslots: Dict[int, Any] = {}
+        self._resident: "OrderedDict[bytes, Tuple[np.ndarray, Tuple[int, ...]]]" = OrderedDict()
+        if self.paged:
+            default_pages = self.n_slots * (self.buckets[-1] // chunk) + 1
+            self.n_kv_pages = int(n_pages or default_pages)
+            self._alloc = PageAllocator(self.n_kv_pages)
+            self._resident_max = max(self.n_kv_pages // 2, 1)
+        cfg_d = None
+        self._draft_cfg = self._draft_params = None
+        if draft is not None:
+            cfg_d, self._draft_params = draft
+            self._draft_cfg = cfg_d
+            if not getattr(cfg_d, "causal", False):
+                raise ValueError("draft config must be causal")
+            if cfg_d.max_len < self.buckets[-1]:
+                raise ValueError(
+                    f"draft max_len {cfg_d.max_len} < largest bucket "
+                    f"{self.buckets[-1]}: the draft mirrors target "
+                    f"positions")
         self._buckets: Dict[int, _Bucket] = {
-            t: _Bucket(t, self.n_slots) for t in self.buckets}
-        prefill_fn, decode_fn, key = gpt.make_slot_fns(cfg)
+            t: _Bucket(t, self.n_slots, self.page_tokens)
+            for t in self.buckets}
+        verify_fn = None
+        if self.paged:
+            key = ("gpt_slots", repr(cfg))
+
+            def prefill_fn(params, pool, ptab_s, toks, start, n_valid,
+                           temperature, seed):
+                return gpt.paged_prefill(cfg, params, pool, ptab_s, toks,
+                                         start, n_valid, temperature, seed)
+
+            def decode_fn(params, pool, ptab, tokens, pos, active,
+                          temperature, seeds):
+                return gpt.paged_decode(cfg, params, pool, ptab, tokens,
+                                        pos, active, temperature, seeds)
+
+            if draft is not None:
+                def verify_fn(params, pool, ptab, tokens, pos, active,
+                              temperature, seeds, drafts):
+                    return gpt.paged_verify(cfg, params, pool, ptab,
+                                            tokens, pos, active,
+                                            temperature, seeds, drafts)
+        else:
+            prefill_fn, decode_fn, key = gpt.make_slot_fns(cfg)
+            if draft is not None:
+                def verify_fn(params, slots, active, temperature, seeds,
+                              drafts):
+                    return gpt.slot_verify(cfg, params, slots, active,
+                                           temperature, seeds, drafts)
         if self.quantize is not None:
             # dequant fused INTO the jitted programs: the executables
-            # take the quantized tree and stream int8 bytes from HBM
+            # take the quantized tree and stream int8 bytes from HBM.
+            # The DRAFT model stays full-precision (it is already tiny
+            # — quantizing it buys nothing and would couple its
+            # numerics to the target's quantization mode).
             base_prefill, base_decode = prefill_fn, decode_fn
 
             def prefill_fn(params, *a):
@@ -380,6 +561,12 @@ class DecodeEngine:
 
             def decode_fn(params, *a):
                 return base_decode(qz.dequantize_tree(params), *a)
+
+            if verify_fn is not None:
+                base_verify = verify_fn
+
+                def verify_fn(params, *a):
+                    return base_verify(qz.dequantize_tree(params), *a)
         # one executable pair per (conf, slot-geometry, mesh,
         # quantization mode, kv dtype): the shapes traced differ only in
         # T_max across buckets, so the compile count is bounded by 2 x
@@ -390,13 +577,22 @@ class DecodeEngine:
         # key their own entries — a dequant-fused program must never be
         # served to a full-precision engine or vice versa
         geo = (self.n_slots, self.prefill_chunk, mesh_signature(mesh),
-               self.quantize, self.kv_dtype)
+               self.quantize, self.kv_dtype,
+               ("paged", self.n_kv_pages) if self.paged else None,
+               (repr(cfg_d), self.draft_k) if draft is not None else None)
         shard_kw_prefill: Dict[str, Any] = {}
         shard_kw_decode: Dict[str, Any] = {}
         shard_kw_read: Dict[str, Any] = {}
         shard_kw_write: Dict[str, Any] = {}
+        shard_kw_verify: Dict[str, Any] = {}
+        shard_kw_draft: Dict[str, Any] = {}
+        shard_kw_dprefill: Dict[str, Any] = {}
         self._slot_shardings = None
         self._param_shardings = None
+        self._pool_shardings = None
+        self._dpool_shardings = None
+        self._dslot_shardings = None
+        self._draft_shardings = None
         if mesh is not None:
             from deeplearning4j_tpu.parallel.sharded_fit import \
                 named_shardings
@@ -414,29 +610,94 @@ class DecodeEngine:
                 pspecs = qz.quant_specs(pspecs, self._raw_params(),
                                         self.quantize)
             psh = named_shardings(mesh, pspecs)
-            ssh = named_shardings(mesh, gpt.slot_specs(cfg, self.kv_dtype))
             repl = NamedSharding(mesh, P())
-            self._slot_shardings = ssh
             self._param_shardings = psh
-            # prefill(params, slots, toks, slot, start, n_valid, temp,
-            # seed) / decode(params, slots, active, temps, seeds): only
-            # params and the slot state carry a layout
-            shard_kw_prefill = dict(
-                in_shardings=(psh, ssh) + (repl,) * 6,
-                out_shardings=(ssh, repl))
-            shard_kw_decode = dict(
-                in_shardings=(psh, ssh) + (repl,) * 3,
-                out_shardings=(ssh, repl))
-            # prefix pages [L, T_max, NH, D] shard over heads like the
-            # cache rows they copy; int8 scale pages replicated
-            page_sh = (NamedSharding(mesh, P(None, None, MODEL_AXIS,
-                                             None)),) * 2
-            if self.kv_dtype == "int8":
-                page_sh = page_sh + (repl, repl)
-            shard_kw_read = dict(in_shardings=(ssh, repl),
-                                 out_shardings=page_sh)
-            shard_kw_write = dict(in_shardings=(ssh, repl) + page_sh,
-                                  out_shardings=ssh)
+            if self.paged:
+                poolsh = named_shardings(
+                    mesh, gpt.paged_specs(cfg, self.kv_dtype))
+                self._pool_shardings = poolsh
+                # paged_prefill(params, pool, ptab_s, toks, start,
+                # n_valid, temp, seed) / paged_decode(params, pool,
+                # ptab, tokens, pos, active, temps, seeds): only params
+                # and the pool carry a layout
+                shard_kw_prefill = dict(
+                    in_shardings=(psh, poolsh) + (repl,) * 6,
+                    out_shardings=(poolsh, repl))
+                shard_kw_decode = dict(
+                    in_shardings=(psh, poolsh) + (repl,) * 6,
+                    out_shardings=(poolsh, repl))
+                # prefix pages [L, TBL, C, NH, D] shard over heads like
+                # the pool rows they copy; int8 scale pages replicated
+                page_sh = (NamedSharding(
+                    mesh, P(None, None, None, MODEL_AXIS, None)),) * 2
+                if self.kv_dtype == "int8":
+                    page_sh = page_sh + (repl, repl)
+                shard_kw_read = dict(in_shardings=(poolsh, repl),
+                                     out_shardings=page_sh)
+                shard_kw_write = dict(in_shardings=(poolsh, repl) + page_sh,
+                                      out_shardings=poolsh)
+                if draft is not None:
+                    shard_kw_verify = dict(
+                        in_shardings=(psh, poolsh) + (repl,) * 7,
+                        out_shardings=(poolsh, repl, repl))
+            else:
+                ssh = named_shardings(
+                    mesh, gpt.slot_specs(cfg, self.kv_dtype))
+                self._slot_shardings = ssh
+                # prefill(params, slots, toks, slot, start, n_valid,
+                # temp, seed) / decode(params, slots, active, temps,
+                # seeds): only params and the slot state carry a layout
+                shard_kw_prefill = dict(
+                    in_shardings=(psh, ssh) + (repl,) * 6,
+                    out_shardings=(ssh, repl))
+                shard_kw_decode = dict(
+                    in_shardings=(psh, ssh) + (repl,) * 3,
+                    out_shardings=(ssh, repl))
+                # prefix pages [L, T_max, NH, D] shard over heads like
+                # the cache rows they copy; int8 scale pages replicated
+                page_sh = (NamedSharding(mesh, P(None, None, MODEL_AXIS,
+                                                 None)),) * 2
+                if self.kv_dtype == "int8":
+                    page_sh = page_sh + (repl, repl)
+                shard_kw_read = dict(in_shardings=(ssh, repl),
+                                     out_shardings=page_sh)
+                shard_kw_write = dict(in_shardings=(ssh, repl) + page_sh,
+                                      out_shardings=ssh)
+                if draft is not None:
+                    shard_kw_verify = dict(
+                        in_shardings=(psh, ssh) + (repl,) * 4,
+                        out_shardings=(ssh, repl, repl))
+            if draft is not None:
+                if cfg_d.n_heads % m_deg:
+                    raise ValueError(
+                        f"draft n_heads={cfg_d.n_heads} not divisible "
+                        f"by model degree {m_deg}: the draft KV shards "
+                        f"over heads alongside the target's")
+                dpsh = named_shardings(
+                    mesh, gpt.shard_specs(cfg_d, model_degree=m_deg))
+                self._draft_shardings = dpsh
+                self._draft_params = jax.device_put(
+                    self._draft_params, dpsh)
+                if self.paged:
+                    dpoolsh = named_shardings(
+                        mesh, gpt.paged_specs(cfg_d, self.kv_dtype))
+                    self._dpool_shardings = dpoolsh
+                    shard_kw_draft = dict(
+                        in_shardings=(dpsh, dpoolsh) + (repl,) * 4,
+                        out_shardings=(dpoolsh, repl))
+                    shard_kw_dprefill = dict(
+                        in_shardings=(dpsh, dpoolsh) + (repl,) * 4,
+                        out_shardings=dpoolsh)
+                else:
+                    dssh = named_shardings(
+                        mesh, gpt.slot_specs(cfg_d, self.kv_dtype))
+                    self._dslot_shardings = dssh
+                    shard_kw_draft = dict(
+                        in_shardings=(dpsh, dssh, repl),
+                        out_shardings=(dssh, repl))
+                    shard_kw_dprefill = dict(
+                        in_shardings=(dpsh, dssh) + (repl,) * 4,
+                        out_shardings=dssh)
         self._prefill = compile_cache.cached_jit(
             prefill_fn, key=(key, geo, "prefill"),
             label=f"{label}.prefill", donate_argnums=(1,),
@@ -445,20 +706,78 @@ class DecodeEngine:
             decode_fn, key=(key, geo, "step"),
             label=f"{label}.step", donate_argnums=(1,),
             **shard_kw_decode)
+        self._verify = self._draft_fn = self._draft_prefill = None
+        if draft is not None:
+            k_steps = self.draft_k
+            if self.paged:
+                def draft_fn(params_d, dpool, ptab, tokens, pos, active):
+                    return gpt.paged_draft_propose(
+                        cfg_d, params_d, dpool, ptab, tokens, pos,
+                        active, k_steps)
+
+                def draft_prefill_fn(params_d, dpool, ptab_s, toks,
+                                     start, n_valid):
+                    p, _ = gpt.paged_prefill(
+                        cfg_d, params_d, dpool, ptab_s, toks, start,
+                        n_valid, jnp.float32(0.0), jnp.uint32(0))
+                    return p
+            else:
+                def draft_fn(params_d, dslots, active):
+                    return gpt.draft_propose(cfg_d, params_d, dslots,
+                                             active, k_steps)
+
+                def draft_prefill_fn(params_d, dslots, toks, slot,
+                                     start, n_valid):
+                    s, _ = gpt.slot_prefill(
+                        cfg_d, params_d, dslots, toks, slot, start,
+                        n_valid, jnp.float32(0.0), jnp.uint32(0))
+                    return s
+            self._verify = compile_cache.cached_jit(
+                verify_fn, key=(key, geo, "verify"),
+                label=f"{label}.verify", donate_argnums=(1,),
+                **shard_kw_verify)
+            self._draft_fn = compile_cache.cached_jit(
+                draft_fn, key=(key, geo, "draft"),
+                label=f"{label}.draft", donate_argnums=(1,),
+                **shard_kw_draft)
+            self._draft_prefill = compile_cache.cached_jit(
+                draft_prefill_fn, key=(key, geo, "draft_prefill"),
+                label=f"{label}.draft_prefill", donate_argnums=(1,),
+                **shard_kw_dprefill)
         self._read = self._write = None
         if self._prefix is not None:
-            self._read = compile_cache.cached_jit(
-                gpt.slot_read_pages, key=(key, geo, "prefix_read"),
-                label=f"{label}.prefix_read", **shard_kw_read)
-            self._write = compile_cache.cached_jit(
-                gpt.slot_write_pages, key=(key, geo, "prefix_write"),
-                label=f"{label}.prefix_write", donate_argnums=(0,),
-                **shard_kw_write)
+            if self.paged:
+                self._read = compile_cache.cached_jit(
+                    gpt.paged_read_pages, key=(key, geo, "prefix_read"),
+                    label=f"{label}.prefix_read", **shard_kw_read)
+                self._write = compile_cache.cached_jit(
+                    gpt.paged_write_pages, key=(key, geo, "prefix_write"),
+                    label=f"{label}.prefix_write", donate_argnums=(0,),
+                    **shard_kw_write)
+            else:
+                self._read = compile_cache.cached_jit(
+                    gpt.slot_read_pages, key=(key, geo, "prefix_read"),
+                    label=f"{label}.prefix_read", **shard_kw_read)
+                self._write = compile_cache.cached_jit(
+                    gpt.slot_write_pages, key=(key, geo, "prefix_write"),
+                    label=f"{label}.prefix_write", donate_argnums=(0,),
+                    **shard_kw_write)
         #: KV bytes one slot of the largest bucket costs — the 'slots
         #: per chip' capacity denominator (int8 KV is the ~4x/2x lever)
         self.kv_bytes_per_slot = int(gpt.slots_bytes_per_slot(
             cfg, self.buckets[-1], self.kv_dtype))
         decode_metrics.note_kv_bytes_per_slot(self.kv_bytes_per_slot)
+        #: total paged-pool HBM (target + draft pools) — the paged
+        #: capacity denominator: slots/chip at a given HBM budget is
+        #: bounded by live tokens, not bucket length
+        self.pool_bytes = 0
+        if self.paged:
+            self.pool_bytes = int(gpt.pages_bytes(
+                cfg, self.n_kv_pages, self.page_tokens, self.kv_dtype))
+            if draft is not None:
+                self.pool_bytes += int(gpt.pages_bytes(
+                    cfg_d, self.n_kv_pages, self.page_tokens,
+                    self.kv_dtype))
         # prefix harvesting is ASYNC: the page read dispatches on the
         # serving thread (cheap), but the device->host transfer +
         # store insert run on a harvest worker so they never stall the
@@ -541,6 +860,201 @@ class DecodeEngine:
             b.slots = slots
         return b.slots
 
+    def _pool_state(self):
+        """Lazily materialize the page pool(s) — ONE pool shared by
+        every bucket (page shape is bucket-independent; only the page
+        TABLE width differs per bucket)."""
+        if self._pool is None:
+            pool = gpt.init_pages(self.cfg, self.n_kv_pages,
+                                  self.page_tokens, self.kv_dtype)
+            if self._pool_shardings is not None:
+                pool = jax.device_put(pool, self._pool_shardings)
+            self._pool = pool
+        if self.draft is not None and self._dpool is None:
+            # the draft pool is indexed by the SAME page tables as the
+            # target's (same positions, same allocator) — one allocator
+            # covers both models
+            dpool = gpt.init_pages(self._draft_cfg, self.n_kv_pages,
+                                   self.page_tokens, self.kv_dtype)
+            if self._dpool_shardings is not None:
+                dpool = jax.device_put(dpool, self._dpool_shardings)
+            self._dpool = dpool
+        return self._pool
+
+    def _dslots_state(self, b: _Bucket):
+        """Pinned-mode draft KV slots, one state per bucket (mirrors
+        ``_state`` for the draft model)."""
+        d = self._dslots.get(b.t_max)
+        if d is None:
+            d = gpt.init_slots(self._draft_cfg, self.n_slots, b.t_max,
+                               kv_dtype=self.kv_dtype)
+            if self._dslot_shardings is not None:
+                d = jax.device_put(d, self._dslot_shardings)
+            self._dslots[b.t_max] = d
+        return d
+
+    def _live_rows(self) -> int:
+        """Token rows currently live across all paged slots — the
+        page_utilization numerator."""
+        return int(sum(int(bb.pos_h[bb.active].sum())
+                       for bb in self._buckets.values()))
+
+    # -- paged admission / page tables -------------------------------------
+    def can_admit(self, bucket: int, prompt_len: int) -> bool:
+        """Room for a request in ``bucket`` RIGHT NOW?  Slot
+        availability plus, for a paged engine, enough free pages for
+        the prompt and its first decode page.  In-flight growth past
+        that STALLS rather than deadlocks, so admission only gates on
+        the prompt floor."""
+        if self._buckets[bucket].free_slot() is None:
+            return False
+        if not self.paged:
+            return True
+        C = self.page_tokens
+        needed = -(-prompt_len // C) + 1
+        return self._alloc.n_free() >= needed
+
+    def check_capacity(self, prompt_len: int) -> None:
+        """Raise the typed error when a prompt alone can NEVER fit the
+        pool — the sync-validate path for oversize paged admits."""
+        if not self.paged:
+            return
+        C = self.page_tokens
+        needed = -(-prompt_len // C) + 1
+        total = self.n_kv_pages - self._alloc.n_reserved
+        if needed > total:
+            raise KVPagesExhausted(needed, total, self.n_kv_pages)
+
+    def last_ran(self, bucket: int) -> np.ndarray:
+        """[S] mask of slots the last advance/advance_spec actually
+        moved — paged slots can STALL on page exhaustion (their token
+        output is stale and must be ignored); pinned engines always run
+        every active slot."""
+        return self._buckets[bucket].ran.copy()
+
+    def _ensure_pages(self, b: _Bucket, span: int) -> np.ndarray:
+        """Grow each active slot's page table to cover writes through
+        ``pos + span``.  Slots whose pages cannot be allocated STALL —
+        masked out of this dispatch, retried next — and when nothing
+        active can run at all the deadlock breaker raises the typed
+        error naming a victim (the slot pinning the most pages, so
+        evicting it frees the most room).  Returns the runnable mask."""
+        run = b.active.copy()
+        C = self.page_tokens
+        for s in np.flatnonzero(b.active):
+            need = int(b.pos_h[s] + span) // C + 1
+            need = min(need, b.ptab.shape[1])
+            short = need - int(b.n_pages[s])
+            if short <= 0:
+                continue
+            try:
+                ids = self._alloc.alloc(short)
+            except KVPagesExhausted:
+                run[s] = False
+                continue
+            b.ptab[s, int(b.n_pages[s]):need] = ids
+            b.n_pages[s] = need
+        if b.active.any() and not run.any():
+            victim = int(max(np.flatnonzero(b.active),
+                             key=lambda s: int(b.n_pages[s])))
+            raise KVPagesExhausted(1, self._alloc.n_free(),
+                                   self.n_kv_pages, bucket=b.t_max,
+                                   slot=victim)
+        return run
+
+    def _release_pages(self, b: _Bucket, slot: int) -> None:
+        n = int(b.n_pages[slot])
+        if n:
+            self._alloc.free(int(p) for p in b.ptab[slot, :n])
+        b.ptab[slot, :] = 0
+        b.n_pages[slot] = 0
+        b.tokens_h[slot] = 0
+        b.pos_h[slot] = 0
+        decode_metrics.note_pages(self._alloc.in_use(), 0, 0)
+
+    # -- pool-resident prefix pages ----------------------------------------
+    def _resident_lookup(self, prompt: np.ndarray):
+        """Longest pool-RESIDENT chunk-aligned strict prefix of
+        ``prompt`` — the mount-by-reference hit path: the hitting
+        slot's page table points at the shared pages directly (zero
+        copy, zero dispatch).  Writes can never touch them: the slot's
+        own rows start at the next page boundary and its release only
+        DECREFS the shared ids."""
+        C = self.page_tokens
+        n = (prompt.size - 1) // C
+        if n < 1 or not self._resident:
+            return 0, None
+        digs = PrefixCache._boundary_digests(prompt, C, n,
+                                             self._prefix_space)
+        for k in range(n, 0, -1):
+            ent = self._resident.get(digs[k - 1])
+            if ent is not None and np.array_equal(ent[0],
+                                                  prompt[:k * C]):
+                self._resident.move_to_end(digs[k - 1])
+                return k * C, ent[1]
+        return 0, None
+
+    def _resident_register(self, prompt: np.ndarray, b: _Bucket,
+                           slot: int) -> None:
+        """Register the slot's chunk-aligned prompt prefix pages as
+        pool-resident at every chunk boundary (so a partial prefix
+        match still hits).  The registry holds its own reference on
+        each page — the pages outlive the harvesting slot and return
+        to the pool when the LRU bound (or a weight swap) evicts the
+        entry and the last sharer releases."""
+        C = self.page_tokens
+        m = C * ((prompt.size - 1) // C)
+        if m < C:
+            return
+        digs = PrefixCache._boundary_digests(prompt, C, m // C,
+                                             self._prefix_space)
+        for k in range(1, m // C + 1):
+            ids = tuple(int(p) for p in b.ptab[slot, :k])
+            old = self._resident.pop(digs[k - 1], None)
+            if old is not None:
+                self._alloc.free(old[1])
+            self._alloc.share(ids)
+            self._resident[digs[k - 1]] = (prompt[:k * C].copy(), ids)
+        while (sum(len(v[1]) for v in self._resident.values())
+               > self._resident_max and self._resident):
+            _, (_, ids) = self._resident.popitem(last=False)
+            self._alloc.free(ids)
+
+    # -- hot checkpoint swap -----------------------------------------------
+    def rebind_params(self, params: Any,
+                      draft_params: Any = None) -> None:
+        """Hot checkpoint swap, engine side: replace the params tree
+        while IDLE (the router drains this replica first; a busy rebind
+        raises).  Same shapes/dtypes → the executables and their
+        compile-cache entries are reused untouched: ZERO new compiles.
+        Quantization re-runs lazily on the next ``current_params()`` —
+        call that on the swap thread to keep the requantize cost off
+        the serving worker.  The engine-local resident page registry is
+        invalidated (pages are only valid for the params that wrote
+        them); clearing a SHARED host :class:`PrefixCache` is the
+        router's job, once per store."""
+        if self.n_active():
+            raise RuntimeError(
+                f"rebind_params on a busy engine ({self.n_active()} "
+                f"active slot(s)): drain first")
+        self._params = params
+        self._static_quantized = False
+        self._qmemo = qz.QuantMemo()
+        self._params_gen += 1
+        self._prefix_space = (repr(self.cfg), self.quantize,
+                              self.kv_dtype, self._params_gen)
+        if draft_params is not None:
+            if self._draft_cfg is None:
+                raise ValueError("engine built without draft=")
+            if self._draft_shardings is not None:
+                draft_params = jax.device_put(draft_params,
+                                              self._draft_shardings)
+            self._draft_params = draft_params
+        if self.paged:
+            for _, (_, ids) in self._resident.items():
+                self._alloc.free(ids)
+            self._resident.clear()
+
     # -- prefix harvesting -------------------------------------------------
     def _ensure_harvester(self) -> None:
         """(Re)spawn the harvest worker.  The loop closes over ONLY the
@@ -557,14 +1071,23 @@ class DecodeEngine:
                 try:
                     if item is None:
                         return
-                    pages, prefix, chunk = item
+                    pages, prefix, chunk, paged = item
                     # the read executable's outputs are fresh buffers
                     # — independent of the slot state later dispatches
                     # donate — so fetching them here cannot race the
-                    # serving thread
-                    host = tuple(np.asarray(p)[:, :prefix.size]  # jaxlint: disable=host-sync-on-serving-worker — the harvest worker EXISTS to absorb this fetch off the decode thread
-                                 for p in pages)
-                    store.insert(prefix, host, chunk, space)
+                    # serving thread.  A PAGED read comes back
+                    # [L, TBL, C, ...]; flatten to the store's row
+                    # format so paged and pinned engines sharing the
+                    # store serve each other's harvests.
+                    host = []
+                    for p in pages:
+                        a = np.asarray(p)  # jaxlint: disable=host-sync-on-serving-worker — the harvest worker EXISTS to absorb this fetch off the decode thread
+                        if paged:
+                            a = a.reshape(
+                                (a.shape[0], a.shape[1] * a.shape[2])
+                                + a.shape[3:])
+                        host.append(a[:, :prefix.size])
+                    store.insert(prefix, tuple(host), chunk, space)
                 except Exception:   # noqa: BLE001 — opportunistic path
                     # a failed harvest must never kill the worker: the
                     # request it served already completed; the prefix
@@ -613,6 +1136,23 @@ class DecodeEngine:
                 out.append(buf)
         return out
 
+    def _pad_pool_pages(self, pages: Sequence[np.ndarray], b: _Bucket):
+        """Re-chunk stored prefix rows [L, m, ...] into the paged write
+        executable's fixed page format [L, TBL, C, ...] (host-side; pad
+        pages land in the trash page, so one shape per bucket — a fresh
+        hit length never costs a trace)."""
+        C = self.page_tokens
+        tbl = b.ptab.shape[1]
+        out = []
+        for p in pages:
+            buf = np.zeros((p.shape[0], tbl, C) + p.shape[2:], p.dtype)
+            m = p.shape[1]
+            buf[:, :m // C] = np.ascontiguousarray(
+                p[:, :C * (m // C)]).reshape(
+                    (p.shape[0], m // C, C) + p.shape[2:])
+            out.append(buf)
+        return out
+
     # -- AOT warmup --------------------------------------------------------
     def warmup(self) -> dict:
         """Pre-trace the prefill + decode executables for every bucket
@@ -627,6 +1167,10 @@ class DecodeEngine:
         if self._prefix is not None:
             labels += [f"{self.label}.prefix_read",
                        f"{self.label}.prefix_write"]
+        if self.draft is not None:
+            labels += [f"{self.label}.draft",
+                       f"{self.label}.draft_prefill",
+                       f"{self.label}.verify"]
         before = sum(
             compile_metrics.snapshot()["traces"].get(k, 0) for k in labels)
         params = self.current_params()
@@ -634,18 +1178,69 @@ class DecodeEngine:
         with telemetry.span("decode.warmup", buckets=len(self.buckets)):
             for t in self.buckets:
                 b = self._buckets[t]
-                slots = self._state(b)
                 toks = np.zeros((self.prefill_chunk,), np.int32)
-                slots, _ = self._prefill(
-                    params, slots, toks, np.int32(0), np.int32(0),
-                    np.int32(1), np.float32(0.0), np.uint32(0))
-                if self._prefix is not None:
-                    pages = self._read(slots, np.int32(0))
-                    slots = self._write(slots, np.int32(0), *pages)
-                slots, out = self._decode(
-                    params, slots, b.active, b.temps, b.seeds)
-                jax.block_until_ready(out)
+                if self.paged:
+                    # all warmup dispatches run with ZERO page tables
+                    # and all-inactive masks: every write lands in the
+                    # trash page, the allocator is untouched, and the
+                    # pool is dropped afterwards anyway
+                    pool = self._pool_state()
+                    ptab_s = np.zeros((b.ptab.shape[1],), np.int32)
+                    pool, _ = self._prefill(
+                        params, pool, ptab_s, toks, np.int32(0),
+                        np.int32(1), np.float32(0.0), np.uint32(0))
+                    self._pool = pool
+                    if self._prefix is not None:
+                        pages = self._read(pool, ptab_s)
+                        self._pool = pool = self._write(pool, ptab_s,
+                                                        *pages)
+                    if self.draft is not None:
+                        self._dpool = self._draft_prefill(
+                            self._draft_params, self._dpool, ptab_s,
+                            toks, np.int32(0), np.int32(1))
+                        self._dpool, props = self._draft_fn(
+                            self._draft_params, self._dpool,
+                            b.ptab.copy(), b.tokens_h.copy(),
+                            b.pos_h.copy(), b.active.copy())
+                        pool, _, _ = self._verify(
+                            params, pool, b.ptab.copy(),
+                            b.tokens_h.copy(), b.pos_h.copy(),
+                            b.active.copy(), b.temps, b.seeds, props)
+                        self._pool = pool
+                    pool, out = self._decode(
+                        params, self._pool, b.ptab.copy(),
+                        b.tokens_h.copy(), b.pos_h.copy(),
+                        b.active.copy(), b.temps, b.seeds)
+                    self._pool = pool
+                    jax.block_until_ready(out)
+                else:
+                    slots = self._state(b)
+                    slots, _ = self._prefill(
+                        params, slots, toks, np.int32(0), np.int32(0),
+                        np.int32(1), np.float32(0.0), np.uint32(0))
+                    if self._prefix is not None:
+                        pages = self._read(slots, np.int32(0))
+                        slots = self._write(slots, np.int32(0), *pages)
+                    if self.draft is not None:
+                        dsl = self._dslots_state(b)
+                        dsl = self._draft_prefill(
+                            self._draft_params, dsl, toks, np.int32(0),
+                            np.int32(0), np.int32(1))
+                        dsl, props = self._draft_fn(
+                            self._draft_params, dsl, b.active.copy())
+                        slots, _, _ = self._verify(
+                            params, slots, b.active.copy(), b.temps,
+                            b.seeds, props)
+                        self._dslots.pop(b.t_max, None)
+                    slots, out = self._decode(
+                        params, slots, b.active.copy(), b.temps, b.seeds)
+                    jax.block_until_ready(out)
                 b.slots = None                  # fresh state for serving
+            # warmup scribbled on the shared pools; re-init lazily so
+            # serving starts from zeros
+            self._pool = None
+            self._dpool = None
+            self._dslots.clear()
         wall_ms = (time.perf_counter() - t0) * 1e3
         compiles = sum(
             compile_metrics.snapshot()["traces"].get(k, 0) for k in labels
@@ -674,6 +1269,22 @@ class DecodeEngine:
         slot = b.free_slot()
         if slot is None:
             raise RuntimeError(f"no free slot in bucket {bucket}")
+        if self.paged:
+            first_tok = self._start_paged(prompt, b, bucket, slot,
+                                          temperature, seed)
+        else:
+            first_tok = self._start_pinned(prompt, b, bucket, slot,
+                                           temperature, seed)
+        b.tokens_h[slot] = first_tok
+        b.pos_h[slot] = prompt.size
+        b.active[slot] = True
+        b.temps[slot] = np.float32(temperature)
+        b.seeds[slot] = np.uint32(seed)
+        b.owners[slot] = owner
+        return bucket, slot, first_tok
+
+    def _start_pinned(self, prompt: np.ndarray, b: _Bucket, bucket: int,
+                      slot: int, temperature: float, seed: int) -> int:
         params = self.current_params()
         slots = self._state(b)
         C = self.prefill_chunk
@@ -715,6 +1326,25 @@ class DecodeEngine:
                 b.slots = None
                 raise
             b.slots = slots
+            if self.draft is not None:
+                # the draft model prefills the WHOLE prompt (its KV has
+                # no prefix store — it is tiny; re-running its chunks
+                # costs a sliver of the target compute the hit saved)
+                dsl = self._dslots_state(b)
+                try:
+                    for c in range(n_chunks):
+                        lo = c * C
+                        n_valid = min(C, prompt.size - lo)
+                        chunk = np.zeros((C,), np.int32)
+                        chunk[:n_valid] = prompt[lo:lo + n_valid]
+                        dsl = self._draft_prefill(
+                            self._draft_params, dsl, chunk,
+                            np.int32(slot), np.int32(lo),
+                            np.int32(n_valid))
+                except Exception:
+                    self._dslots.pop(b.t_max, None)
+                    raise
+                self._dslots[b.t_max] = dsl
             first_tok = int(first)              # join-time sync, once
         decode_metrics.note_prefill(n_chunks - hit_len // C)
         if self._prefix is not None:
@@ -740,14 +1370,119 @@ class DecodeEngine:
                 self._ensure_harvester()
                 try:
                     self._harvest_q.put_nowait(
-                        (full, prompt[:m_store].copy(), C))
+                        (full, prompt[:m_store].copy(), C, False))
                 except queue.Full:
                     pass            # backpressure: drop, opportunistic
-        b.active[slot] = True
-        b.temps[slot] = np.float32(temperature)
-        b.seeds[slot] = np.uint32(seed)
-        b.owners[slot] = owner
-        return bucket, slot, first_tok
+        return first_tok
+
+    def _start_paged(self, prompt: np.ndarray, b: _Bucket, bucket: int,
+                     slot: int, temperature: float, seed: int) -> int:
+        self.check_capacity(prompt.size)
+        params = self.current_params()
+        pool = self._pool_state()
+        C = self.page_tokens
+        n_chunks = -(-prompt.size // C)
+        tbl = b.ptab.shape[1]
+        # prefix reuse, best first: (1) pool-RESIDENT pages mount into
+        # the page table BY REFERENCE — no copy, no dispatch; (2) the
+        # host PrefixCache (shared across replicas, paged or pinned)
+        # copies pages into freshly-allocated pool pages
+        hit_len, hit_ids = self._resident_lookup(prompt)
+        resident_hit = hit_len > 0
+        host_pages = None
+        if not resident_hit and self._prefix is not None:
+            hit = self._prefix.lookup(prompt, C, self._prefix_space)
+            if hit is not None:
+                hit_len, host_pages = hit
+        h = hit_len // C
+        tr = telemetry.get_tracer()
+        sp = tr.span("decode.prefill", bucket=bucket, slot=slot,
+                     prompt_tokens=int(prompt.size), chunks=n_chunks,
+                     prefix_hit_tokens=hit_len) \
+            if tr is not None else telemetry.NOOP_SPAN
+        with sp:
+            if resident_hit:
+                self._alloc.share(hit_ids)
+                b.ptab[slot, :h] = hit_ids
+            # only a RESIDENT hit reuses pages by reference; a host
+            # -store hit copies into fresh pool pages, so it needs the
+            # full n_chunks allocated (the hit region included)
+            n_fresh = n_chunks - h if resident_hit else n_chunks
+            try:
+                fresh = self._alloc.alloc(n_fresh)
+            except KVPagesExhausted:
+                if resident_hit:
+                    self._alloc.free(hit_ids)
+                    b.ptab[slot, :h] = 0
+                raise
+            b.ptab[slot, n_chunks - n_fresh:n_chunks] = fresh
+            b.n_pages[slot] = n_chunks
+            first = None
+            try:
+                if host_pages is not None:
+                    pids = np.zeros((tbl,), np.int32)
+                    pids[:h] = b.ptab[slot, :h]
+                    self._pool = pool = self._write(
+                        pool, pids, *self._pad_pool_pages(host_pages, b))
+                for c in range(h, n_chunks):
+                    lo = c * C
+                    n_valid = min(C, prompt.size - lo)
+                    chunk = np.zeros((C,), np.int32)
+                    chunk[:n_valid] = prompt[lo:lo + n_valid]
+                    pool, first = self._prefill(
+                        params, pool, b.ptab[slot].copy(), chunk,
+                        np.int32(lo), np.int32(n_valid),
+                        np.float32(temperature), np.uint32(seed))
+                    self._pool = pool
+                if self.draft is not None:
+                    # draft prefills EVERY chunk: host-store hits carry
+                    # no draft KV, and re-writing a resident page's
+                    # draft rows recomputes identical values (same
+                    # tokens, same draft params) — harmless either way
+                    for c in range(n_chunks):
+                        lo = c * C
+                        n_valid = min(C, prompt.size - lo)
+                        chunk = np.zeros((C,), np.int32)
+                        chunk[:n_valid] = prompt[lo:lo + n_valid]
+                        self._dpool = self._draft_prefill(
+                            self._draft_params, self._dpool,
+                            b.ptab[slot].copy(), chunk, np.int32(lo),
+                            np.int32(n_valid))
+            except Exception:
+                # the pool was donated into the failed dispatch — every
+                # paged bucket's KV is gone; drop it so serving
+                # re-initializes instead of touching deleted buffers
+                self._pool = None
+                self._dpool = None
+                raise
+            first_tok = int(first)              # join-time sync, once
+        decode_metrics.note_prefill(n_chunks - h)
+        if hit_len:
+            decode_metrics.note_prefix_hit(hit_len)
+            if tr is not None:
+                tr.event("decode.prefix_hit", bucket=bucket, slot=slot,
+                         tokens_saved=hit_len,
+                         resident=bool(resident_hit))
+        else:
+            decode_metrics.note_prefix_miss()
+        m_store = C * ((prompt.size - 1) // C)
+        if m_store > hit_len and m_store >= C:
+            # harvest: register the prefix pages pool-resident (no
+            # dispatch — the registry just refs the page ids) and, with
+            # a host store attached, enqueue the cross-replica fetch
+            self._resident_register(prompt, b, slot)
+            if self._prefix is not None:
+                pids = np.zeros((tbl,), np.int32)
+                pids[:m_store // C] = b.ptab[slot, :m_store // C]
+                full = self._read(pool, pids)
+                self._ensure_harvester()
+                try:
+                    self._harvest_q.put_nowait(
+                        (full, prompt[:m_store].copy(), C, True))
+                except queue.Full:
+                    pass            # backpressure: drop, opportunistic
+        decode_metrics.note_pages(self._alloc.in_use(), 0, 0)
+        return first_tok
 
     def advance(self, bucket: int) -> np.ndarray:
         """One decode dispatch for ``bucket``: every active slot emits
@@ -756,11 +1491,38 @@ class DecodeEngine:
         ownership map)."""
         b = self._buckets[bucket]
         params = self.current_params()
-        slots = self._state(b)
         n_act = b.n_active()
         tr = telemetry.get_tracer()
         sp = tr.span("decode.dispatch", bucket=bucket, active=n_act) \
             if tr is not None else telemetry.NOOP_SPAN
+        if self.paged:
+            run = self._ensure_pages(b, 0)
+            b.ran = run
+            pool = self._pool_state()
+            with sp:
+                try:
+                    pool, out = self._decode(
+                        params, pool, b.ptab.copy(), b.tokens_h.copy(),
+                        b.pos_h.copy(), run, b.temps, b.seeds)
+                except Exception:
+                    self._pool = None       # donated into the failure
+                    self._dpool = None
+                    raise
+                self._pool = pool
+                # the per-step stream sync: each active request's next
+                # token must land on host to stream — this ONE [S]-int
+                # fetch per dispatch is the product, not a stall
+                toks = np.asarray(out)  # jaxlint: disable=host-sync-on-serving-worker — the per-step token fetch IS the stream
+            b.tokens_h[run] = toks[run]
+            b.pos_h[run] += 1
+            decode_metrics.note_decode_dispatch(int(run.sum()),
+                                                self.n_slots)
+            decode_metrics.note_pages(self._alloc.in_use(),
+                                      self._live_rows(),
+                                      self.page_tokens)
+            return toks
+        slots = self._state(b)
+        b.ran = b.active.copy()
         with sp:
             try:
                 slots, out = self._decode(params, slots, b.active.copy(),
@@ -773,16 +1535,102 @@ class DecodeEngine:
             # must land on host to stream — this ONE [S]-int fetch per
             # dispatch is the product, not a stall
             toks = np.asarray(out)  # jaxlint: disable=host-sync-on-serving-worker — the per-step token fetch IS the stream
+        b.tokens_h[b.ran] = toks[b.ran]
+        b.pos_h[b.ran] += 1
         decode_metrics.note_decode_dispatch(n_act, self.n_slots)
         return toks
+
+    def advance_spec(self, bucket: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One SPECULATIVE round for ``bucket``: the draft proposes
+        ``draft_k`` tokens per slot in one dispatch (proposals stay on
+        device), the target verifies all k+1 positions in ONE batched
+        dispatch, and the longest accepted prefix (+ the target's own
+        next token) commits.  Returns ``(out [S, k+1], n_commit [S])``
+        — slot s committed ``out[s, :n_commit[s]]`` this round (0 for
+        inactive/stalled slots).  Greedy target ⇒ bit-identical stream
+        to non-speculative decode; sampled targets stay identical too,
+        because sampling keys are POSITION-keyed (gpt._slot_key), not
+        step-keyed."""
+        if self._draft_fn is None:
+            raise RuntimeError("engine built without draft=")
+        b = self._buckets[bucket]
+        params = self.current_params()
+        k = self.draft_k
+        tr = telemetry.get_tracer()
+        sp = tr.span("decode.spec_round", bucket=bucket,
+                     active=b.n_active(), k=k) \
+            if tr is not None else telemetry.NOOP_SPAN
+        if self.paged:
+            run = self._ensure_pages(b, k)
+            b.ran = run
+            pool = self._pool_state()
+            with sp:
+                try:
+                    self._dpool, props = self._draft_fn(
+                        self._draft_params, self._dpool, b.ptab.copy(),
+                        b.tokens_h.copy(), b.pos_h.copy(), run)
+                    pool, out, n_commit = self._verify(
+                        params, pool, b.ptab.copy(), b.tokens_h.copy(),
+                        b.pos_h.copy(), run, b.temps, b.seeds, props)
+                except Exception:
+                    self._pool = None
+                    self._dpool = None
+                    raise
+                self._pool = pool
+                # the ONE host round-trip of the round: the committed
+                # tokens and their counts (the proposals never land)
+                toks = np.asarray(out)  # jaxlint: disable=host-sync-on-serving-worker — the per-round committed-token fetch IS the stream
+                n_c = np.asarray(n_commit)  # jaxlint: disable=host-sync-on-serving-worker — rides the same round-trip as the committed tokens
+        else:
+            run = b.active.copy()
+            b.ran = run
+            slots = self._state(b)
+            dsl = self._dslots_state(b)
+            # the draft's device tokens/pos are overwritten with the
+            # verified frontier: rows below it hold exactly the
+            # committed tokens' KV (accepted proposals consumed them),
+            # so no re-sync dispatch is ever needed
+            dsl = dsl._replace(tokens=b.tokens_h.copy(),
+                               pos=b.pos_h.copy())
+            with sp:
+                try:
+                    dsl, props = self._draft_fn(self._draft_params, dsl,
+                                                run)
+                    self._dslots[b.t_max] = dsl
+                    slots, out, n_commit = self._verify(
+                        params, slots, run, b.temps, b.seeds, props)
+                except Exception:
+                    b.slots = None
+                    self._dslots.pop(b.t_max, None)
+                    raise
+                b.slots = slots
+                toks = np.asarray(out)  # jaxlint: disable=host-sync-on-serving-worker — the per-round committed-token fetch IS the stream
+                n_c = np.asarray(n_commit)  # jaxlint: disable=host-sync-on-serving-worker — rides the same round-trip as the committed tokens
+        n_c = n_c.astype(np.int64)
+        idx = np.flatnonzero(n_c)
+        b.tokens_h[idx] = toks[idx, n_c[idx] - 1]
+        b.pos_h += n_c.astype(np.int32)
+        n_run = int(run.sum())
+        decode_metrics.note_decode_dispatch(n_run, self.n_slots)
+        decode_metrics.note_spec(k * n_run,
+                                 int(np.maximum(n_c - 1, 0).sum()))
+        if self.paged:
+            decode_metrics.note_pages(self._alloc.in_use(),
+                                      self._live_rows(),
+                                      self.page_tokens)
+        return toks, n_c
 
     def release(self, bucket: int, slot: int) -> None:
         """Free a finished slot — the cache rows need no scrubbing: a
         future occupant prefills its prompt over them and decode never
-        attends past its own position."""
+        attends past its own position.  A paged slot also returns its
+        page-table references to the allocator (pool-resident prefix
+        pages survive: the registry holds its own reference)."""
         b = self._buckets[bucket]
         b.active[slot] = False
         b.owners[slot] = None
+        if self.paged:
+            self._release_pages(b, slot)
 
 
 class DecodeRequest:
@@ -899,6 +1747,7 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         max_tokens = int(max_tokens or self.default_max_tokens)
         self.engine.pick_bucket(prompt.size + max_tokens)  # sync validate
+        self.engine.check_capacity(prompt.size)  # typed paged oversize
         req = DecodeRequest(prompt, max_tokens, float(temperature),
                             int(seed), eos_id)
         with self._cv:
@@ -932,7 +1781,7 @@ class ContinuousBatcher:
                 for i, r in enumerate(self._pending):
                     bucket = self.engine.pick_bucket(
                         r.prompt.size + r.max_tokens)
-                    if self.engine.free_slot(bucket) is not None:
+                    if self.engine.can_admit(bucket, r.prompt.size):
                         req = self._pending.pop(i)
                         break
                 if req is None:
@@ -978,10 +1827,26 @@ class ContinuousBatcher:
         return False
 
     def _advance_all(self) -> None:
+        spec = self.engine.draft is not None
         for bucket in self.engine.active_buckets():
             t0 = time.perf_counter()
             try:
-                toks = self.engine.advance(bucket)
+                if spec:
+                    out, n_c = self.engine.advance_spec(bucket)
+                else:
+                    toks = self.engine.advance(bucket)
+            except KVPagesExhausted as e:
+                # paged deadlock breaker: the pool cannot advance ANY
+                # slot in this bucket — evict the named victim (typed
+                # error to its client; its pages free the others)
+                if e.slot is None:
+                    raise
+                with self._cv:
+                    r = self._placed.pop((bucket, e.slot), None)
+                self.engine.release(bucket, e.slot)
+                if r is not None:
+                    r._finish(e)
+                continue
             except Exception as e:
                 # a failed dispatch poisons this bucket's in-flight
                 # requests (state was donated); resolve them all rather
@@ -997,14 +1862,25 @@ class ContinuousBatcher:
                 continue
             decode_metrics.note_token_ms(
                 (time.perf_counter() - t0) * 1e3)
+            ran = self.engine.last_ran(bucket)
             with self._cv:
                 owned = [(k, r) for k, r in self._placed.items()
                          if k[0] == bucket]
             for (bk, slot), r in owned:
-                tok = int(toks[slot])
-                r._push(tok)
-                self._maybe_finish(bk, slot, r, tok,
-                                   n_out=len(r._tokens))
+                if not ran[slot]:
+                    continue        # stalled on pages; retried next pass
+                if spec:
+                    for j in range(int(n_c[slot])):
+                        tok = int(out[slot, j])
+                        r._push(tok)
+                        if self._maybe_finish(bk, slot, r, tok,
+                                              n_out=len(r._tokens)):
+                            break
+                else:
+                    tok = int(toks[slot])
+                    r._push(tok)
+                    self._maybe_finish(bk, slot, r, tok,
+                                       n_out=len(r._tokens))
 
     def _loop(self) -> None:
         while True:

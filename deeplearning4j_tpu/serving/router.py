@@ -41,6 +41,16 @@ SERVING TIER 2 closes the telemetry loop the static bound leaves open:
   load that a fixed fleet would reject becomes a scale-up instead.
   ``max_queue_depth`` is thereby reinterpreted as the per-replica
   pressure bound that triggers emergency scale-up (MIGRATION.md).
+
+SERVING TIER 3 adds the zero-downtime weight swap:
+``AutoscalingRouter.swap_weights(new_params)`` flips replicas one at a
+time — drain (excluded from routing, fleet absorbs the traffic) →
+``engine.rebind_params`` → requantize on the swapping thread → rejoin —
+so a fleet rolls onto a new checkpoint with zero dropped requests and,
+because shapes are unchanged, zero new XLA compiles.  Shared
+``PrefixCache`` stores are cleared once at the end (their pages encode
+the old weights).  Requests admitted while a swap is in flight are
+counted in ``decode_metrics.requests_during_swap``.
 """
 
 from __future__ import annotations
@@ -326,6 +336,11 @@ class AutoscalingRouter(Router):
         self._drains: List[threading.Thread] = []
         self._closed = False
         self._spawning = False
+        self._swapping = False
+        # replicas temporarily excluded from routing (identity set):
+        # swap_weights drains one replica at a time through here while
+        # the rest keep serving — zero dropped requests
+        self._draining: set = set()
         super().__init__([factory()
                           for _ in range(self.policy.min_replicas)],
                          max_queue_depth=max_queue_depth)
@@ -447,6 +462,107 @@ class AutoscalingRouter(Router):
         self._drains = [d for d in self._drains if d.is_alive()]
         self._drains.append(t)
 
+    # -- hot weight swap ---------------------------------------------------
+    def swap_weights(self, params: Any, draft_params: Any = None, *,
+                     timeout: float = 120.0) -> int:
+        """Zero-downtime hot checkpoint swap: flip every replica to
+        ``params`` one at a time, without dropping a request or
+        compiling a new XLA program.
+
+        Protocol per replica: exclude it from routing (``_draining``),
+        poll its queue to zero (accepted requests finish on the OLD
+        weights), ``engine.rebind_params`` + ``engine.current_params()``
+        — the requantization cost lands HERE, on the swapping thread,
+        never on a serving worker — then rejoin.  The rest of the fleet
+        absorbs traffic throughout; a single-replica fleet first gains
+        a temporary factory replica (old weights) so requests keep
+        flowing while the real one drains — the temp is swapped too,
+        then retired.  Afterwards each distinct shared
+        :class:`~deeplearning4j_tpu.serving.decode.PrefixCache` is
+        cleared once: its pages were computed under the old weights
+        (``rebind_params`` already bumped the engine fingerprints, so
+        stale hits were impossible; clearing reclaims the memory).
+
+        Shapes are unchanged, so every rebound engine reuses its warmed
+        executables — ``swap_compile_delta == 0`` is asserted by the
+        bench drill.  Returns the number of replicas swapped.  Raises
+        ``TimeoutError`` if a replica fails to drain in ``timeout``
+        seconds (the fleet is left serving: swapped replicas keep the
+        new weights, unswapped ones the old)."""
+        deadline = time.monotonic() + float(timeout)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AutoscalingRouter is closed")
+            if self._swapping:
+                raise RuntimeError("a weight swap is already in progress")
+            self._swapping = True
+        temp = None
+        try:
+            with self._lock:
+                if len(self.batchers) == 1:
+                    temp = self.factory()       # still the OLD weights
+                    self.batchers.append(temp)
+                    decode_metrics.note_replicas(added=1)
+            swapped: set = set()                # id() of flipped replicas
+            while True:
+                with self._lock:
+                    target = next((b for b in self.batchers
+                                   if id(b) not in swapped), None)
+                    if target is None:
+                        break
+                    self._draining.add(target)
+                try:
+                    while True:
+                        if target.depth() == 0:
+                            try:
+                                target.engine.rebind_params(params,
+                                                            draft_params)
+                                break
+                            except RuntimeError:
+                                # depth hit 0 a beat before the worker
+                                # released its last slot — retry
+                                pass
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"replica did not drain within {timeout}s "
+                                f"(depth {target.depth()}); "
+                                f"{len(swapped)} replica(s) swapped")
+                        time.sleep(0.005)
+                    target.engine.current_params()
+                    swapped.add(id(target))
+                finally:
+                    with self._lock:
+                        self._draining.discard(target)
+            with self._lock:
+                batchers = list(self.batchers)
+            seen: set = set()
+            for b in batchers:
+                store = getattr(b.engine, "_prefix", None)
+                if store is not None and id(store) not in seen:
+                    seen.add(id(store))
+                    store.clear()
+            if temp is not None:
+                with self._lock:
+                    if temp in self.batchers:
+                        self.batchers.remove(temp)
+                        decode_metrics.note_replicas(removed=1)
+                    t = threading.Thread(target=temp.close,
+                                         name="dl4j-replica-drain",
+                                         daemon=True)
+                    self._drains = [d for d in self._drains
+                                    if d.is_alive()]
+                    self._drains.append(t)
+                t.start()
+            decode_metrics.note_swap()
+            tr = telemetry.get_tracer()
+            if tr is not None:
+                tr.event("decode.swap", replicas=len(swapped))
+            return len(swapped)
+        finally:
+            with self._lock:
+                self._swapping = False
+                self._draining.clear()
+
     # -- dispatch ----------------------------------------------------------
     def submit(self, prompt, **kw) -> DecodeRequest:
         self.tick()
@@ -457,12 +573,20 @@ class AutoscalingRouter(Router):
                     # racing submit could spawn a fresh replica close()
                     # never sees, leaking its worker thread
                     raise RuntimeError("AutoscalingRouter is closed")
-                depths = [b.depth() for b in self.batchers]
+                # replicas mid-swap-drain are excluded from routing;
+                # the rest of the fleet absorbs their share (fall back
+                # to the full list defensively if that empties it)
+                live = [b for b in self.batchers
+                        if b not in self._draining] or list(self.batchers)
+                if self._swapping:
+                    decode_metrics.note_request_during_swap()
+                depths = [b.depth() for b in live]
                 i = int(np.argmin(depths))
                 if depths[i] >= self.max_queue_depth:
                     if len(self.batchers) < self.policy.max_replicas:
                         self._scale_up("pressure")
-                        i = len(self.batchers) - 1
+                        live.append(self.batchers[-1])
+                        i = len(live) - 1
                     else:
                         decode_metrics.note_shed(by_policy=True)
                         tr = telemetry.get_tracer()
@@ -474,7 +598,7 @@ class AutoscalingRouter(Router):
                         raise OverloadedError(depths[i],
                                               self.max_queue_depth,
                                               len(self.batchers))
-                target = self.batchers[i]
+                target = live[i]
             try:
                 return target.submit(prompt, **kw)
             except RuntimeError:

@@ -1,0 +1,413 @@
+"""Serving tier 3: paged KV cache, speculative decoding, and the
+zero-downtime weight swap.
+
+The load-bearing properties:
+
+- the ``PageAllocator`` never double-assigns a page, reclaims freed
+  pages, is all-or-nothing (typed :class:`KVPagesExhausted` on
+  shortfall), and keeps EXACT occupancy under a randomized
+  admit/extend/free schedule;
+- a paged engine is BIT-identical to the pinned engine — greedy and
+  sampled, fp32 and int8 — because paging only re-indexes KV storage,
+  never changes a single matmul;
+- a pool-resident prefix hit mounts pages BY REFERENCE (refcounts, no
+  copy) and a released slot returns its pages to the pool;
+- speculative decoding is bit-identical to plain decode at ANY
+  temperature (position-keyed sampling), proposes/accepts are booked,
+  and the whole stack composes: paged + draft + int8 + batcher;
+- oversize paged admits fail SYNCHRONOUSLY with the typed error;
+- ``rebind_params`` requires an idle engine and flips outputs to the
+  new checkpoint with zero new compiles; the router's
+  ``swap_weights`` rolls a live fleet with zero dropped requests;
+- every tier-3 path preserves the zero-steady-state-compile contract.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import gpt
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.runtime.metrics import decode_metrics
+from deeplearning4j_tpu.serving.decode import (KV_PAGE_TOKENS,
+                                               ContinuousBatcher,
+                                               DecodeEngine,
+                                               KVPagesExhausted,
+                                               PageAllocator, PrefixCache)
+from deeplearning4j_tpu.serving.router import (AutoscalePolicy,
+                                               AutoscalingRouter)
+
+CFG = TransformerConfig(vocab_size=64, max_len=64, hidden=32, n_layers=2,
+                        n_heads=2, ffn_dim=64, dropout=0.0,
+                        compute_dtype="float32", causal=True,
+                        type_vocab_size=1)
+DCFG = TransformerConfig(vocab_size=64, max_len=64, hidden=16, n_layers=1,
+                         n_heads=2, ffn_dim=32, dropout=0.0,
+                         compute_dtype="float32", causal=True,
+                         type_vocab_size=1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init_params(jax.random.key(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def dparams():
+    return gpt.init_params(jax.random.key(3), DCFG)
+
+
+def _solo(p, prompt, n_tokens):
+    out = gpt.generate(CFG, p, np.asarray(prompt, np.int32)[None, :],
+                       n_tokens, jax.random.key(0), temperature=0.0)
+    return list(np.asarray(out)[0])
+
+
+def _engine_tokens(eng, prompt, n, temperature=0.0, seed=0):
+    """Drive one request to n tokens through plain or speculative
+    advance, honoring the ran-mask contract."""
+    bucket, slot, first = eng.start(np.asarray(prompt, np.int32),
+                                    max_tokens=n, temperature=temperature,
+                                    seed=seed)
+    out = [first]
+    while len(out) < n:
+        if eng.draft is not None:
+            toks, n_c = eng.advance_spec(bucket)
+            for j in range(int(n_c[slot])):
+                out.append(int(toks[slot, j]))
+                if len(out) >= n:
+                    break
+        else:
+            toks = eng.advance(bucket)
+            if eng.last_ran(bucket)[slot]:
+                out.append(int(toks[slot]))
+    eng.release(bucket, slot)
+    return out[:n]
+
+
+# -- page allocator ---------------------------------------------------------
+
+def test_kv_page_tokens_matches_prefill_chunk():
+    """Drift guard: the page size IS the prefill chunk — prefix-cache
+    chunks, pool pages, and prefill writes must stay aligned or the
+    mount-by-reference path silently corrupts."""
+    assert KV_PAGE_TOKENS == gpt.PREFILL_CHUNK
+
+
+def test_page_allocator_properties():
+    a = PageAllocator(8)                    # page 0 reserved: 7 usable
+    assert a.n_free() == 7 and a.in_use() == 0
+    ids = a.alloc(3)
+    assert len(set(ids)) == 3 and 0 not in ids
+    ids2 = a.alloc(4)
+    assert not set(ids) & set(ids2)         # never double-assigned
+    assert a.in_use() == 7
+    with pytest.raises(KVPagesExhausted) as ei:
+        a.alloc(1)                          # all-or-nothing
+    assert ei.value.needed == 1 and ei.value.free == 0
+    a.free(ids)
+    assert set(a.alloc(3)) == set(ids)      # freed pages reusable
+    # refcounted sharing: a shared page survives one free
+    p = ids2[0]
+    a.share([p])
+    assert a.refcount(p) == 2
+    a.free([p])
+    assert a.refcount(p) == 1 and p not in a._free
+    a.free([p])
+    assert a.refcount(p) == 0
+    with pytest.raises(ValueError):
+        a.free([p])                         # double-free is typed
+    with pytest.raises(ValueError):
+        a.share([0])                        # reserved page never shared
+    with pytest.raises(ValueError):
+        PageAllocator(1)                    # nothing left after reserve
+
+
+def test_page_allocator_randomized_schedule():
+    """Exact occupancy under a randomized admit/free interleaving: no
+    page ever lives in two requests, in_use tracks the live sum, and a
+    fully-drained pool is fully free again."""
+    rng = np.random.default_rng(0)
+    a = PageAllocator(33)
+    live = {}
+    next_id = 0
+    for _ in range(300):
+        if live and (rng.random() < 0.4 or a.n_free() < 4):
+            rid = list(live)[int(rng.integers(len(live)))]
+            a.free(live.pop(rid))
+        else:
+            n = int(rng.integers(1, 5))
+            if n > a.n_free():
+                with pytest.raises(KVPagesExhausted):
+                    a.alloc(n)
+                continue
+            ids = a.alloc(n)
+            held = [p for ids_ in live.values() for p in ids_]
+            assert not set(ids) & set(held)
+            live[next_id] = ids
+            next_id += 1
+        assert a.in_use() == sum(len(v) for v in live.values())
+    for ids in live.values():
+        a.free(ids)
+    assert a.in_use() == 0 and a.n_free() == 32
+
+
+# -- paged == pinned --------------------------------------------------------
+
+def test_paged_greedy_bit_exact_and_pages_released(params):
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, paged=True)
+    eng.warmup()
+    prompt = np.arange(1, 7, dtype=np.int32)    # < one chunk: no harvest
+    got = _engine_tokens(eng, prompt, 10)
+    assert got == _solo(params, prompt, 10)
+    assert eng._alloc.in_use() == 0             # release returned them
+    snap = decode_metrics.snapshot()
+    assert snap["pages_in_use"] == 0
+    assert snap["pages_in_use_hw"] >= 2         # prompt page + growth
+
+
+def test_paged_int8_matches_pinned_int8(params):
+    kw = dict(n_slots=2, buckets=(32,), prefill_chunk=8,
+              quantize="int8", kv_dtype="int8")
+    paged = DecodeEngine(CFG, params, paged=True, **kw)
+    pinned = DecodeEngine(CFG, params, **kw)
+    paged.warmup()
+    pinned.warmup()
+    prompt = np.arange(1, 13, dtype=np.int32)
+    assert _engine_tokens(paged, prompt, 10) \
+        == _engine_tokens(pinned, prompt, 10)
+
+
+def test_paged_sampled_matches_pinned(params):
+    kw = dict(n_slots=2, buckets=(32,), prefill_chunk=8)
+    paged = DecodeEngine(CFG, params, paged=True, **kw)
+    pinned = DecodeEngine(CFG, params, **kw)
+    paged.warmup()
+    pinned.warmup()
+    prompt = np.arange(1, 10, dtype=np.int32)
+    a = _engine_tokens(paged, prompt, 12, temperature=0.8, seed=5)
+    b = _engine_tokens(pinned, prompt, 12, temperature=0.8, seed=5)
+    assert a == b
+
+
+def test_resident_prefix_mounts_by_reference(params):
+    """Second request sharing a chunk-aligned head mounts the FIRST
+    request's pages: refcount > 1 while mounted, a prefix hit is
+    booked, output stays bit-exact, and release only decrefs."""
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, paged=True)
+    eng.warmup()
+    head = np.arange(1, 17, dtype=np.int32)             # two full chunks
+    p1 = np.concatenate([head, [20, 21]])
+    p2 = np.concatenate([head, [30]])
+    assert _engine_tokens(eng, p1, 8) == _solo(params, p1, 8)
+    held = eng._alloc.in_use()
+    assert held >= 2                                    # registry pins
+    before = decode_metrics.snapshot()["prefix_hits"]
+    bucket, slot, first = eng.start(p2, max_tokens=8)
+    assert decode_metrics.snapshot()["prefix_hits"] == before + 1
+    b = eng._buckets[bucket]
+    shared = [int(x) for x in b.ptab[slot, :2]]
+    assert all(eng._alloc.refcount(p) >= 2 for p in shared)
+    out = [first]
+    while len(out) < 8:
+        toks = eng.advance(bucket)
+        out.append(int(toks[slot]))
+    eng.release(bucket, slot)
+    assert out == _solo(params, p2, 8)
+    assert all(eng._alloc.refcount(p) >= 1 for p in shared)
+    assert eng._alloc.in_use() >= held                  # only decrefs
+
+
+def test_oversize_paged_admit_is_typed_and_sync(params):
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, paged=True, n_pages=4)
+    eng.warmup()
+    with pytest.raises(KVPagesExhausted):
+        eng.check_capacity(25)              # needs 4+1 pages, pool has 3
+    bat = ContinuousBatcher(eng)
+    try:
+        with pytest.raises(KVPagesExhausted):
+            bat.submit(np.arange(1, 26, dtype=np.int32), max_tokens=4)
+    finally:
+        bat.close()
+
+
+# -- speculative decoding ---------------------------------------------------
+
+def test_spec_greedy_bit_identical_and_booked(params, dparams):
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, draft=(DCFG, dparams), draft_k=3)
+    eng.warmup()
+    before = decode_metrics.snapshot()
+    prompt = np.arange(1, 10, dtype=np.int32)
+    assert _engine_tokens(eng, prompt, 12) == _solo(params, prompt, 12)
+    after = decode_metrics.snapshot()
+    proposed = after["draft_proposed"] - before["draft_proposed"]
+    accepted = after["draft_accepted"] - before["draft_accepted"]
+    assert proposed > 0 and 0 <= accepted <= proposed
+
+
+def test_spec_paged_sampled_matches_plain(params, dparams):
+    """Position-keyed sampling makes speculative decoding token
+    -identical to plain decode at ANY temperature — paged + draft vs
+    the pinned plain engine."""
+    spec = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                        prefill_chunk=8, paged=True,
+                        draft=(DCFG, dparams), draft_k=3)
+    plain = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                         prefill_chunk=8)
+    spec.warmup()
+    plain.warmup()
+    prompt = np.arange(1, 8, dtype=np.int32)
+    a = _engine_tokens(spec, prompt, 12, temperature=0.7, seed=9)
+    b = _engine_tokens(plain, prompt, 12, temperature=0.7, seed=9)
+    assert a == b
+
+
+def test_batcher_composes_paged_spec_int8(params, dparams):
+    """The whole tier-3 stack at once: continuous batching over a
+    paged, speculative, int8-weight engine with a shared prefix store
+    — every request bit-matches the pinned int8 plain engine."""
+    store = PrefixCache()
+    eng = DecodeEngine(CFG, params, n_slots=4, buckets=(32,),
+                       prefill_chunk=8, paged=True, quantize="int8",
+                       draft=(DCFG, dparams), draft_k=3,
+                       prefix_cache=store)
+    ref = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, quantize="int8")
+    eng.warmup()
+    ref.warmup()
+    rng = np.random.default_rng(1)
+    bat = ContinuousBatcher(eng)
+    try:
+        prompts = [rng.integers(1, 64, size=int(rng.integers(4, 18)))
+                   for _ in range(6)]
+        reqs = [bat.submit(p, max_tokens=8) for p in prompts]
+        outs = [list(r.result(120.0)) for r in reqs]
+    finally:
+        bat.close()
+    for p, o in zip(prompts, outs):
+        assert o == _engine_tokens(ref, p, 8), p
+
+
+def test_tier3_zero_steady_state_compiles(params, dparams):
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, paged=True,
+                       draft=(DCFG, dparams), draft_k=3)
+    eng.warmup()                            # marks the compile baseline
+    for start in (1, 5):
+        prompt = np.arange(start, start + 9, dtype=np.int32)
+        _engine_tokens(eng, prompt, 10)
+    assert decode_metrics.snapshot()["compile_delta_since_mark"] == 0
+
+
+# -- hot weight swap --------------------------------------------------------
+
+def test_rebind_params_requires_idle_then_flips(params):
+    p_new = gpt.init_params(jax.random.key(11), CFG)
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, paged=True)
+    eng.warmup()
+    prompt = np.arange(1, 8, dtype=np.int32)
+    bucket, slot, _ = eng.start(prompt, max_tokens=4)
+    with pytest.raises(RuntimeError, match="busy"):
+        eng.rebind_params(p_new)
+    eng.release(bucket, slot)
+    eng.rebind_params(p_new)
+    assert _engine_tokens(eng, prompt, 10) == _solo(p_new, prompt, 10)
+    assert decode_metrics.snapshot()["compile_delta_since_mark"] == 0
+
+
+def test_rebind_invalidates_resident_prefix(params):
+    """Pages harvested under the old weights must never satisfy a hit
+    after a swap: rebinding bumps the engine's prefix fingerprint and
+    drops the resident registry."""
+    p_new = gpt.init_params(jax.random.key(12), CFG)
+    eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                       prefill_chunk=8, paged=True)
+    eng.warmup()
+    head = np.arange(1, 17, dtype=np.int32)
+    _engine_tokens(eng, np.concatenate([head, [20]]), 6)
+    assert eng._alloc.in_use() > 0          # resident registry pins
+    eng.rebind_params(p_new)
+    assert eng._alloc.in_use() == 0         # registry flushed
+    p2 = np.concatenate([head, [30]])
+    before = decode_metrics.snapshot()["prefix_hits"]
+    assert _engine_tokens(eng, p2, 8) == _solo(p_new, p2, 8)
+    assert decode_metrics.snapshot()["prefix_hits"] == before
+
+
+def test_router_swap_weights_zero_drops(params):
+    """Live fleet rolls onto a new checkpoint: no request is dropped
+    or shed, requests during the swap are counted, the swap books its
+    counter, steady-state compiles stay at zero, and post-swap output
+    comes from the NEW weights."""
+    p_new = gpt.init_params(jax.random.key(13), CFG)
+    store = PrefixCache()
+
+    def factory():
+        eng = DecodeEngine(CFG, params, n_slots=4, buckets=(32,),
+                           prefill_chunk=8, paged=True,
+                           prefix_cache=store)
+        eng.warmup()
+        return ContinuousBatcher(eng, default_max_tokens=6)
+
+    router = AutoscalingRouter(
+        factory, AutoscalePolicy(min_replicas=2, max_replicas=2))
+    before = decode_metrics.snapshot()
+    stop = threading.Event()
+    errors = []
+
+    def traffic():
+        rng = np.random.default_rng(2)
+        while not stop.is_set():
+            try:
+                router.generate(rng.integers(1, 64, size=9), timeout=60.0)
+            except Exception as e:          # any drop = failure
+                errors.append(e)
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    try:
+        time.sleep(0.2)
+        assert router.swap_weights(p_new, timeout=60.0) == 2
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        t.join()
+    prompt = np.arange(1, 8, dtype=np.int32)
+    out = list(router.generate(prompt, timeout=60.0, max_tokens=8))
+    router.close()
+    assert not errors, errors[:3]
+    assert out == _solo(p_new, prompt, 8)
+    after = decode_metrics.snapshot()
+    assert after["swaps_completed"] == before["swaps_completed"] + 1
+    assert after["compile_delta_since_mark"] == 0
+    assert router._draining == set() and not router._swapping
+
+
+def test_swap_single_replica_spawns_temp(params):
+    """A one-replica fleet can still swap without downtime: a
+    temporary factory replica keeps serving while the only real one
+    drains, is swapped too, then retired."""
+    p_new = gpt.init_params(jax.random.key(14), CFG)
+
+    def factory():
+        eng = DecodeEngine(CFG, params, n_slots=2, buckets=(32,),
+                           prefill_chunk=8, paged=True)
+        eng.warmup()
+        return ContinuousBatcher(eng, default_max_tokens=6)
+
+    router = AutoscalingRouter(
+        factory, AutoscalePolicy(min_replicas=1, max_replicas=2))
+    assert router.swap_weights(p_new, timeout=60.0) == 2
+    assert router.n_replicas() == 1         # temp retired
+    prompt = np.arange(1, 6, dtype=np.int32)
+    out = list(router.generate(prompt, timeout=60.0, max_tokens=6))
+    router.close()
+    assert out == _solo(p_new, prompt, 6)

@@ -274,6 +274,11 @@ def test_process_registry_has_all_counter_families():
     assert "compile_count" in snap["counters"]["compile"]
     assert "requests" in snap["counters"]["serving"]
     assert "tokens_out" in snap["counters"]["decode"]
+    # tier-3 counters ride the existing "decode" family — NO new family
+    for key in ("pages_in_use", "pages_in_use_hw", "page_utilization",
+                "draft_proposed", "draft_accepted", "draft_accept_rate",
+                "swaps_completed", "requests_during_swap"):
+        assert key in snap["counters"]["decode"], key
     assert "dispatches" in snap["counters"]["dp"]
     assert "snapshots_committed" in snap["counters"]["checkpoint"]
     assert "estimates" in snap["counters"]["mfu"]

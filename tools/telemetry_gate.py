@@ -29,6 +29,12 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
    and prefix HITS (which write cached pages into a slot) without a
    single new program — the dequant-fused executables, the page
    read/write pair, and every hit length are covered by ``warmup()``;
+6c. the same off/on zero-compile contract for the SERVING TIER 3
+   loop: a warmed PAGED + SPECULATIVE replica fleet behind the
+   autoscaling router serving mixed traffic — page allocation/release,
+   draft propose/verify rounds, prefix mounts — with a zero-downtime
+   ``swap_weights`` in the MIDDLE of each pass: the swap drains,
+   rebinds, and requantizes without tracing one new program;
 7. the same off/on zero-compile contract for a warmed DATA×MODEL fit
    (``models/lm_fit.CausalLM`` on a 2×4 mesh through the sharded_fit
    GSPMD builders): the model-sharded scanned dispatch, its staging
@@ -356,6 +362,107 @@ def _tier2_decode_gate(registry, telemetry) -> int:
     return 0
 
 
+def _tier3_decode_gate(registry, telemetry) -> int:
+    """Serving-tier-3 loop gate: a warmed PAGED + SPECULATIVE fleet
+    behind the autoscaling router — prefix misses and hits, draft
+    propose/verify rounds, and a mid-loop zero-downtime weight swap —
+    must dispatch only cached programs with the tracer off AND on.
+    The swap itself is part of the contract: same shapes, same
+    executables, zero new programs."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.runtime.metrics import decode_metrics
+    from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
+                                                   DecodeEngine,
+                                                   PrefixCache)
+    from deeplearning4j_tpu.serving.router import (AutoscalePolicy,
+                                                   AutoscalingRouter)
+
+    cfg = gpt.gpt_tiny(vocab_size=48, max_len=32)
+    dcfg = dataclasses.replace(cfg, hidden=16, n_layers=1, n_heads=2,
+                               ffn_dim=32)
+    params = gpt.init_params(jax.random.key(0), cfg)
+    dp = gpt.init_params(jax.random.key(1), dcfg)
+    p_new = gpt.init_params(jax.random.key(5), cfg)
+    store = PrefixCache()
+
+    def factory():
+        eng = DecodeEngine(cfg, params, n_slots=3, buckets=(16, 32),
+                           prefill_chunk=8, paged=True,
+                           draft=(dcfg, dp), draft_k=3,
+                           prefix_cache=store, label="gate-tier3")
+        eng.warmup()
+        return ContinuousBatcher(eng, default_max_tokens=4)
+
+    shared = np.random.RandomState(3).randint(1, 48, size=16) \
+        .astype(np.int32)
+
+    def mixed_requests(router, seed):
+        r = np.random.RandomState(seed)
+        handles = []
+        for i in range(6):
+            if i % 2:                     # prefix-sharing requests
+                tail = r.randint(1, 48, size=r.randint(1, 6))
+                prompt = np.concatenate([shared, tail.astype(np.int32)])
+            else:                         # fresh prompts (misses)
+                prompt = r.randint(1, 48, size=r.randint(2, 12))
+            handles.append(router.submit(prompt, max_tokens=3 + i % 3))
+        for h in handles:
+            h.result(120)
+
+    router = AutoscalingRouter(
+        factory, AutoscalePolicy(min_replicas=2, max_replicas=2))
+    try:
+        mixed_requests(router, seed=7)    # warm joins + seed the store
+        for b in router.batchers:
+            b.engine.flush_harvests()
+        registry.mark()
+
+        assert not telemetry.enabled()
+        mixed_requests(router, seed=8)
+        router.swap_weights(p_new)        # mid-loop hot swap
+        mixed_requests(router, seed=9)
+        delta_off = registry.compile_delta_since_mark()
+        if delta_off != 0:
+            print(f"[telemetry-gate] FAIL: tracer-off tier-3 decode "
+                  f"loop compiled {delta_off} new program(s)")
+            return 1
+
+        telemetry.enable("telemetry-gate-tier3")
+        registry.mark()
+        mixed_requests(router, seed=10)
+        router.swap_weights(params)       # and back, tracer on
+        mixed_requests(router, seed=11)
+        delta_on = registry.compile_delta_since_mark()
+        telemetry.disable()
+        if delta_on != 0:
+            print(f"[telemetry-gate] FAIL: tracer-on tier-3 decode "
+                  f"loop compiled {delta_on} new program(s) — paged/"
+                  "speculative/swap instrumentation leaked into a "
+                  "jitted region")
+            return 1
+    finally:
+        router.close()
+    snap = decode_metrics.snapshot()
+    if snap["draft_proposed"] < 1:
+        print("[telemetry-gate] FAIL: tier-3 loop proposed no draft "
+              "tokens — the speculative path did not run")
+        return 1
+    if snap["swaps_completed"] < 2:
+        print(f"[telemetry-gate] FAIL: tier-3 loop completed only "
+              f"{snap['swaps_completed']} swap(s), expected 2")
+        return 1
+    print(f"[telemetry-gate] ok: tier-3 decode loop compile_delta "
+          f"off={delta_off} on={delta_on}, accept_rate="
+          f"{snap['draft_accept_rate']}, {snap['swaps_completed']} "
+          "swap(s)")
+    return 0
+
+
 def main() -> int:
     from deeplearning4j_tpu.runtime import telemetry
 
@@ -414,7 +521,10 @@ def main() -> int:
     rc = _decode_gate(registry, telemetry)
     if rc:
         return rc
-    return _tier2_decode_gate(registry, telemetry)
+    rc = _tier2_decode_gate(registry, telemetry)
+    if rc:
+        return rc
+    return _tier3_decode_gate(registry, telemetry)
 
 
 if __name__ == "__main__":
