@@ -20,6 +20,14 @@ tests and soak runs rather than only when something really breaks.
 Failures are drawn from a counter-based hash of (seed, worker calls), so
 a given seed produces the same fault schedule every run — flaky-test
 debugging stays deterministic.
+
+``ServingChaos`` extends the same philosophy to the serving fleet: it
+arms one-shot faults against ONE decode replica (a ``ContinuousBatcher``
+over a ``DecodeEngine``) — worker-thread death, dispatch poison, stalls,
+KV page-pool exhaustion — each fired deterministically at the replica's
+next step boundary ON its own worker thread (the engine and its page
+allocator are single-driver by contract; chaos must not become the
+second driver).  ``tools/serving_chaos_gate.py`` drives it in CI.
 """
 
 from __future__ import annotations
@@ -186,6 +194,232 @@ class ChaosPerformer(so.WorkerPerformer):
 
     def update(self, *args) -> None:
         self.inner.update(*args)
+
+
+class WorkerKilled(BaseException):
+    """Injected decode-worker death.  Deliberately a ``BaseException``:
+    the batcher's dispatch-failure handler catches ``Exception`` (the
+    replay path), and a KILL must sail past it and terminate the worker
+    thread exactly like an interpreter-level death would — leaving
+    ``worker_alive()`` False and the replica's in-flight requests
+    stranded for the health monitor to evacuate."""
+
+
+_orig_thread_excepthook: Optional[Callable] = None
+
+
+def _install_kill_excepthook() -> None:
+    """Silence ONLY :class:`WorkerKilled` escaping a thread — an
+    injected death is the drill's expected outcome, and its traceback
+    spew would make every chaos run look like a failing one.  All other
+    thread exceptions still reach the previous hook.  Idempotent;
+    installed on first ``ServingChaos`` construction."""
+    global _orig_thread_excepthook
+    if _orig_thread_excepthook is not None:
+        return
+    _orig_thread_excepthook = threading.excepthook
+
+    def hook(args) -> None:
+        if args.exc_type is not WorkerKilled:
+            _orig_thread_excepthook(args)
+
+    threading.excepthook = hook
+
+
+class ServingChaos:
+    """Deterministic fault injection for ONE serving replica.
+
+    Every injector ARMS a fault rather than performing it: the fault
+    fires at the replica's next touch of an engine step-boundary entry
+    point (``advance`` / ``advance_spec``, plus ``can_admit`` for the
+    faults that are legal under the batcher's condition variable), so
+    the mutation happens on the replica's OWN worker thread — the
+    engine and its ``PageAllocator`` are single-driver by contract, and
+    chaos must not become a second driver racing it.
+
+    - :meth:`kill_worker`: next step raises :class:`WorkerKilled`
+      (a BaseException — escapes the replay handler, thread dies);
+    - :meth:`poison_dispatch`: next ``n`` decode dispatches raise
+      :class:`InjectedFault` — exercises the donated-state poison reset
+      and bit-exact request replay;
+    - :meth:`stall_dispatch`: next decode dispatch sleeps first — trips
+      the monitor's ``progress_age`` stall detector while the zombie
+      worker later wakes into detached request handles;
+    - :meth:`exhaust_pages` / :meth:`release_pages`: grab (then return)
+      the replica's free KV pages — admissions stall, then shed with
+      the typed ``KVPagesExhausted``.
+
+    ``injected`` counts what actually fired; :meth:`restore` disarms
+    anything still pending (a dead worker never fires armed faults).
+    """
+
+    #: entry points legal for faults that may fire under the batcher's
+    #: condition variable (can_admit is called inside the admit scan)
+    _ANY = ("advance", "advance_spec", "can_admit")
+    #: entry points for faults that must fire OUTSIDE every lock
+    #: (sleeps) or that only make sense for a decode dispatch (poison)
+    _DISPATCH = ("advance", "advance_spec")
+
+    def __init__(self, batcher) -> None:
+        self.batcher = batcher
+        self.engine = batcher.engine
+        self.injected = {"kill": 0, "poison": 0, "stall": 0,
+                         "exhaust": 0, "release": 0}
+        self._held_pages: list = []
+        # RLock: page-bookkeeping hooks fire INSIDE the lock region
+        # (atomic with the fire decision) yet keep their own ``with``
+        self._lock = threading.RLock()
+        self._restores: list = []
+        self._exhaust_restores: list = []
+        _install_kill_excepthook()
+
+    # -- arming machinery --------------------------------------------------
+    def _arm(self, hook: Callable, methods, times: int = 1, *,
+             locked_hook: bool = False) -> Callable:
+        """Wrap ``methods`` on the engine so the next ``times`` calls
+        (across all of them) run ``hook(name)`` first — on the calling
+        (worker) thread — then restore the originals and delegate.  A
+        raising hook still restores first: an injected fault must fire
+        its scheduled count, never forever.  Returns the disarm
+        closure (idempotent; a no-op once the fault has fired).
+
+        Every setattr — install, fire-restore, disarm — happens under
+        ``self._lock``: arming runs on the host thread while faults
+        fire on the worker thread, and an unsynchronized disarm racing
+        a fire could resurrect a wrapper that was already retired.
+        ``locked_hook=True`` additionally runs the hook inside the
+        lock region, making the fire ATOMIC with the fire decision —
+        required for page bookkeeping, where a disarm racing a
+        half-fired grab would mis-read what is held.  Blocking hooks
+        (sleeps) must keep the default and fire outside the lock."""
+        eng = self.engine
+        state = {"left": int(times)}
+        with self._lock:
+            origs = {m: getattr(eng, m) for m in methods}
+
+        def restore() -> None:
+            with self._lock:
+                if state["left"] == 0:
+                    return
+                state["left"] = 0
+                for m, o in origs.items():
+                    setattr(eng, m, o)
+
+        def make(name: str, orig: Callable) -> Callable:
+            def wrapped(*a, **kw):
+                with self._lock:
+                    fire = state["left"] > 0
+                    if fire:
+                        state["left"] -= 1
+                        if state["left"] == 0:
+                            for m, o in origs.items():
+                                setattr(eng, m, o)
+                        if locked_hook:
+                            hook(name)
+                if fire and not locked_hook:
+                    hook(name)
+                return orig(*a, **kw)
+            return wrapped
+
+        with self._lock:
+            for m, o in origs.items():
+                setattr(eng, m, make(m, o))
+        self._restores.append(restore)
+        return restore
+
+    def restore(self) -> None:
+        """Disarm every armed-but-unfired fault (fired ones already
+        restored themselves) and return any held pages.  Call only when
+        the replica's worker is dead or quiescent — see
+        :meth:`release_pages` for the held-page caveat."""
+        for r in self._restores:
+            r()
+        self._restores = []
+        self.release_pages(armed=False)
+
+    # -- injectors ---------------------------------------------------------
+    def kill_worker(self) -> None:
+        """Arm a one-shot :class:`WorkerKilled` on the replica's next
+        step boundary."""
+        def hook(name: str) -> None:
+            self.injected["kill"] += 1
+            raise WorkerKilled(f"injected worker death (at {name})")
+        self._arm(hook, self._ANY)
+
+    def poison_dispatch(self, n: int = 1) -> None:
+        """Arm :class:`InjectedFault` on the next ``n`` decode
+        dispatches (an ordinary RuntimeError — the batcher's replay
+        handler owns it)."""
+        if n < 1:
+            raise ValueError(f"poison count must be >= 1: {n}")
+
+        def hook(name: str) -> None:
+            self.injected["poison"] += 1
+            raise InjectedFault(f"injected dispatch poison (at {name})")
+        self._arm(hook, self._DISPATCH, times=n)
+
+    def stall_dispatch(self, seconds: float) -> None:
+        """Arm a one-shot pre-dispatch sleep — long enough and the
+        health monitor's ``progress_age`` detector replaces the
+        replica while this worker is still inside the sleep."""
+        if seconds <= 0:
+            raise ValueError(f"stall must be > 0 s: {seconds}")
+
+        def hook(name: str) -> None:
+            self.injected["stall"] += 1
+            time.sleep(seconds)
+        self._arm(hook, self._DISPATCH)
+
+    def exhaust_pages(self, leave: int = 0) -> None:
+        """Arm a one-shot grab of the replica's free KV pages (leaving
+        ``leave``), held by this injector: admissions stall, then shed
+        with the typed ``KVPagesExhausted``.  Paged engines only."""
+        if self.engine._alloc is None:
+            raise ValueError("exhaust_pages requires a paged engine")
+        if leave < 0:
+            raise ValueError(f"leave must be >= 0: {leave}")
+
+        def hook(name: str) -> None:
+            alloc = self.engine._alloc
+            n = max(alloc.n_free() - int(leave), 0)
+            if n:
+                with self._lock:
+                    self._held_pages.extend(alloc.alloc(n))
+            self.injected["exhaust"] += 1
+        self._exhaust_restores.append(
+            self._arm(hook, self._ANY, locked_hook=True))
+
+    def release_pages(self, armed: bool = True) -> None:
+        """End the exhaustion episode and return every held page.
+
+        A still-ARMED (unfired) exhaust is disarmed first: without
+        this, a release racing a slow-to-wake worker would free
+        nothing, then the pending grab would fire AFTER it and hold
+        the pool forever.  ``armed=True`` (default) frees on the
+        worker thread at the replica's next step boundary — the
+        allocator's single-driver contract.  ``armed=False`` frees
+        from the calling thread immediately; legal only when the
+        worker is dead or parked (e.g. auditing occupancy after a
+        drill)."""
+        for r in self._exhaust_restores:
+            r()
+        self._exhaust_restores = []
+
+        def hook(name: str) -> None:
+            with self._lock:
+                held, self._held_pages = self._held_pages, []
+            alloc = self.engine._alloc
+            if alloc is not None and held:
+                alloc.free(held)
+                self.injected["release"] += 1
+        with self._lock:
+            holding = bool(self._held_pages)
+        if not holding:
+            return                       # the grab never fired: no-op
+        if armed:
+            self._arm(hook, self._ANY, locked_hook=True)
+        else:
+            hook("direct")
 
 
 def chaos_factory(inner_factory: Callable[[], so.WorkerPerformer], *,

@@ -302,6 +302,27 @@ class DecodeMetrics:
     - ``swaps_completed`` / ``requests_during_swap``: hot checkpoint
       swaps finished by ``AutoscalingRouter.swap_weights`` and requests
       accepted while one was in progress (the zero-downtime witness).
+
+    Serving fault tolerance (deadlines + health-checked replacement +
+    deterministic re-dispatch + brownout) — still the ``"decode"``
+    family, no new registry source:
+
+    - ``deadline_expirations``: requests freed (pages reclaimed, typed
+      ``DeadlineExceeded`` on the future) because their ``deadline_ms``
+      passed while queued or mid-decode;
+    - ``replicas_replaced``: unhealthy replicas (dead worker thread,
+      dispatch-exception streak, stall) retired and respawned from the
+      factory by the router's health monitor;
+    - ``requests_replayed``: in-flight requests deterministically
+      re-dispatched — replayed as (prompt + tokens emitted so far) on a
+      healthy replica, continuing bit-identically (sampling keys fold
+      (seed, position), not step count);
+    - ``brownout_transitions`` / ``brownout_level``: graceful-brownout
+      ladder moves and the current level gauge (0 = normal, 1 =
+      speculative decoding off, 2 = + prefix harvesting bypassed);
+    - ``pages_leaked``: gauge — allocator page references not accounted
+      for by any live slot or the resident-prefix registry after the
+      last release (nonzero means a reclaim path missed pages).
     """
 
     MAX_SAMPLES = 8192
@@ -339,6 +360,12 @@ class DecodeMetrics:
             self.draft_accepted = 0
             self.swaps_completed = 0
             self.requests_during_swap = 0
+            self.deadline_expirations = 0
+            self.replicas_replaced = 0
+            self.requests_replayed = 0
+            self.brownout_transitions = 0
+            self.brownout_level = 0
+            self.pages_leaked = 0
             self._ttft_ms: List[float] = []
             self._tok_ms: List[float] = []
             self._compile_mark: Optional[int] = None
@@ -396,6 +423,27 @@ class DecodeMetrics:
     def note_request_during_swap(self) -> None:
         with self._lock:
             self.requests_during_swap += 1
+
+    def note_deadline_expiration(self) -> None:
+        with self._lock:
+            self.deadline_expirations += 1
+
+    def note_replica_replaced(self) -> None:
+        with self._lock:
+            self.replicas_replaced += 1
+
+    def note_request_replayed(self) -> None:
+        with self._lock:
+            self.requests_replayed += 1
+
+    def note_brownout(self, level: int) -> None:
+        with self._lock:
+            self.brownout_transitions += 1
+            self.brownout_level = int(level)
+
+    def note_pages_leaked(self, n: int) -> None:
+        with self._lock:
+            self.pages_leaked = int(n)
 
     def note_complete(self, tokens: int) -> None:
         with self._lock:
@@ -471,6 +519,12 @@ class DecodeMetrics:
                 if self.draft_proposed else 0.0,
                 "swaps_completed": self.swaps_completed,
                 "requests_during_swap": self.requests_during_swap,
+                "deadline_expirations": self.deadline_expirations,
+                "replicas_replaced": self.replicas_replaced,
+                "requests_replayed": self.requests_replayed,
+                "brownout_transitions": self.brownout_transitions,
+                "brownout_level": self.brownout_level,
+                "pages_leaked": self.pages_leaked,
                 "ttft_p50_ms": ServingMetrics._pct(ttft, 0.50),
                 "ttft_p99_ms": ServingMetrics._pct(ttft, 0.99),
                 "tok_p50_ms": ServingMetrics._pct(tok, 0.50),

@@ -50,6 +50,28 @@ Serving tier 3 (live tokens, live weights, raw tokens/s):
   speculative decoding — k proposed tokens verified in ONE target
   dispatch, bit-identical to plain decode at any temperature.
 
+Serving fault tolerance (behavior under partial failure):
+
+- ``DecodeRequest(deadline_ms=)``: per-request deadlines — expired
+  requests free their slot, reclaim their KV pages, and resolve with
+  the typed ``DeadlineExceeded`` instead of occupying capacity.
+- ``AutoscalingRouter(health=ReplicaHealth(...))``: a health monitor
+  thread detects dead workers, dispatch-error streaks, and stalls,
+  then retires the replica and spawns a factory replacement (zero new
+  compiles); every in-flight request is journaled (prompt, seed,
+  temperature, tokens emitted) and replayed BIT-identically on the
+  replacement — sampling keys fold (seed, position), so replica death
+  loses no request.
+- Graceful brownout: under pressure at the replica ceiling the router
+  first disables speculative decoding, then bypasses prefix
+  harvesting — booked, reversible — and only sheds from level 2.
+- ``SwapFailed`` / ``RouterClosed`` / ``BatcherClosed``: typed errors
+  for wedged swap drains and submit-vs-close races.
+- ``parallel.chaos.ServingChaos`` + ``tools/serving_chaos_gate.py``:
+  fault-injection drill asserting bit-exact completion, zero new
+  compiles, and zero leaked pages under replica kill / dispatch
+  poison / stall / pool exhaustion.
+
 ``MultiLayerNetwork.output/predict/score`` and ``Evaluation.eval`` route
 through this layer; the per-model adapters live next to each model
 (``models/*.make_serving_apply``).  Metrics:
@@ -59,13 +81,14 @@ through this layer; the per-model adapters live next to each model
 
 from deeplearning4j_tpu.serving.batcher import DynamicBatcher  # noqa: F401
 from deeplearning4j_tpu.serving.decode import (  # noqa: F401
-    KV_PAGE_TOKENS, ContinuousBatcher, DecodeEngine, DecodeRequest,
-    KVPagesExhausted, PageAllocator, PrefixCache,
-    default_length_buckets,
+    KV_PAGE_TOKENS, BatcherClosed, ContinuousBatcher, DeadlineExceeded,
+    DecodeEngine, DecodeRequest, KVPagesExhausted, PageAllocator,
+    PrefixCache, default_length_buckets,
 )
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, default_buckets, pad_rows, pick_bucket,
 )
 from deeplearning4j_tpu.serving.router import (  # noqa: F401
-    AutoscalePolicy, AutoscalingRouter, OverloadedError, Router,
+    AutoscalePolicy, AutoscalingRouter, OverloadedError, ReplicaHealth,
+    Router, RouterClosed, SwapFailed,
 )

@@ -11,7 +11,10 @@ and resolves each caller's future with exactly its own result rows.
 Policy knobs:
 - ``max_batch_size``: flush as soon as this many rows are queued;
 - ``max_delay_ms``: a lone request never waits longer than this — the
-  latency bound traded for coalescing.
+  latency bound traded for coalescing;
+- per-request ``deadline_ms`` (optional): a request still queued past
+  its deadline resolves with the typed ``DeadlineExceeded`` instead of
+  spending MXU time on an answer nobody is waiting for.
 
 Each request is an [n, ...] batch (or a single example of the model's
 per-example shape, returned unbatched).  Results are host numpy: the
@@ -38,19 +41,24 @@ from typing import Any, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.runtime import telemetry
-from deeplearning4j_tpu.runtime.metrics import serving_metrics
+from deeplearning4j_tpu.runtime.metrics import (decode_metrics,
+                                                serving_metrics)
+from deeplearning4j_tpu.serving.decode import BatcherClosed, DeadlineExceeded
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
 
 class _Request:
-    __slots__ = ("x", "rows", "single", "future", "t_submit")
+    __slots__ = ("x", "rows", "single", "future", "t_submit", "deadline")
 
-    def __init__(self, x: np.ndarray, single: bool):
+    def __init__(self, x: np.ndarray, single: bool,
+                 deadline_ms: Optional[float]):
         self.x = x
         self.rows = x.shape[0]
         self.single = single
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        self.deadline = None if deadline_ms is None \
+            else self.t_submit + deadline_ms / 1e3
 
 
 class DynamicBatcher:
@@ -71,21 +79,27 @@ class DynamicBatcher:
         self._thread.start()
 
     # -- client side -------------------------------------------------------
-    def submit(self, x) -> Future:
+    def submit(self, x, *, deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request; returns a Future resolving to its result
         rows (numpy).  A 1-D/example-shaped input (one rank below the
         first pending batch's rank is not knowable here, so: anything the
         caller flags by passing ``np.ndarray`` without a batch dim must
         be pre-batched — except scalars-per-example models; see
-        ``submit_one``)."""
-        return self._submit(np.asarray(x), single=False)
+        ``submit_one``).  ``deadline_ms``: a request still queued past
+        its deadline resolves with ``DeadlineExceeded`` instead of
+        joining a cohort."""
+        return self._submit(np.asarray(x), single=False,
+                            deadline_ms=deadline_ms)
 
-    def submit_one(self, example) -> Future:
+    def submit_one(self, example, *,
+                   deadline_ms: Optional[float] = None) -> Future:
         """Enqueue a single UNBATCHED example; the future resolves to its
         unbatched result (row 0 of the model output)."""
-        return self._submit(np.asarray(example)[None], single=True)
+        return self._submit(np.asarray(example)[None], single=True,
+                            deadline_ms=deadline_ms)
 
-    def _submit(self, x: np.ndarray, single: bool) -> Future:
+    def _submit(self, x: np.ndarray, single: bool,
+                deadline_ms: Optional[float] = None) -> Future:
         # reject against the engine's known input spec HERE, before the
         # request can ever join (and poison, or be poisoned by) a
         # coalescing window — with a warmed engine this is the authority
@@ -96,10 +110,12 @@ class DynamicBatcher:
             raise ValueError(
                 f"request per-example shape {x.shape[1:]}/{x.dtype} does "
                 f"not match the engine's {spec[0]}/{spec[1]}")
-        req = _Request(x, single)
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError("deadline_ms must be > 0")
+        req = _Request(x, single, deadline_ms)
         with self._cv:
             if not self._open:
-                raise RuntimeError("DynamicBatcher is closed")
+                raise BatcherClosed("DynamicBatcher is closed")
             self._pending.append(req)
             serving_metrics.note_request(req.rows)
             serving_metrics.note_queue_depth(len(self._pending))
@@ -166,6 +182,31 @@ class DynamicBatcher:
                     f"match the batch's {head[0]}/{head[1]}"))
         return keep
 
+    def _expire(self, batch: List[_Request]) -> List[_Request]:
+        """Resolve requests whose deadline passed while queued with the
+        typed ``DeadlineExceeded`` instead of spending a dispatch on
+        rows nobody is waiting for; booked on the serving family's
+        decode-shared failure counter."""
+        now = time.perf_counter()
+        keep: List[_Request] = []
+        for r in batch:
+            if r.deadline is None or now <= r.deadline:
+                keep.append(r)
+            elif r.future.set_running_or_notify_cancel():
+                elapsed_ms = (now - r.t_submit) * 1e3
+                deadline_ms = (r.deadline - r.t_submit) * 1e3
+                r.future.set_exception(DeadlineExceeded(
+                    deadline_ms=deadline_ms, elapsed_ms=elapsed_ms,
+                    tokens_emitted=0))
+                # fault-tolerance failure counters ride the decode
+                # family (one serving-wide home; see runtime/metrics.py)
+                decode_metrics.note_deadline_expiration()
+                tr = telemetry.get_tracer()
+                if tr is not None:
+                    tr.event("serving.deadline_exceeded", rows=r.rows,
+                             elapsed_ms=round(elapsed_ms, 3))
+        return keep
+
     def _loop(self) -> None:
         import jax
 
@@ -173,7 +214,7 @@ class DynamicBatcher:
             batch = self._take_batch()
             if not batch:
                 return
-            batch = self._reject_mismatched(batch)
+            batch = self._expire(self._reject_mismatched(batch))
             if not batch:
                 continue
             # book only what actually dispatches: rejected requests (and

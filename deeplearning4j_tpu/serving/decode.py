@@ -121,6 +121,25 @@ class KVPagesExhausted(RuntimeError):
         self.slot = slot
 
 
+class DeadlineExceeded(RuntimeError):
+    """Typed per-request deadline expiry: the request's ``deadline_ms``
+    budget elapsed while it was queued or mid-decode.  The batcher
+    frees its slot and reclaims its KV pages the moment it expires —
+    an expired request never occupies capacity a live one could use.
+    Carries the partial stream length so clients can distinguish
+    'never started' from 'cut off mid-continuation'."""
+
+    def __init__(self, deadline_ms: float, elapsed_ms: float,
+                 tokens_emitted: int):
+        super().__init__(
+            f"decode request deadline exceeded: {elapsed_ms:.1f}ms "
+            f"elapsed of a {deadline_ms:.1f}ms budget "
+            f"({tokens_emitted} token(s) emitted)")
+        self.deadline_ms = deadline_ms
+        self.elapsed_ms = elapsed_ms
+        self.tokens_emitted = tokens_emitted
+
+
 class PageAllocator:
     """Host-side refcounted free-list allocator over the paged engine's
     pool ids.  Page 0 is RESERVED (the trash page inactive-slot writes
@@ -177,6 +196,12 @@ class PageAllocator:
 
     def refcount(self, pid: int) -> int:
         return self._refs.get(pid, 0)
+
+    def total_refs(self) -> int:
+        """Sum of all outstanding page references — the leak-audit
+        numerator: every live slot's table entries plus every resident
+        -registry registration should account for exactly this many."""
+        return sum(self._refs.values())
 
 
 def default_length_buckets(max_len: int, min_bucket: int = 32
@@ -444,6 +469,14 @@ class DecodeEngine:
         self.draft_k = int(draft_k)
         if draft is not None and self.draft_k < 1:
             raise ValueError(f"draft_k must be >= 1: {draft_k}")
+        #: graceful-brownout knobs (the AutoscalingRouter pressure
+        #: ladder flips them): plain bools, written by the router
+        #: thread and read by the batcher worker each pass — a torn
+        #: read costs at most one pass at the old setting, and both
+        #: settings are CORRECT (spec-off and harvest-off change cost,
+        #: never tokens), so no lock is needed
+        self.spec_enabled = True
+        self.harvest_enabled = True
         self.quantize = qz.check_mode(quantize)
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8': {kv_dtype!r}")
@@ -971,6 +1004,33 @@ class DecodeEngine:
         b.tokens_h[slot] = 0
         b.pos_h[slot] = 0
         decode_metrics.note_pages(self._alloc.in_use(), 0, 0)
+        decode_metrics.note_pages_leaked(self.pages_unaccounted())
+
+    def _drop_pool(self) -> None:
+        """Poison-reset after a failed paged dispatch: the pool was
+        donated into the failure, so it re-initializes to ZEROS on the
+        next ``_pool_state``.  The resident-prefix registry must flush
+        WITH it — its entries reference page ids whose KV bytes no
+        longer exist, and a later mount-by-reference hit would serve
+        zeroed cache rows as silently wrong tokens."""
+        self._pool = None
+        self._dpool = None
+        for _, (_, ids) in self._resident.items():
+            self._alloc.free(ids)
+        self._resident.clear()
+        decode_metrics.note_pages_leaked(self.pages_unaccounted())
+
+    def pages_unaccounted(self) -> int:
+        """Allocator page references not explained by any live slot's
+        page table or the resident-prefix registry — nonzero means a
+        reclaim path leaked (exported as the ``pages_leaked`` gauge,
+        asserted zero by the chaos drill after drain)."""
+        if not self.paged:
+            return 0
+        accounted = sum(int(bb.n_pages.sum())
+                        for bb in self._buckets.values())
+        accounted += sum(len(ids) for _, ids in self._resident.values())
+        return self._alloc.total_refs() - accounted
 
     # -- pool-resident prefix pages ----------------------------------------
     def _resident_lookup(self, prompt: np.ndarray):
@@ -1019,6 +1079,20 @@ class DecodeEngine:
                > self._resident_max and self._resident):
             _, (_, ids) = self._resident.popitem(last=False)
             self._alloc.free(ids)
+
+    def drop_residents(self) -> None:
+        """Evict every pool-resident prefix registration, releasing the
+        registry's page references (pages shared with live slots
+        survive until those slots release — refcounts).  An operational
+        pressure valve, and the occupancy-zero audit hook for drills:
+        after a full drain plus ``drop_residents`` the allocator's
+        ``in_use()`` must be exactly zero.  Call from the driver thread
+        — or when the engine's worker is dead or quiescent (the
+        allocator's single-driver contract)."""
+        while self._resident:
+            _, (_, ids) = self._resident.popitem(last=False)
+            self._alloc.free(ids)
+        decode_metrics.note_pages_leaked(self.pages_unaccounted())
 
     # -- hot checkpoint swap -----------------------------------------------
     def rebind_params(self, params: Any,
@@ -1356,7 +1430,8 @@ class DecodeEngine:
             else:
                 decode_metrics.note_prefix_miss()
             m_store = C * ((prompt.size - 1) // C)
-            if m_store > hit_len and m_store >= C:
+            if m_store > hit_len and m_store >= C \
+                    and self.harvest_enabled:
                 # harvest this prompt's chunk-aligned prefix for later
                 # requests — also on PARTIAL hits, or a growing
                 # conversation would hit only its first turn's prefix
@@ -1451,9 +1526,14 @@ class DecodeEngine:
             except Exception:
                 # the pool was donated into the failed dispatch — every
                 # paged bucket's KV is gone; drop it so serving
-                # re-initializes instead of touching deleted buffers
-                self._pool = None
-                self._dpool = None
+                # re-initializes instead of touching deleted buffers.
+                # FIRST return this slot's page-table references
+                # (resident-hit shares AND fresh pages) to the
+                # allocator: the failed dispatch destroyed the KV
+                # bytes, but the allocator's bookkeeping is host-side —
+                # skipping this leaked the pages until engine teardown
+                self._release_pages(b, slot)
+                self._drop_pool()
                 raise
             first_tok = int(first)              # join-time sync, once
         decode_metrics.note_prefill(n_chunks - h)
@@ -1466,7 +1546,7 @@ class DecodeEngine:
         else:
             decode_metrics.note_prefix_miss()
         m_store = C * ((prompt.size - 1) // C)
-        if m_store > hit_len and m_store >= C:
+        if m_store > hit_len and m_store >= C and self.harvest_enabled:
             # harvest: register the prefix pages pool-resident (no
             # dispatch — the registry just refs the page ids) and, with
             # a host store attached, enqueue the cross-replica fetch
@@ -1505,8 +1585,7 @@ class DecodeEngine:
                         params, pool, b.ptab.copy(), b.tokens_h.copy(),
                         b.pos_h.copy(), run, b.temps, b.seeds)
                 except Exception:
-                    self._pool = None       # donated into the failure
-                    self._dpool = None
+                    self._drop_pool()       # donated into the failure
                     raise
                 self._pool = pool
                 # the per-step stream sync: each active request's next
@@ -1573,8 +1652,7 @@ class DecodeEngine:
                         params, pool, b.ptab.copy(), b.tokens_h.copy(),
                         b.pos_h.copy(), run, b.temps, b.seeds, props)
                 except Exception:
-                    self._pool = None
-                    self._dpool = None
+                    self._drop_pool()
                     raise
                 self._pool = pool
                 # the ONE host round-trip of the round: the committed
@@ -1636,27 +1714,53 @@ class DecodeEngine:
 class DecodeRequest:
     """Handle for one in-flight decode request: tokens stream into an
     internal buffer as the engine emits them; ``result()`` blocks for
-    the full continuation, ``stream()`` yields tokens as they land."""
+    the full continuation, ``stream()`` yields tokens as they land.
+
+    ``deadline_ms`` bounds the WHOLE request (queue wait included):
+    once it elapses the batcher frees the slot, reclaims its KV pages,
+    and resolves the future with the typed :class:`DeadlineExceeded` —
+    an expired request never occupies capacity.
+
+    The handle doubles as the re-dispatch JOURNAL: (prompt, seed,
+    temperature, tokens emitted so far) is everything needed to replay
+    the request on another replica and continue BIT-identically —
+    sampling keys fold (seed, position), not step count, so the token
+    at each absolute position is the same no matter which replica (or
+    how many prefill/decode boundaries) produced it."""
 
     _DONE = object()
 
     def __init__(self, prompt: np.ndarray, max_tokens: int,
-                 temperature: float, seed: int, eos_id: Optional[int]):
+                 temperature: float, seed: int, eos_id: Optional[int],
+                 deadline_ms: Optional[float] = None):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.seed = seed
         self.eos_id = eos_id
+        self.deadline_ms = deadline_ms
         self.ttft_ms: Optional[float] = None
         self._t_submit = time.perf_counter()
+        self._deadline: Optional[float] = (
+            self._t_submit + deadline_ms / 1e3
+            if deadline_ms is not None else None)
         self._tokens: List[int] = []
         self._cond = threading.Condition()
         self._done = False
         self._error: Optional[BaseException] = None
+        # re-dispatch state: a detached request drops producer calls
+        # (its old worker may be wedged and wake up later — zombie
+        # pushes must not corrupt the adopted stream); the replay
+        # budget stops a deterministic dispatch failure from requeueing
+        # forever
+        self._migrated = False
+        self._replays = 0
 
     # -- producer side (batcher worker) ------------------------------------
     def _push(self, tok: int) -> None:
         with self._cond:
+            if self._migrated:
+                return
             if self.ttft_ms is None:
                 self.ttft_ms = (time.perf_counter()
                                 - self._t_submit) * 1e3
@@ -1666,6 +1770,46 @@ class DecodeRequest:
 
     def _finish(self, error: Optional[BaseException] = None) -> None:
         with self._cond:
+            if self._migrated:
+                return
+            self._error = error
+            self._done = True
+            self._cond.notify_all()
+
+    # -- re-dispatch journal ------------------------------------------------
+    def _snapshot_tokens(self) -> np.ndarray:
+        """The emitted-so-far half of the replay journal."""
+        with self._cond:
+            return np.asarray(self._tokens, np.int32)
+
+    def _expired(self, now: float) -> bool:
+        return (self._deadline is not None and now > self._deadline
+                and not self.done())
+
+    def _detach(self) -> None:
+        """Cut the old (dead/wedged) worker off: every later ``_push``/
+        ``_finish`` through THIS handle is dropped; only the adopting
+        replica's :class:`_ReplayRequest` forwards into it."""
+        with self._cond:
+            self._migrated = True
+
+    def _force_push(self, tok: int) -> None:
+        """Producer path for the adopting replica — bypasses the
+        detached guard (the replay shadow is the only caller)."""
+        with self._cond:
+            if self._done:
+                return
+            if self.ttft_ms is None:
+                self.ttft_ms = (time.perf_counter()
+                                - self._t_submit) * 1e3
+                decode_metrics.note_ttft_ms(self.ttft_ms)
+            self._tokens.append(int(tok))
+            self._cond.notify_all()
+
+    def _force_finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            if self._done:
+                return
             self._error = error
             self._done = True
             self._cond.notify_all()
@@ -1715,6 +1859,46 @@ class DecodeRequest:
                 return
 
 
+class BatcherClosed(RuntimeError):
+    """Typed rejection for a submit racing ``close()``: the batcher's
+    closed flag flipped before the request could be enqueued.  Raised
+    synchronously — a request is either accepted (and then drains to
+    completion) or rejected with this; it can never hang unresolved."""
+
+
+class _ReplayRequest(DecodeRequest):
+    """Shadow of an evacuated request, re-submitted on a healthy
+    replica.  Carries the original's full journal — prompt, sampling
+    identity, the tokens already streamed — so the adopting batcher
+    prefills (prompt + emitted) and continues from the NEXT position
+    with the same (seed, position)-folded keys: the continuation is
+    bit-identical to an undisturbed run.  Every produced token/finish
+    forwards into the original handle (the one the client holds); the
+    original's own producer path stays detached, so a wedged old
+    worker waking up later cannot interleave stale tokens."""
+
+    def __init__(self, orig: DecodeRequest):
+        super().__init__(orig.prompt, orig.max_tokens, orig.temperature,
+                         orig.seed, orig.eos_id)
+        self._orig = orig
+        # inherit the ABSOLUTE deadline: migration must not extend a
+        # request's budget (clients sized it end-to-end)
+        self.deadline_ms = orig.deadline_ms
+        self._deadline = orig._deadline
+        self._t_submit = orig._t_submit
+        self.ttft_ms = orig.ttft_ms     # don't re-book a TTFT sample
+        self._tokens = [int(t) for t in orig._snapshot_tokens()]
+        self._replays = orig._replays + 1
+
+    def _push(self, tok: int) -> None:
+        super()._push(tok)
+        self._orig._force_push(tok)
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        super()._finish(error)
+        self._orig._force_finish(error)
+
+
 class ContinuousBatcher:
     """Streaming front-end over a ``DecodeEngine``: one worker thread
     admits pending requests into free slots (prefill joins between
@@ -1723,14 +1907,32 @@ class ContinuousBatcher:
     ``DecodeRequest`` handles.  ``close()`` drains: accepted requests
     run to completion, then the worker exits."""
 
+    #: a request is requeued at most this many times after failed
+    #: dispatches before its error resolves the future — an injected
+    #: one-shot fault replays cleanly, a deterministic dispatch bug
+    #: cannot requeue forever
+    MAX_REPLAYS = 2
+
     def __init__(self, engine: DecodeEngine, *,
                  default_max_tokens: int = 64):
         self.engine = engine
         self.default_max_tokens = int(default_max_tokens)
         self._cv = threading.Condition()
         self._pending: List[DecodeRequest] = []
+        #: requests the worker has popped from ``_pending`` but not yet
+        #: placed (``engine.start`` runs OUTSIDE the lock — prefill is
+        #: milliseconds): tracked so ``depth()`` never undercounts
+        #: mid-admit requests, or the router's shed bound would admit
+        #: over capacity through the pop-to-place window
+        self._admitting: List[DecodeRequest] = []
         self._placed: Dict[Tuple[int, int], DecodeRequest] = {}
         self._open = True
+        #: health surface the router's monitor polls (plain reads of
+        #: worker-written fields — a torn read costs one poll):
+        #: consecutive failed dispatches, and when the worker last
+        #: admitted or advanced anything
+        self.dispatch_error_streak = 0
+        self._last_progress = time.perf_counter()
         self._thread = threading.Thread(
             target=self._loop, name="dl4j-decode-batcher", daemon=True)
         self._thread.start()
@@ -1738,26 +1940,53 @@ class ContinuousBatcher:
     # -- client side -------------------------------------------------------
     def submit(self, prompt, max_tokens: Optional[int] = None,
                temperature: float = 0.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> DecodeRequest:
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> DecodeRequest:
         """Enqueue one prompt [T_p] (ints); returns its streaming
         handle.  Prompt-too-long raises synchronously (typed ValueError
-        from the bucket ladder)."""
+        from the bucket ladder).  ``deadline_ms`` bounds the request
+        end-to-end (queue wait included): past it the slot frees, the
+        pages reclaim, and the future resolves with the typed
+        :class:`DeadlineExceeded`."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0: {deadline_ms}")
         max_tokens = int(max_tokens or self.default_max_tokens)
         self.engine.pick_bucket(prompt.size + max_tokens)  # sync validate
         self.engine.check_capacity(prompt.size)  # typed paged oversize
         req = DecodeRequest(prompt, max_tokens, float(temperature),
-                            int(seed), eos_id)
+                            int(seed), eos_id, deadline_ms=deadline_ms)
         with self._cv:
             if not self._open:
-                raise RuntimeError("ContinuousBatcher is closed")
+                raise BatcherClosed("ContinuousBatcher is closed")
+            if not self._pending and not self._placed:
+                # restart the stall clock on an idle->busy edge: the
+                # monitor's progress_age must measure "has work and
+                # isn't moving", not the idle stretch before this
+                # request arrived
+                self._last_progress = time.perf_counter()
             self._pending.append(req)
             decode_metrics.note_request(prompt.size)
             decode_metrics.note_queue_depth(len(self._pending))
             self._cv.notify()
         return req
+
+    def resubmit(self, req: DecodeRequest) -> None:
+        """Adopt an already-journaled request (the router's replay
+        path): no re-validation — the original submit validated the
+        geometry against an identically-configured engine (the factory
+        contract).  The request's emitted-so-far tokens fold into its
+        re-prefill at admission."""
+        with self._cv:
+            if not self._open:
+                raise BatcherClosed("ContinuousBatcher is closed")
+            if not self._pending and not self._placed:
+                self._last_progress = time.perf_counter()  # stall clock
+            self._pending.append(req)
+            decode_metrics.note_queue_depth(len(self._pending))
+            self._cv.notify()
 
     def generate(self, prompt, timeout: Optional[float] = 120.0,
                  **kw) -> np.ndarray:
@@ -1765,10 +1994,51 @@ class ContinuousBatcher:
         return self.submit(prompt, **kw).result(timeout)
 
     def depth(self) -> int:
-        """Pending + in-flight request count — the router's least-depth
-        dispatch and load-shed signal."""
+        """Pending + mid-admit + in-flight request count — the router's
+        least-depth dispatch and load-shed signal.  Mid-admit requests
+        (popped, prefilling, not yet placed) COUNT: they occupy a slot
+        the moment ``engine.start`` returns, and omitting them let a
+        racing submit slip past the shed bound."""
         with self._cv:
-            return len(self._pending) + len(self._placed)
+            return (len(self._pending) + len(self._admitting)
+                    + len(self._placed))
+
+    # -- health surface (router monitor) -----------------------------------
+    def worker_alive(self) -> bool:
+        """Is the decode worker thread running?  False means every
+        accepted request is stranded — the replica must be replaced."""
+        return self._thread.is_alive()
+
+    def progress_age(self) -> float:
+        """Seconds since the worker last admitted or advanced anything.
+        Meaningful as a STALL signal only while ``depth() > 0`` (an
+        idle worker legitimately parks on its condition)."""
+        return time.perf_counter() - self._last_progress
+
+    def evacuate(self) -> List[DecodeRequest]:
+        """Stop intake and hand back every unfinished request — queued
+        AND mid-decode — for deterministic re-dispatch on a healthy
+        replica (the router's health-replacement path).  Each request
+        is DETACHED first: a wedged worker waking up later pushes into
+        a dead handle, never into the adopted stream.  The engine's
+        device state is deliberately untouched — the worker may be dead
+        or stalled mid-dispatch, and the replica is being discarded
+        wholesale; releasing its slots from this (foreign) thread would
+        race the engine's single-driver contract."""
+        with self._cv:
+            self._open = False
+            reqs = (list(self._pending) + list(self._admitting)
+                    + list(self._placed.values()))
+            self._pending.clear()
+            self._admitting.clear()
+            self._placed.clear()
+            self._cv.notify_all()
+        out = []
+        for r in reqs:
+            if not r.done():
+                r._detach()
+                out.append(r)
+        return out
 
     # -- worker side -------------------------------------------------------
     def _admit(self) -> int:
@@ -1779,21 +2049,39 @@ class ContinuousBatcher:
             with self._cv:
                 req = None
                 for i, r in enumerate(self._pending):
+                    # a REPLAYED request re-prefills prompt + emitted
+                    # (len(r._tokens) is worker-written only — this IS
+                    # the worker); its bucket is unchanged because
+                    # emitted tokens move from budget to prompt 1:1
                     bucket = self.engine.pick_bucket(
                         r.prompt.size + r.max_tokens)
-                    if self.engine.can_admit(bucket, r.prompt.size):
+                    if self.engine.can_admit(
+                            bucket, r.prompt.size + len(r._tokens)):
                         req = self._pending.pop(i)
+                        self._admitting.append(req)
                         break
                 if req is None:
                     decode_metrics.note_queue_depth(len(self._pending))
                     return admitted
             joined = self.engine.n_active() > 0
+            emitted = req._snapshot_tokens()
+            eff_prompt = (np.concatenate([req.prompt, emitted])
+                          if emitted.size else req.prompt)
             try:
+                # replay is bit-exact because sampling keys fold (seed,
+                # POSITION): the token at position p is identical
+                # whether p was reached by decode here or by prefilling
+                # the journaled stream — prefix-cache hits make the
+                # re-prefill cheap
                 bucket, slot, first = self.engine.start(
-                    req.prompt, max_tokens=req.max_tokens,
+                    eff_prompt,
+                    max_tokens=req.max_tokens - emitted.size,
                     temperature=req.temperature, seed=req.seed,
                     owner=req)
             except Exception as e:      # resolve, never wedge the client
+                with self._cv:
+                    if req in self._admitting:
+                        self._admitting.remove(req)
                 req._finish(e)
                 continue
             if joined:
@@ -1801,13 +2089,17 @@ class ContinuousBatcher:
             tr = telemetry.get_tracer()
             if tr is not None:
                 tr.event("decode.join", bucket=bucket, slot=slot,
-                         prompt_tokens=int(req.prompt.size),
-                         mid_flight=joined)
+                         prompt_tokens=int(eff_prompt.size),
+                         mid_flight=joined, replayed=bool(emitted.size))
             admitted += 1
             with self._cv:
+                self._last_progress = time.perf_counter()
+                if req in self._admitting:   # evacuate() may have
+                    self._admitting.remove(req)  # adopted it mid-start
                 self._placed[(bucket, slot)] = req
             req._push(first)
-            self._maybe_finish(bucket, slot, req, first, n_out=1)
+            self._maybe_finish(bucket, slot, req, first,
+                               n_out=len(req._tokens))
 
     def _maybe_finish(self, bucket: int, slot: int, req: DecodeRequest,
                       tok: int, n_out: int) -> bool:
@@ -1827,7 +2119,7 @@ class ContinuousBatcher:
         return False
 
     def _advance_all(self) -> None:
-        spec = self.engine.draft is not None
+        spec = self.engine.draft is not None and self.engine.spec_enabled
         for bucket in self.engine.active_buckets():
             t0 = time.perf_counter()
             try:
@@ -1848,22 +2140,41 @@ class ContinuousBatcher:
                     r._finish(e)
                 continue
             except Exception as e:
-                # a failed dispatch poisons this bucket's in-flight
-                # requests (state was donated); resolve them all rather
-                # than wedge their clients, and free the slots
+                # a failed dispatch poisons in-flight device state (it
+                # was donated): a PINNED bucket's slots die alone, but
+                # a PAGED failure drops the shared pool — EVERY paged
+                # bucket's KV is gone, not just this one's.  Free the
+                # affected slots (the page reclaim is host-side
+                # bookkeeping and stays valid) and REPLAY the requests
+                # instead of dooming them: re-admitted as (prompt +
+                # emitted), each continues bit-identically.  Past the
+                # replay budget the error resolves the future — a
+                # deterministic dispatch bug must not requeue forever.
+                self.dispatch_error_streak += 1
                 with self._cv:
-                    doomed = [(k, r) for k, r in self._placed.items()
-                              if k[0] == bucket]
-                for (bk, slot), r in doomed:
+                    affected = [(k, r) for k, r in self._placed.items()
+                                if self.engine.paged or k[0] == bucket]
+                    for k, _ in affected:
+                        self._placed.pop(k, None)
+                replay = []
+                for (bk, slot), r in affected:
                     self.engine.release(bk, slot)
+                    if r._replays >= self.MAX_REPLAYS:
+                        r._finish(e)
+                    else:
+                        r._replays += 1
+                        replay.append(r)
+                        decode_metrics.note_request_replayed()
+                if replay:
                     with self._cv:
-                        self._placed.pop((bk, slot), None)
-                    r._finish(e)
+                        self._pending[:0] = replay
                 continue
             decode_metrics.note_token_ms(
                 (time.perf_counter() - t0) * 1e3)
+            self.dispatch_error_streak = 0
             ran = self.engine.last_ran(bucket)
             with self._cv:
+                self._last_progress = time.perf_counter()
                 owned = [(k, r) for k, r in self._placed.items()
                          if k[0] == bucket]
             for (bk, slot), r in owned:
@@ -1882,6 +2193,34 @@ class ContinuousBatcher:
                     self._maybe_finish(bk, slot, r, tok,
                                        n_out=len(r._tokens))
 
+    def _expire(self) -> None:
+        """Free every deadline-expired request (worker thread): queued
+        ones simply leave the queue; placed ones release their slot —
+        reclaiming their KV pages — so an expired request never
+        occupies capacity a live one could use.  Each resolves with
+        the typed :class:`DeadlineExceeded`."""
+        now = time.perf_counter()
+        with self._cv:
+            exp_q = [r for r in self._pending if r._expired(now)]
+            for r in exp_q:
+                self._pending.remove(r)
+            exp_s = [(k, r) for k, r in self._placed.items()
+                     if r._expired(now)]
+            for k, _ in exp_s:
+                self._placed.pop(k, None)
+        for (bucket, slot), _ in exp_s:
+            self.engine.release(bucket, slot)
+        for r in exp_q + [r for _, r in exp_s]:
+            decode_metrics.note_deadline_expiration()
+            r._finish(DeadlineExceeded(
+                r.deadline_ms, (now - r._t_submit) * 1e3,
+                len(r._tokens)))
+            tr = telemetry.get_tracer()
+            if tr is not None:
+                tr.event("decode.deadline_exceeded",
+                         deadline_ms=r.deadline_ms,
+                         tokens=len(r._tokens))
+
     def _loop(self) -> None:
         while True:
             with self._cv:
@@ -1891,8 +2230,17 @@ class ContinuousBatcher:
                 if not self._open and not self._pending \
                         and not self._placed:
                     return
-            self._admit()
+            self._expire()
+            admitted = self._admit()
             self._advance_all()
+            with self._cv:
+                if self._open and not admitted and not self._placed \
+                        and self._pending:
+                    # capacity-stalled: nothing is placed to advance
+                    # and nothing pending fits — a timed wait instead
+                    # of a hot spin (submit/close notifies early; the
+                    # timeout keeps deadline expiry ticking)
+                    self._cv.wait(0.005)
 
     # -- lifecycle ---------------------------------------------------------
     def close(self, timeout: float = 120.0) -> None:

@@ -57,15 +57,76 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from deeplearning4j_tpu.runtime import telemetry
 from deeplearning4j_tpu.runtime.metrics import decode_metrics
-from deeplearning4j_tpu.serving.decode import (ContinuousBatcher,
-                                               DecodeEngine, DecodeRequest)
+from deeplearning4j_tpu.serving.decode import (BatcherClosed,
+                                               ContinuousBatcher,
+                                               DecodeEngine, DecodeRequest,
+                                               _ReplayRequest)
+
+
+class RouterClosed(RuntimeError):
+    """Typed rejection for a submit racing router ``close()``: the
+    closed flag flipped before the request could be routed.  Raised
+    synchronously — a request is either accepted by a replica (and
+    drains to completion) or rejected with this; never a hang."""
+
+
+class SwapFailed(TimeoutError):
+    """Typed ``swap_weights`` drain failure: a replica did not reach
+    depth zero within the timeout.  Carries the per-replica drain
+    states (depth, worker liveness, draining flag) captured at failure
+    time, so operators can tell a WEDGED drain (depth pinned, worker
+    dead or stalled) from a merely slow one.  Subclasses
+    ``TimeoutError`` so pre-existing handlers keep working.  The fleet
+    is left serving: already-swapped replicas keep the new weights,
+    the rest the old."""
+
+    def __init__(self, timeout: float,
+                 drain_states: Dict[int, Dict[str, Any]],
+                 swapped: int):
+        super().__init__(
+            f"weight swap failed: a replica did not drain within "
+            f"{timeout}s ({swapped} replica(s) swapped); per-replica "
+            f"drain states: {drain_states}")
+        self.timeout = timeout
+        self.drain_states = drain_states
+        self.swapped = swapped
+
+
+class ReplicaHealth:
+    """Thresholds for the router's replica health monitor — all three
+    detection signals are HOST-side reads (no device sync on the
+    monitor thread; machine-checked by jaxlint):
+
+    - ``worker_alive()`` False: the decode worker thread died — every
+      accepted request is stranded;
+    - ``dispatch_error_streak >= max_error_streak``: consecutive
+      failed device dispatches without a successful advance;
+    - ``progress_age() > stall_after_s`` while ``depth() > 0``: the
+      worker has neither admitted nor advanced anything despite having
+      work — a wedged dispatch or a livelocked loop."""
+
+    def __init__(self, poll_interval_s: float = 0.25, *,
+                 max_error_streak: int = 3,
+                 stall_after_s: float = 5.0):
+        if poll_interval_s <= 0:
+            raise ValueError(
+                f"poll_interval_s must be > 0: {poll_interval_s}")
+        if max_error_streak < 1:
+            raise ValueError(
+                f"max_error_streak must be >= 1: {max_error_streak}")
+        if stall_after_s <= 0:
+            raise ValueError(
+                f"stall_after_s must be > 0: {stall_after_s}")
+        self.poll_interval_s = float(poll_interval_s)
+        self.max_error_streak = int(max_error_streak)
+        self.stall_after_s = float(stall_after_s)
 
 
 class OverloadedError(RuntimeError):
@@ -329,14 +390,21 @@ class AutoscalingRouter(Router):
 
     def __init__(self, factory: Callable[[], ContinuousBatcher],
                  policy: Optional[AutoscalePolicy] = None, *,
-                 max_queue_depth: int = 64):
+                 max_queue_depth: int = 64,
+                 health: Optional[ReplicaHealth] = None):
         self.factory = factory
         self.policy = policy or AutoscalePolicy()
+        self.health = health
         self._lock = threading.RLock()
         self._drains: List[threading.Thread] = []
         self._closed = False
         self._spawning = False
         self._swapping = False
+        # graceful-brownout ladder level (0 = normal, 1 = speculative
+        # decoding off, 2 = + prefix harvesting bypassed): escalated
+        # under pressure BEFORE shedding, de-escalated by tick() when
+        # the fleet cools; every transition is booked and reversible
+        self._brownout = 0
         # replicas temporarily excluded from routing (identity set):
         # swap_weights drains one replica at a time through here while
         # the rest keep serving — zero dropped requests
@@ -344,6 +412,13 @@ class AutoscalingRouter(Router):
         super().__init__([factory()
                           for _ in range(self.policy.min_replicas)],
                          max_queue_depth=max_queue_depth)
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        if health is not None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="dl4j-health-monitor",
+                daemon=True)
+            self._monitor.start()
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -387,6 +462,12 @@ class AutoscalingRouter(Router):
                 self._scale_up_async()
             elif action == "down":
                 self._scale_down()
+            if self._brownout and sum(depths) / len(depths) \
+                    <= max(1.0, self.max_queue_depth / 4):
+                # the fleet cooled well under the pressure bound: walk
+                # the brownout ladder back one rung per (rate-limited)
+                # observation — reversible, and each step is booked
+                self._set_brownout(self._brownout - 1, "recovered")
         return action
 
     def _scale_up_async(self) -> None:
@@ -420,7 +501,8 @@ class AutoscalingRouter(Router):
                         or len(self.batchers) >= self.policy.max_replicas:
                     doomed = b
                 else:
-                    self.batchers.append(b)
+                    self.batchers.append(b)  # jaxlint: disable=unlocked-shared-mutation — inside spawn's `with self._lock` above; the resolver does not model nested-def lock regions
+                    self._apply_brownout(b)
                     decode_metrics.note_replicas(added=1)
                     tr = telemetry.get_tracer()
                     if tr is not None:
@@ -432,35 +514,155 @@ class AutoscalingRouter(Router):
 
         t = threading.Thread(target=spawn, name="dl4j-replica-spawn",
                              daemon=True)
-        self._drains = [d for d in self._drains if d.is_alive()]
-        self._drains.append(t)      # close() joins spawns like drains
+        with self._lock:            # re-entrant from tick's hold
+            self._drains = [d for d in self._drains if d.is_alive()]
+            self._drains.append(t)  # close() joins spawns like drains
         t.start()
 
     def _scale_up(self, reason: str) -> None:
-        # under self._lock.  The factory's engine construction +
-        # warmup() hit the shared compile cache: no new XLA programs.
-        self.batchers.append(self.factory())
+        # re-entrant under the caller's self._lock hold (RLock): the
+        # factory's engine construction + warmup() hit the shared
+        # compile cache — no new XLA programs.
+        with self._lock:
+            self.batchers.append(self.factory())
+            self._apply_brownout(self.batchers[-1])
         decode_metrics.note_replicas(added=1)
         tr = telemetry.get_tracer()
         if tr is not None:
-            tr.event("decode.scale_up", replicas=len(self.batchers),
+            tr.event("decode.scale_up", replicas=self.n_replicas(),
                      reason=reason)
 
     def _scale_down(self) -> None:
-        # under self._lock; the drained replica finishes its accepted
-        # requests on a background thread
-        b = self.batchers.pop()
+        # re-entrant under the caller's self._lock hold (RLock); the
+        # drained replica finishes its accepted requests on a
+        # background thread
+        with self._lock:
+            b = self.batchers.pop()
         decode_metrics.note_replicas(removed=1)
         tr = telemetry.get_tracer()
         if tr is not None:
-            tr.event("decode.scale_down", replicas=len(self.batchers))
+            tr.event("decode.scale_down", replicas=self.n_replicas())
         t = threading.Thread(target=b.close, name="dl4j-replica-drain",
                              daemon=True)
         t.start()
         # prune finished drains so a long-lived oscillating fleet
         # doesn't accumulate dead Thread objects without bound
-        self._drains = [d for d in self._drains if d.is_alive()]
-        self._drains.append(t)
+        with self._lock:
+            self._drains = [d for d in self._drains if d.is_alive()]
+            self._drains.append(t)
+
+    # -- replica health ----------------------------------------------------
+    def _monitor_loop(self) -> None:
+        """Replica health watchdog: poll HOST-side liveness signals and
+        replace whatever fails diagnosis.  This thread must never touch
+        device state — every signal it reads (thread liveness, error
+        streaks, progress timestamps, queue depths) is a host field,
+        and every wait is TIMED (machine-checked by jaxlint's
+        blocking-in-health-monitor rule): a monitor blocked on a device
+        sync or an unbounded join could itself be wedged by the very
+        failure it exists to detect."""
+        h = self.health
+        while not self._monitor_stop.wait(h.poll_interval_s):
+            with self._lock:
+                if self._closed:
+                    return
+                replicas = [b for b in self.batchers
+                            if b not in self._draining]
+            for b in replicas:
+                reason = self._diagnose(b, h)
+                if reason is not None:
+                    self.replace_replica(b, reason=reason)
+
+    @staticmethod
+    def _diagnose(b: ContinuousBatcher,
+                  h: ReplicaHealth) -> Optional[str]:
+        """One replica's health verdict — None (healthy) or the
+        detection signal that tripped."""
+        if not b.worker_alive():
+            return "worker-dead"
+        if b.dispatch_error_streak >= h.max_error_streak:
+            return "error-streak"
+        if b.depth() > 0 and b.progress_age() > h.stall_after_s:
+            return "stalled"
+        return None
+
+    def replace_replica(self, batcher: ContinuousBatcher, *,
+                        reason: str = "unhealthy") -> bool:
+        """Retire an unhealthy replica and spawn its factory
+        replacement — ZERO new compiles (the clone's warmup hits the
+        shared compile cache, the autoscaling invariant).  Every
+        unfinished request on the retired replica is evacuated and
+        deterministically RE-DISPATCHED on the replacement: journaled
+        as (prompt, seed, temperature, tokens emitted), each replays
+        bit-identically from its last streamed token — replica death
+        loses no request.  Returns False when the replica is already
+        gone (or the router closed); True once the replacement serves.
+
+        The spawn runs under the replica lock like the emergency
+        scale-up: the fleet is degraded, and routing submits into a
+        known-unhealthy replica while the replacement builds would be
+        worse than making them wait."""
+        with self._lock:
+            if self._closed or batcher not in self.batchers:
+                return False
+            self.batchers.remove(batcher)
+            decode_metrics.note_replicas(removed=1)
+            self._scale_up(f"replace:{reason}")
+            replacement = self.batchers[-1]
+        decode_metrics.note_replica_replaced()
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            tr.event("decode.replica_replaced", reason=reason,
+                     replicas=self.n_replicas())
+        replayed = 0
+        for r in batcher.evacuate():
+            shadow = _ReplayRequest(r)
+            decode_metrics.note_request_replayed()
+            replayed += 1
+            try:
+                replacement.resubmit(shadow)
+            except BatcherClosed:
+                # the router closed mid-replacement: resolve the
+                # client's handle rather than strand it
+                r._force_finish(RouterClosed(
+                    "router closed during replica replacement"))
+        if tr is not None and replayed:
+            tr.event("decode.requests_replayed", count=replayed,
+                     reason=reason)
+        # retire the carcass off-thread: close() joins a possibly
+        # wedged worker — bounded, best-effort (the batcher is already
+        # evacuated and out of routing; worst case its daemon thread
+        # dies with the process)
+        t = threading.Thread(target=lambda: batcher.close(timeout=5.0),
+                             name="dl4j-replica-retire", daemon=True)
+        with self._lock:
+            self._drains = [d for d in self._drains if d.is_alive()]
+            self._drains.append(t)
+        t.start()
+        return True
+
+    # -- graceful brownout -------------------------------------------------
+    def brownout_level(self) -> int:
+        with self._lock:
+            return self._brownout
+
+    def _apply_brownout(self, b: ContinuousBatcher) -> None:
+        # under self._lock; benign-race bools the worker reads per pass
+        b.engine.spec_enabled = self._brownout < 1
+        b.engine.harvest_enabled = self._brownout < 2
+
+    def _set_brownout(self, level: int, reason: str) -> None:
+        # under self._lock
+        level = max(0, min(2, level))
+        if level == self._brownout:
+            return
+        self._brownout = level
+        for b in self.batchers:
+            self._apply_brownout(b)
+        decode_metrics.note_brownout(level)
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            tr.event("decode.brownout", level=level, reason=reason)
 
     # -- hot weight swap ---------------------------------------------------
     def swap_weights(self, params: Any, draft_params: Any = None, *,
@@ -486,13 +688,16 @@ class AutoscalingRouter(Router):
         Shapes are unchanged, so every rebound engine reuses its warmed
         executables — ``swap_compile_delta == 0`` is asserted by the
         bench drill.  Returns the number of replicas swapped.  Raises
-        ``TimeoutError`` if a replica fails to drain in ``timeout``
-        seconds (the fleet is left serving: swapped replicas keep the
-        new weights, unswapped ones the old)."""
+        the typed :class:`SwapFailed` (a ``TimeoutError`` subclass,
+        carrying per-replica drain states) if a replica fails to drain
+        in ``timeout`` seconds — e.g. a fleet whose replicas are all
+        unhealthy or wedged mid-drain — with the fleet left serving:
+        swapped replicas keep the new weights, unswapped ones the
+        old."""
         deadline = time.monotonic() + float(timeout)
         with self._lock:
             if self._closed:
-                raise RuntimeError("AutoscalingRouter is closed")
+                raise RouterClosed("AutoscalingRouter is closed")
             if self._swapping:
                 raise RuntimeError("a weight swap is already in progress")
             self._swapping = True
@@ -523,10 +728,9 @@ class AutoscalingRouter(Router):
                                 # released its last slot — retry
                                 pass
                         if time.monotonic() > deadline:
-                            raise TimeoutError(
-                                f"replica did not drain within {timeout}s "
-                                f"(depth {target.depth()}); "
-                                f"{len(swapped)} replica(s) swapped")
+                            raise SwapFailed(timeout,
+                                             self._drain_states(),
+                                             len(swapped))
                         time.sleep(0.005)
                     target.engine.current_params()
                     swapped.add(id(target))
@@ -563,6 +767,18 @@ class AutoscalingRouter(Router):
                 self._swapping = False
                 self._draining.clear()
 
+    def _drain_states(self) -> Dict[int, Dict[str, Any]]:
+        """Per-replica drain diagnostics for :class:`SwapFailed` —
+        depth, worker liveness, and whether the replica is currently
+        excluded from routing."""
+        with self._lock:
+            batchers = list(self.batchers)
+            draining = set(self._draining)
+        return {i: {"depth": b.depth(),
+                    "worker_alive": b.worker_alive(),
+                    "draining": b in draining}
+                for i, b in enumerate(batchers)}
+
     # -- dispatch ----------------------------------------------------------
     def submit(self, prompt, **kw) -> DecodeRequest:
         self.tick()
@@ -572,7 +788,7 @@ class AutoscalingRouter(Router):
                     # closing must also stop SCALING: without this a
                     # racing submit could spawn a fresh replica close()
                     # never sees, leaking its worker thread
-                    raise RuntimeError("AutoscalingRouter is closed")
+                    raise RouterClosed("AutoscalingRouter is closed")
                 # replicas mid-swap-drain are excluded from routing;
                 # the rest of the fleet absorbs their share (fall back
                 # to the full list defensively if that empties it)
@@ -587,6 +803,18 @@ class AutoscalingRouter(Router):
                         self._scale_up("pressure")
                         live.append(self.batchers[-1])
                         i = len(live) - 1
+                    elif self._brownout < 2:
+                        # graceful brownout BEFORE shedding: at the
+                        # replica ceiling and over the depth bound,
+                        # first trade throughput optimizations for
+                        # headroom — speculative decoding off (draft
+                        # dispatches freed), then prefix harvesting
+                        # bypassed (reads + page refs freed) — and
+                        # admit the request; only a fleet already at
+                        # level 2 sheds.  tick() walks the ladder back
+                        # down when the fleet cools.
+                        self._set_brownout(self._brownout + 1,
+                                           "pressure")
                     else:
                         decode_metrics.note_shed(by_policy=True)
                         tr = telemetry.get_tracer()
@@ -613,10 +841,13 @@ class AutoscalingRouter(Router):
 
     # -- lifecycle ---------------------------------------------------------
     def close(self, timeout: float = 120.0) -> None:
-        with self._lock:
+        self._monitor_stop.set()         # health monitor exits first —
+        with self._lock:                 # no replacement races close
             self._closed = True          # no more submits OR scale-ups
             batchers = list(self.batchers)
             drains = list(self._drains)
+        if self._monitor is not None:
+            self._monitor.join(timeout)
         for b in batchers:
             b.close(timeout)
         for t in drains:

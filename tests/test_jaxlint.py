@@ -2233,3 +2233,121 @@ def test_partition_spec_param_shadows_module_binding():
     '''
     assert only(src, "unknown-axis-in-partition-spec",
                 path=MODELS_PATH) == [8]
+
+
+# ---------------------------------------------------------------------------
+# PR 17: blocking-in-health-monitor (serving watchdog contract)
+# ---------------------------------------------------------------------------
+
+def test_health_monitor_flags_untimed_blocking_and_device_syncs():
+    """The watchdog contract: a monitor thread blocking unboundedly
+    (or fetching device values) can be wedged by the very failure it
+    exists to detect.  Attribution follows the thread NAME and closes
+    over the monitor's same-class self-call graph (the replacement
+    path runs on the monitor thread too)."""
+    src = '''
+    import threading
+    import numpy as np
+
+    class Router:
+        def __init__(self):
+            self._stop = threading.Event()
+            self._monitor = threading.Thread(
+                target=self._watch, name="dl4j-health-monitor")
+
+        def _watch(self):
+            while not self._stop.wait(0.25):
+                self._replace()
+
+        def _replace(self):
+            self._cv.wait()
+            self._drain.join()
+            depth = self._depths.item()
+            snap = np.asarray(self._depths)
+    '''
+    assert only(src, "blocking-in-health-monitor",
+                path=SERVING_PATH) == [16, 17, 18, 19]
+
+
+def test_health_monitor_timed_waits_and_host_reads_stay_clean():
+    """The REAL monitor shape — timed Event.wait poll, host-side field
+    reads, bounded joins — must not fire (the committed baseline stays
+    empty)."""
+    src = '''
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._stop = threading.Event()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="dl4j-health-monitor")
+
+        def _monitor_loop(self):
+            while not self._stop.wait(0.25):
+                for b in list(self.batchers):
+                    if not b.worker_alive():
+                        self._replace(b)
+
+        def _replace(self, b):
+            b.close(timeout=5.0)
+            self._drain.join(5.0)
+    '''
+    assert only(src, "blocking-in-health-monitor",
+                path=SERVING_PATH) == []
+
+
+def test_health_monitor_attribution_requires_monitor_name():
+    """A worker thread that is NOT a health monitor is out of scope —
+    the decode worker's untimed cv.wait is its designed park (other
+    rules own worker discipline)."""
+    src = '''
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._thread = threading.Thread(
+                target=self._loop, name="dl4j-decode-batcher")
+
+        def _loop(self):
+            self._cv.wait()
+    '''
+    assert only(src, "blocking-in-health-monitor",
+                path=SERVING_PATH) == []
+
+
+def test_health_monitor_scope_and_suppression():
+    src = '''
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="m")
+
+        def _monitor_loop(self):
+            self._cv.wait()
+    '''
+    # method name carries the "monitor" attribution even when the
+    # thread name does not
+    assert only(src, "blocking-in-health-monitor",
+                path=SERVING_PATH) == [10]
+    # outside serving/: the rule does not apply
+    assert only(src, "blocking-in-health-monitor",
+                path="deeplearning4j_tpu/nn/fixture.py") == []
+    sup = '''
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="m")
+
+        def _monitor_loop(self):
+            self._cv.wait()  # jaxlint: disable=blocking-in-health-monitor — fixture
+    '''
+    assert only(sup, "blocking-in-health-monitor",
+                path=SERVING_PATH) == []
+
+
+def test_health_monitor_rule_registered_in_concurrency_family():
+    assert REGISTRY["blocking-in-health-monitor"].family == "concurrency"

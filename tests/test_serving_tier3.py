@@ -31,14 +31,21 @@ import pytest
 
 from deeplearning4j_tpu.models import gpt
 from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.parallel.chaos import ServingChaos
+from deeplearning4j_tpu.runtime import telemetry
 from deeplearning4j_tpu.runtime.metrics import decode_metrics
 from deeplearning4j_tpu.serving.decode import (KV_PAGE_TOKENS,
+                                               BatcherClosed,
                                                ContinuousBatcher,
+                                               DeadlineExceeded,
                                                DecodeEngine,
                                                KVPagesExhausted,
                                                PageAllocator, PrefixCache)
 from deeplearning4j_tpu.serving.router import (AutoscalePolicy,
-                                               AutoscalingRouter)
+                                               AutoscalingRouter,
+                                               OverloadedError,
+                                               ReplicaHealth, RouterClosed,
+                                               SwapFailed)
 
 CFG = TransformerConfig(vocab_size=64, max_len=64, hidden=32, n_layers=2,
                         n_heads=2, ffn_dim=64, dropout=0.0,
@@ -411,3 +418,276 @@ def test_swap_single_replica_spawns_temp(params):
     out = list(router.generate(prompt, timeout=60.0, max_tokens=6))
     router.close()
     assert out == _solo(p_new, prompt, 6)
+
+
+# -- PR 17: serving fleet fault tolerance -----------------------------------
+# Deadlines, health-checked replica replacement, deterministic replay,
+# the brownout ladder, and the page-accounting invariants of every
+# recovery path.  Faults are injected with parallel.chaos.ServingChaos,
+# which arms on the host and fires at a step boundary on the victim's
+# own worker thread (the allocator's single-driver contract).
+
+def _ft_batcher(params, *, n_slots=2, default_max_tokens=6):
+    eng = DecodeEngine(CFG, params, n_slots=n_slots, buckets=(32,),
+                       prefill_chunk=8, paged=True)
+    eng.warmup()
+    return ContinuousBatcher(eng, default_max_tokens=default_max_tokens)
+
+
+def _audit_zero_pages(eng):
+    """Post-drain leak audit: evict the pool-resident prefix registry
+    (cache refs, not occupancy) — then every page must be free and
+    every refcount accounted for."""
+    eng.drop_residents()
+    assert eng._alloc.in_use() == 0
+    assert eng.pages_unaccounted() == 0
+
+
+def test_deadline_ms_validation(params):
+    b = _ft_batcher(params)
+    try:
+        with pytest.raises(ValueError):
+            b.submit(np.arange(1, 5, dtype=np.int32), deadline_ms=0)
+        with pytest.raises(ValueError):
+            b.submit(np.arange(1, 5, dtype=np.int32), deadline_ms=-10)
+    finally:
+        b.close()
+
+
+def test_queued_deadline_expires_typed_and_reclaims(params):
+    """A request expiring while QUEUED (page pool held hostage) fails
+    with the typed DeadlineExceeded, frees no-longer-needed capacity,
+    and leaves the batcher fully serviceable."""
+    b = _ft_batcher(params)
+    eng = b.engine
+    prompt = np.arange(1, 6, dtype=np.int32)
+    before = decode_metrics.snapshot()["deadline_expirations"]
+    chaos = ServingChaos(b)
+    try:
+        chaos.exhaust_pages()
+        probe = b.submit(prompt, max_tokens=4, deadline_ms=80)
+        time.sleep(0.3)                  # expire while inadmissible
+        chaos.release_pages()
+        with pytest.raises(DeadlineExceeded) as ei:
+            probe.result(30)
+        err = ei.value
+        assert err.deadline_ms == 80
+        assert err.elapsed_ms >= 80
+        assert err.tokens_emitted == 0   # never admitted
+        after = decode_metrics.snapshot()["deadline_expirations"]
+        assert after - before >= 1
+        # the batcher is not poisoned: a fresh request still completes
+        out = list(b.submit(prompt, max_tokens=4).result(60))
+        assert out == _solo(params, prompt, 4)
+    finally:
+        chaos.restore()
+        b.close()
+    _audit_zero_pages(eng)
+
+
+def test_placed_deadline_expires_mid_decode(params):
+    """A PLACED request whose budget elapses mid-decode is cut off with
+    the typed error (partial stream length attached) and its slot and
+    pages are reclaimed for live traffic."""
+    b = _ft_batcher(params)
+    eng = b.engine
+    prompt = np.arange(1, 6, dtype=np.int32)
+    chaos = ServingChaos(b)
+    try:
+        chaos.stall_dispatch(0.4)        # hold the worker past the budget
+        r = b.submit(prompt, max_tokens=8, deadline_ms=100)
+        with pytest.raises(DeadlineExceeded) as ei:
+            r.result(30)
+        assert ei.value.tokens_emitted < 8
+        out = list(b.submit(prompt, max_tokens=4).result(60))
+        assert out == _solo(params, prompt, 4)
+    finally:
+        chaos.restore()
+        b.close()
+    _audit_zero_pages(eng)
+
+
+def test_failed_dispatch_returns_pages_and_replays(params):
+    """Satellite regression: a dispatch failure mid-flight must return
+    the affected slots' KV pages to the pool and replay the requests
+    in place — bit-exact, no leak, no stranded client."""
+    b = _ft_batcher(params)
+    eng = b.engine
+    prompt = np.arange(2, 9, dtype=np.int32)
+    expect = np.asarray(
+        b.submit(prompt, max_tokens=6, temperature=0.8, seed=11).result(60))
+    before = decode_metrics.snapshot()["requests_replayed"]
+    ServingChaos(b).poison_dispatch(1)
+    got = np.asarray(
+        b.submit(prompt, max_tokens=6, temperature=0.8, seed=11).result(60))
+    assert np.array_equal(got, expect)   # position-keyed sampling replays
+    assert decode_metrics.snapshot()["requests_replayed"] - before >= 1
+    assert b.worker_alive()              # poison is survivable in place
+    b.close()
+    _audit_zero_pages(eng)
+
+
+def test_killed_worker_replaced_and_replayed_bit_exact(params):
+    """A dead decode worker is detected by the health monitor, the
+    replica is replaced from the factory with ZERO new compiles, and
+    every journaled request re-dispatches bit-exactly."""
+    prompts = [np.arange(1, 6, dtype=np.int32),
+               np.arange(3, 11, dtype=np.int32),
+               np.arange(2, 7, dtype=np.int32)]
+
+    def factory():
+        return _ft_batcher(params, n_slots=3)
+
+    base = factory()
+    expect = [np.asarray(base.submit(p, max_tokens=5, temperature=0.7,
+                                     seed=40 + i).result(60))
+              for i, p in enumerate(prompts)]
+    base.close()
+
+    before = decode_metrics.snapshot()["replicas_replaced"]
+    router = AutoscalingRouter(
+        factory, AutoscalePolicy(min_replicas=1, max_replicas=2),
+        health=ReplicaHealth(poll_interval_s=0.02, max_error_streak=3,
+                             stall_after_s=5.0))
+    try:
+        telemetry.registry.mark()
+        victim = router.batchers[0]
+        ServingChaos(victim).kill_worker()
+        handles = [victim.submit(p, max_tokens=5, temperature=0.7,
+                                 seed=40 + i)
+                   for i, p in enumerate(prompts)]
+        got = [np.asarray(h.result(120)) for h in handles]
+        assert victim not in router.batchers       # replaced, not revived
+        assert all(np.array_equal(g, e) for g, e in zip(got, expect))
+        assert telemetry.registry.compile_delta_since_mark() == 0
+        assert decode_metrics.snapshot()["replicas_replaced"] - before >= 1
+    finally:
+        router.close()
+
+
+def test_brownout_ladder_escalates_before_shedding_and_recovers(params):
+    """At the replica ceiling and over the depth bound the router walks
+    the brownout ladder (spec off, then harvest bypass) BEFORE shedding,
+    books every transition, and tick() walks it back down when the
+    fleet cools — the engine flags flip both ways."""
+    def factory():
+        return _ft_batcher(params)
+
+    before = decode_metrics.snapshot()["brownout_transitions"]
+    router = AutoscalingRouter(
+        factory, AutoscalePolicy(min_replicas=1, max_replicas=1),
+        max_queue_depth=1)
+    b = router.batchers[0]
+    eng = b.engine
+    chaos = ServingChaos(b)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    try:
+        chaos.exhaust_pages()            # pin depth: nothing can admit
+        handles = [router.submit(prompt, max_tokens=4)]
+        assert router.brownout_level() == 0
+        handles.append(router.submit(prompt, max_tokens=4))
+        assert router.brownout_level() == 1
+        assert eng.spec_enabled is False          # rung 1: spec off
+        assert eng.harvest_enabled is True
+        handles.append(router.submit(prompt, max_tokens=4))
+        assert router.brownout_level() == 2
+        assert eng.harvest_enabled is False       # rung 2: + harvest off
+        with pytest.raises(OverloadedError):      # only level 2 sheds
+            router.submit(prompt, max_tokens=4)
+        chaos.release_pages()
+        for h in handles:                # admitted requests all complete
+            assert list(h.result(60)) == _solo(params, prompt, 4)
+        now = time.monotonic()
+        assert router.tick(now=now + 10.0) is not None
+        assert router.brownout_level() == 1       # one rung per tick
+        router.tick(now=now + 20.0)
+        assert router.brownout_level() == 0
+        assert eng.spec_enabled is True and eng.harvest_enabled is True
+        after = decode_metrics.snapshot()["brownout_transitions"]
+        assert after - before == 4       # 0->1->2->1->0, each booked
+    finally:
+        chaos.restore()
+        router.close()
+    _audit_zero_pages(eng)
+
+
+def test_submit_racing_close_gets_typed_error_never_hangs(params):
+    """A submit racing close() either lands (and its request completes
+    during the drain) or fails with the typed RouterClosed — never a
+    hang, never an unexplained RuntimeError."""
+    def factory():
+        return _ft_batcher(params)
+
+    router = AutoscalingRouter(
+        factory, AutoscalePolicy(min_replicas=1, max_replicas=1))
+    prompt = np.arange(1, 6, dtype=np.int32)
+    accepted, outcome = [], {}
+
+    def hammer():
+        try:
+            for _ in range(500):
+                accepted.append(router.submit(prompt, max_tokens=3))
+                time.sleep(0.002)
+            outcome["end"] = "exhausted"
+        except RouterClosed:
+            outcome["end"] = "typed"
+        except BaseException as e:       # the failure this test exists for
+            outcome["end"] = repr(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    time.sleep(0.1)
+    router.close()
+    t.join(30)
+    assert not t.is_alive()              # the race must never hang
+    assert outcome["end"] == "typed"
+    for h in accepted:                   # accepted before close: completes
+        assert list(h.result(60)) == _solo(params, prompt, 3)
+    # closed-fleet submits stay typed afterwards too
+    with pytest.raises(RouterClosed):
+        router.submit(prompt)
+    b = factory()
+    b.close()
+    with pytest.raises(BatcherClosed):
+        b.submit(prompt)
+
+
+def test_swap_failed_typed_with_drain_states_on_wedged_fleet(params):
+    """swap_weights on a fleet that cannot drain (dead worker, pinned
+    depth) raises the typed SwapFailed carrying per-replica drain
+    states, with the fleet left on the old weights."""
+    def factory():
+        return _ft_batcher(params)
+
+    p_new = gpt.init_params(jax.random.key(21), CFG)
+    router = AutoscalingRouter(            # no health monitor: the wedge
+        factory, AutoscalePolicy(min_replicas=1, max_replicas=2))
+    victim = router.batchers[0]
+    try:
+        ServingChaos(victim).kill_worker()
+        victim.submit(np.arange(1, 6, dtype=np.int32), max_tokens=8)
+        deadline = time.monotonic() + 10.0
+        while victim.worker_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not victim.worker_alive()
+        with pytest.raises(SwapFailed) as ei:
+            router.swap_weights(p_new, timeout=0.5)
+        err = ei.value
+        assert isinstance(err, TimeoutError)       # handler compatible
+        assert err.swapped == 0
+        states = err.drain_states
+        assert any(s["depth"] > 0 and not s["worker_alive"]
+                   for s in states.values())
+        assert any(s["draining"] for s in states.values())
+    finally:
+        router.close(timeout=5.0)
+
+
+def test_serving_chaos_drill(params):
+    """The full chaos drill — poison, kill, stall, exhaust — completes
+    every request bit-exactly with zero new compiles and zero leaked
+    pages.  Runs the CI gate in-process so the acceptance invariant is
+    asserted in the tier-1 suite too, not only in tools/ci.sh."""
+    from tools import serving_chaos_gate
+
+    assert serving_chaos_gate.main() == 0

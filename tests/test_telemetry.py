@@ -279,6 +279,13 @@ def test_process_registry_has_all_counter_families():
                 "draft_proposed", "draft_accepted", "draft_accept_rate",
                 "swaps_completed", "requests_during_swap"):
         assert key in snap["counters"]["decode"], key
+    # PR 17 fault-tolerance counters ALSO ride "decode" — still no new
+    # family (deadline expiry, replica replacement, deterministic
+    # replay, brownout ladder, and the pages-leaked gauge)
+    for key in ("deadline_expirations", "replicas_replaced",
+                "requests_replayed", "brownout_transitions",
+                "brownout_level", "pages_leaked"):
+        assert key in snap["counters"]["decode"], key
     assert "dispatches" in snap["counters"]["dp"]
     assert "snapshots_committed" in snap["counters"]["checkpoint"]
     assert "estimates" in snap["counters"]["mfu"]

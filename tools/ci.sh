@@ -49,6 +49,15 @@ fi
 echo "[ci] telemetry overhead gate"
 JAX_PLATFORMS=cpu python -m tools.telemetry_gate || exit 1
 
+# Serving chaos drill: under injected faults (poisoned dispatch, killed
+# decode worker, stalled replica, exhausted KV page pool) every request
+# must complete BIT-identical to an undisturbed run, replacement
+# replicas must compile zero new programs, and the page allocator must
+# end the drill with zero occupancy — the serving fault-tolerance
+# contract.  ~15 s on CPU.
+echo "[ci] serving chaos drill"
+JAX_PLATFORMS=cpu python -m tools.serving_chaos_gate || exit 1
+
 # Autotune smoke gate: a tiny kernel sweep must complete, persist a
 # well-formed winner record, and a cold (memo-dropped) consult must hit
 # the on-disk cache with zero re-sweeps and zero steady-state compiles —

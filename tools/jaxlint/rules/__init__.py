@@ -9,7 +9,9 @@ Six families:
               sharded fit hand-enforced
 - concurrency (PR 10): unlocked-shared-mutation, blocking-under-lock,
               impure-signal-handler — the thread/drain/handler contracts
-              of the PR 7 batcher and PR 8 async checkpointer
+              of the PR 7 batcher and PR 8 async checkpointer — and
+              blocking-in-health-monitor (PR 17): the serving watchdog
+              must never block unboundedly or sync device values
 - distributed-protocol (PR 15): cluster-sync-in-divergent-branch,
               uncommitted-coordinator-write — the PR 13 cluster
               barrier/commit protocols
@@ -29,6 +31,7 @@ from tools.jaxlint.rules import (  # noqa: F401
     divergent_collective,
     divisibility_guard,
     donation_across_collective,
+    health_monitor_blocking,
     host_sync,
     impure_jit,
     impure_signal_handler,

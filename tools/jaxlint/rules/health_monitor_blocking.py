@@ -1,0 +1,160 @@
+"""blocking-in-health-monitor: the watchdog must never be wedgeable.
+
+The serving fleet's health monitor (PR 17,
+``AutoscalingRouter._monitor_loop``) exists to detect replicas wedged
+by dead workers, dispatch-error streaks, and stalls.  A monitor that
+itself blocks unboundedly — an untimed ``Condition.wait()``, a
+``join()`` with no timeout, a bare ``Future.result()`` — or that
+fetches device values (``.item()``, single-arg ``np.asarray``,
+``jax.device_get``, ``block_until_ready``) can be wedged by the very
+failure it exists to detect: a dead decode worker never notifies, and
+a poisoned dispatch can leave a device value that never resolves.  The
+monitor's contract is HOST-side signals and TIMED waits only; this
+rule machine-checks it.
+
+Attribution: methods spawned as a Thread target whose thread ``name=``
+or method name mentions "monitor"/"health", closed over the method's
+same-class ``self.m()`` call graph (the monitor's replacement path —
+``replace_replica``, ``_scale_up`` — runs on the monitor thread too).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+_NP_NAMES = {"np", "numpy", "onp"}
+
+#: attribute calls that block forever without a timeout argument
+_UNTIMED_BLOCKERS = {"wait", "join", "result"}
+
+
+def _is_np_asarray(node: ast.AST) -> bool:
+    name = astutil.dotted_name(node)
+    return name is not None and "." in name \
+        and name.split(".", 1)[0] in _NP_NAMES \
+        and name.rsplit(".", 1)[-1] == "asarray"
+
+
+def _is_device_get(node: ast.AST) -> bool:
+    name = astutil.dotted_name(node)
+    return name is not None and name.rsplit(".", 1)[-1] == "device_get"
+
+
+def _self_calls(fn) -> Set[str]:
+    """Names of ``self.m(...)`` calls in ``fn``'s own body."""
+    out: Set[str] = set()
+    for node in astutil.walk_own_body(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
+def _monitor_functions(tree: ast.Module) -> List[Tuple[ast.AST, str]]:
+    """(function, attribution label) for every method running on a
+    health-monitor thread: Thread targets named like a monitor, plus
+    their same-class self-call closure."""
+    out: List[Tuple[ast.AST, str]] = []
+    for info in astutil.class_infos(tree):
+        roots: Set[str] = set()
+        for fn in info.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ctor = astutil.dotted_name(node.func)
+                if ctor is None or ctor.rsplit(".", 1)[-1] \
+                        not in ("Thread", "Timer"):
+                    continue
+                target, tname = None, ""
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+                    elif kw.arg == "name" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        tname = kw.value.value
+                m = astutil.self_attr(target) if target is not None \
+                    else None
+                if m is None:
+                    continue
+                hay = f"{tname} {m}".lower()
+                if "monitor" in hay or "health" in hay:
+                    roots.add(m)
+        seen: Set[str] = set()
+        stack = sorted(roots)
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in info.methods:
+                continue
+            seen.add(m)
+            fn = info.methods[m]
+            why = (f"the health-monitor thread of {info.node.name}"
+                   if m in roots else
+                   f"the health monitor via {info.node.name}.{m}()")
+            out.append((fn, why))
+            stack.extend(_self_calls(fn))
+    return sorted(out, key=lambda p: p[0].lineno)
+
+
+@register
+class BlockingInHealthMonitorRule(Rule):
+    name = "blocking-in-health-monitor"
+    severity = "error"
+    family = "concurrency"
+    description = ("unbounded wait/join/result or device→host fetch on "
+                   "a replica health-monitor thread — the watchdog must "
+                   "not be wedgeable by the failures it exists to "
+                   "detect (host-side signals, timed waits only)")
+
+    def applies_to(self, posix_path: str) -> bool:
+        return "serving/" in posix_path
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        for fn, why in _monitor_functions(tree):
+            for node in astutil.walk_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in _UNTIMED_BLOCKERS \
+                        and not node.args \
+                        and not any(kw.arg == "timeout"
+                                    for kw in node.keywords):
+                    yield self.finding(
+                        posix_path, node,
+                        f".{func.attr}() with no timeout on {why} — an "
+                        "unbounded block wedges the watchdog on exactly "
+                        "the failure it should be detecting; pass a "
+                        "timeout")
+                elif isinstance(func, ast.Attribute) \
+                        and func.attr == "item" \
+                        and not node.args and not node.keywords:
+                    yield self.finding(
+                        posix_path, node,
+                        f".item() on {why} — a device→host sync can "
+                        "block forever behind a poisoned dispatch; the "
+                        "monitor reads host-side signals only")
+                elif _is_np_asarray(func) and len(node.args) == 1 \
+                        and not node.keywords:
+                    yield self.finding(
+                        posix_path, node,
+                        f"single-arg np.asarray() on {why} — the "
+                        "device-fetch form; the monitor reads host-side "
+                        "signals only")
+                elif _is_device_get(func):
+                    yield self.finding(
+                        posix_path, node,
+                        f"jax.device_get() on {why} — blocks the "
+                        "watchdog on a device transfer")
+                elif isinstance(func, ast.Attribute) \
+                        and func.attr == "block_until_ready":
+                    yield self.finding(
+                        posix_path, node,
+                        f"block_until_ready on {why} — waits out a "
+                        "dispatch the monitor should only be observing")
