@@ -1149,6 +1149,11 @@ def bench_model_parallel(model_degree: int = 4, ndata: int = 2,
                       f"_nb{n_batches}_e{num_epochs}",
         "model_degree": model_degree,
         "data_degree": ndata,
+        # mesh-shape provenance (ISSUE 18): data×model×pipe, no
+        # microbatch schedule -> no pipeline bubble by construction
+        "mesh_shape": f"{ndata}x{model_degree}x1",
+        "pipe_microbatches": 1,
+        "bubble_fraction": 0.0,
         "param_bytes_total": total_bytes,
         "param_bytes_per_chip_sharded": mp_bytes,
         "param_bytes_per_chip_replicated": dp_bytes,
@@ -1164,6 +1169,117 @@ def bench_model_parallel(model_degree: int = 4, ndata: int = 2,
         "numerically_equivalent": bool(max_diff < 1e-3),
         "mfu": _mfu(flops, t_mp / steps, kind, need,
                     label="bench.model_parallel"),
+    }
+
+
+def bench_parallel_4d(model_degree: int = 2, pipe_deg: int = 2,
+                      ndata: int = 2, pipe_microbatches: int = 4,
+                      rows: int = 32, seq: int = 64, n_batches: int = 8,
+                      num_epochs: int = 4):
+    """Pod-scale 4D parallelism row (the ISSUE 18 tentpole): the SAME
+    causal-LM fit at equal chip count twice —
+
+    1. 2D layout: (ndata*pipe_deg)×model_degree data×model mesh;
+    2. 4D layout: ndata×model_degree×pipe_deg data×model×pipe mesh,
+       stacked layers stage-sharded over `pipe`, the in-step GPipe
+       microbatch schedule at ``pipe_microbatches`` slices.
+
+    Evidence carried in the row: per-chip param bytes STRICTLY below
+    the 2D layout at the same chip count (the memory headroom the pipe
+    axis buys), the schedule bubble fraction (S-1)/(M+S-1) within 10%
+    of the 1/M ideal, samples/s/chip for both layouts, warmed
+    ``compile_delta == 0``, and the two layouts numerically equivalent
+    (pipe-degree changes are bit-exact; the 2D comparison reassociates
+    the data-axis reduction, so equivalence here is allclose)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.models.lm_fit import CausalLM
+    from deeplearning4j_tpu.parallel.mesh import (MeshSpec, make_mesh,
+                                                  per_device_bytes)
+    from deeplearning4j_tpu.runtime.metrics import compile_metrics
+    import dataclasses
+
+    platform, kind, n_dev = _platform_info()
+    need = ndata * model_degree * pipe_deg
+    if n_dev < need:
+        return {"metric": "parallel_4d_per_chip_bytes_ratio",
+                "value": None, "unit": "skipped",
+                "error": f"needs >= {need} devices, have {n_dev}"}
+    cfg = dataclasses.replace(
+        gpt.gpt_tiny(vocab_size=2048, max_len=seq), hidden=128,
+        n_layers=2, n_heads=8, ffn_dim=512, compute_dtype="float32")
+    assert cfg.n_layers % pipe_deg == 0
+    rng = np.random.RandomState(0)
+    batches = [DataSet(
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (rows, seq)), jnp.int32),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (rows, seq)), jnp.int32))
+        for _ in range(n_batches)]
+    mesh_4d = make_mesh(MeshSpec(data=ndata, model=model_degree,
+                                 pipe=pipe_deg),
+                        devices=jax.devices()[:need])
+    mesh_2d = make_mesh(MeshSpec(data=ndata * pipe_deg,
+                                 model=model_degree),
+                        devices=jax.devices()[:need])
+    steps = n_batches * num_epochs
+
+    def once(mesh):
+        net = CausalLM(cfg, lr=0.01,
+                       pipe_microbatches=pipe_microbatches).init(seed=0)
+        net.fit_backprop(batches, num_epochs=num_epochs, mesh=mesh)
+        return net
+
+    def timed(mesh, reps=3):
+        t = _time_fit(lambda: once(mesh).params, reps=reps)
+        return t, once(mesh)
+
+    once(mesh_2d)                              # compiles banked
+    t_2d, net_2d = timed(mesh_2d)
+    once(mesh_4d)                              # compiles banked
+    before = compile_metrics.snapshot()["compile_count"]
+    t_4d, net_4d = timed(mesh_4d)
+    compile_delta = compile_metrics.snapshot()["compile_count"] - before
+
+    bytes_4d = max(per_device_bytes(net_4d.params).values())
+    bytes_2d = max(per_device_bytes(net_2d.params).values())
+    max_diff = float(np.max(np.abs(net_4d.params_flat()
+                                   - net_2d.params_flat())))
+    # GPipe schedule bubble: S-1 stage-fill ticks over M+S-1 total
+    n_micro = pipe_microbatches          # grad_accum=1 in this row
+    bubble = (pipe_deg - 1) / (n_micro + pipe_deg - 1)
+    flops = gpt_train_flops(cfg, rows, seq)
+    ratio = bytes_4d / max(bytes_2d, 1)
+    return {
+        "metric": f"parallel_4d_per_chip_bytes_ratio_{ndata}x"
+                  f"{model_degree}x{pipe_deg}",
+        "value": round(ratio, 4),
+        "unit": "4d_over_2d_per_chip_bytes",
+        "vs_baseline": round(ratio, 4),
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"4d{ndata}x{model_degree}x{pipe_deg}_m"
+                      f"{pipe_microbatches}_b{rows}_T{seq}"
+                      f"_nb{n_batches}_e{num_epochs}",
+        "mesh_shape": f"{ndata}x{model_degree}x{pipe_deg}",
+        "mesh_shape_2d": f"{ndata * pipe_deg}x{model_degree}x1",
+        "pipe_microbatches": pipe_microbatches,
+        "bubble_fraction": round(bubble, 4),
+        "bubble_within_ideal": bool(bubble <= 1.0 / n_micro + 0.10),
+        "param_bytes_per_chip_4d": bytes_4d,
+        "param_bytes_per_chip_2d": bytes_2d,
+        # acceptance: the pipe axis must buy real per-chip headroom
+        "per_chip_bytes_strictly_lower": bool(bytes_4d < bytes_2d),
+        "fit_ms_2d": round(t_2d * 1e3, 1),
+        "fit_ms_4d": round(t_4d * 1e3, 1),
+        "samples_per_sec_per_chip_4d": round(steps * rows / t_4d / need, 2),
+        "samples_per_sec_per_chip_2d": round(steps * rows / t_2d / need, 2),
+        "compile_delta": compile_delta,
+        "max_abs_diff_4d_vs_2d": max_diff,
+        "numerically_equivalent": bool(max_diff < 1e-3),
+        "mfu": _mfu(flops, t_4d / steps, kind, need,
+                    label="bench.parallel_4d"),
     }
 
 
@@ -2264,7 +2380,12 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "gpt": bench_gpt,
          "dp_fit": bench_dp_fit,
          # data×model tentpole: per-chip bytes ~1/model_degree,
          # replicated-vs-sharded step time, zero steady-state compiles
-         "model_parallel": bench_model_parallel}
+         "model_parallel": bench_model_parallel,
+         # 4D tentpole: data×model×pipe at equal chip count vs the 2D
+         # layout — per-chip bytes strictly lower, GPipe bubble within
+         # 10% of 1/M, samples/s/chip both layouts, zero steady-state
+         # compiles
+         "parallel_4d": bench_parallel_4d}
 
 # (tpu_timeout_s, cpu_timeout_s); scaling is cpu-only (needs >=2 devices),
 # longctx32k is tpu-only (the CPU branch would just repeat longctx@256)
@@ -2292,7 +2413,9 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420),
             # dp_fit needs >= 2 devices: cpu-only like scaling
             "dp_fit": (0, 900),
             # model_parallel needs >= 8 devices: cpu-only like dp_fit
-            "model_parallel": (0, 600)}
+            "model_parallel": (0, 600),
+            # parallel_4d: 8-chip data×model×pipe vs 2D at equal count
+            "parallel_4d": (900, 600)}
 
 
 # -- perf-regression guard --------------------------------------------------

@@ -68,17 +68,19 @@ def param_specs(cfg: TransformerConfig) -> PyTree:
     return specs
 
 
-def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
-    """data×model GSPMD specs for the BERT family: the encoder rules
-    from ``transformer.shard_specs`` (heads + MLP hidden over ``model``,
-    tied token embedding over vocab when divisible) plus the MLM head —
+def shard_specs(cfg: TransformerConfig, model_degree: int = 1,
+                pipe_degree: int = 1) -> PyTree:
+    """data×model(×pipe) GSPMD specs for the BERT family: the encoder
+    rules from ``transformer.shard_specs`` (heads + MLP hidden over
+    ``model``, tied token embedding over vocab when divisible, stacked
+    layers split into stages over ``pipe``) plus the MLM head —
     its transform column-parallel over ``model`` and its output bias
     over vocab alongside the tied projection.  LayerNorms and the
     pooler stay replicated (tiny; sharding them buys collectives, not
     memory)."""
     from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
 
-    specs = tfm.shard_specs(cfg, model_degree)
+    specs = tfm.shard_specs(cfg, model_degree, pipe_degree)
     m = MODEL_AXIS if model_degree > 1 else None
     vocab_ok = model_degree > 1 and cfg.vocab_size % model_degree == 0
     specs["mlm"] = {"w": P(None, m), "b": P(m),

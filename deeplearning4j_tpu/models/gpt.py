@@ -53,14 +53,16 @@ def init_params(key: Array, cfg: TransformerConfig) -> PyTree:
     return tfm.init_params(key, cfg)
 
 
-def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
-    """data×model sharding specs for the GPT family: attention heads +
-    MLP hidden over ``model``, the tied token embedding (= the LM
-    output projection) over vocab when the degree divides it.  The GPT
-    param tree IS the transformer tree, so this is
+def shard_specs(cfg: TransformerConfig, model_degree: int = 1,
+                pipe_degree: int = 1) -> PyTree:
+    """data×model(×pipe) sharding specs for the GPT family: attention
+    heads + MLP hidden over ``model``, the tied token embedding (= the
+    LM output projection) over vocab when the degree divides it, and
+    the stacked layer axis split into contiguous pipeline stages over
+    ``pipe``.  The GPT param tree IS the transformer tree, so this is
     ``transformer.shard_specs`` re-exported under the family name the
     sharded-fit/serving plumbing asks for."""
-    return tfm.shard_specs(cfg, model_degree)
+    return tfm.shard_specs(cfg, model_degree, pipe_degree)
 
 
 def slot_specs(cfg: TransformerConfig,

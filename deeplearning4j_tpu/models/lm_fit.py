@@ -46,10 +46,13 @@ from jax.sharding import PartitionSpec as P
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.models import gpt
+from deeplearning4j_tpu.models import moe as moe_lm
 from deeplearning4j_tpu.models import transformer as tfm
+from deeplearning4j_tpu.models.moe import MoETransformerConfig
 from deeplearning4j_tpu.models.transformer import TransformerConfig
-from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, mesh_signature,
-                                              model_degree, pad_rows)
+from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, expert_degree,
+                                              mesh_signature, model_degree,
+                                              pad_rows, pipe_degree)
 from deeplearning4j_tpu.runtime import compile_cache, resilience, telemetry
 from deeplearning4j_tpu.runtime.metrics import dp_metrics
 
@@ -84,28 +87,43 @@ class CausalLM:
     against fp32 masters with the PR 11 dynamic loss scale threaded
     through the scanned epochs."""
 
-    def __init__(self, cfg: TransformerConfig, *, lr: float = 0.1,
+    def __init__(self, cfg: Union[TransformerConfig, MoETransformerConfig],
+                 *, lr: float = 0.1,
                  momentum: float = 0.0, mixed_precision: str = "off",
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, pipe_microbatches: int = 1):
         if not cfg.causal:
             raise ValueError("CausalLM needs a causal TransformerConfig")
         if mixed_precision not in MIXED_PRECISION_POLICIES:
             raise ValueError(
                 f"mixed_precision must be one of "
                 f"{MIXED_PRECISION_POLICIES}, got {mixed_precision!r}")
+        if pipe_microbatches < 1:
+            raise ValueError(
+                f"pipe_microbatches must be >= 1, got {pipe_microbatches}")
         self.cfg = cfg
         self.lr = float(lr)
         self.momentum = float(momentum)
         self.mixed_precision = mixed_precision
+        #: GPipe microbatch count for pipeline meshes — a CONFIG knob,
+        #: not a mesh property: the in-step microbatch schedule is
+        #: accum * pipe_microbatches slices regardless of mesh shape, so
+        #: the grad-sum association is identical at every shape and a
+        #: pipe-degree change is a pure layout change (bit-exact)
+        self.pipe_microbatches = int(pipe_microbatches)
         self.conf = _LMConf(grad_accum)
         self.params: Optional[PyTree] = None
         self.listeners: List = []
         self.guard_skips = 0
         self._bp_cache = {}
 
+    @property
+    def _is_moe(self) -> bool:
+        return isinstance(self.cfg, MoETransformerConfig)
+
     # -- params ------------------------------------------------------------
     def init(self, seed: int = 0) -> "CausalLM":
-        self.params = gpt.init_params(jax.random.key(seed), self.cfg)
+        fam = moe_lm if self._is_moe else gpt
+        self.params = fam.init_params(jax.random.key(seed), self.cfg)
         return self
 
     def _require_params(self) -> PyTree:
@@ -132,7 +150,7 @@ class CausalLM:
     # -- machinery ---------------------------------------------------------
     def _conf_signature(self):
         return ("causal_lm", repr(self.cfg), self.lr, self.momentum,
-                self.mixed_precision)
+                self.mixed_precision, self.pipe_microbatches)
 
     def _mp_on(self) -> bool:
         return self.mixed_precision == "bf16"
@@ -162,23 +180,63 @@ class CausalLM:
         cfg = self.cfg
         lr, mu = self.lr, self.momentum
         mp_on = self._mp_on()
+        is_moe = self._is_moe
         m_deg = model_degree(mesh)
-        specs = gpt.shard_specs(cfg, model_degree=m_deg) \
-            if mesh is not None else None
+        p_deg = pipe_degree(mesh)
+        e_deg = expert_degree(mesh)
+        n_micro = accum * self.pipe_microbatches
+        if mesh is None:
+            specs = None
+        elif is_moe:
+            specs = moe_lm.shard_specs(cfg, model_degree=m_deg,
+                                       pipe_degree=p_deg,
+                                       expert_degree=e_deg)
+        else:
+            specs = gpt.shard_specs(cfg, model_degree=m_deg,
+                                    pipe_degree=p_deg)
+
+        # trace-time attention kernel choice (ops/kernel_select policy +
+        # the runtime/autotune cache): flash under data×model, RING when
+        # the mesh shards the sequence axis, plain XLA on CPU/short-seq
+        if mesh is not None and mesh.size > 1:
+            from deeplearning4j_tpu.ops.pallas_attention import make_attn_fn
+            attn_fn = make_attn_fn("auto", mesh=mesh)
+        else:
+            attn_fn = tfm.attention
+        # MoE layers dispatch through parallel/expert.py's shard_map on
+        # the mesh `expert` axis (all_to_all token routing) from inside
+        # the GSPMD program; without an expert axis the same callable is
+        # the single-shard dispatch math
+        if is_moe:
+            from deeplearning4j_tpu.parallel.expert import make_gspmd_moe_ffn
+            moe_ffn_fn = make_gspmd_moe_ffn(mesh, cfg.moe)
 
         def loss_sum(params, ids, rmask, key):
             """Masked next-token NLL SUM over the (global) batch — the
             linear unit shard/microbatch combination preserves.  Under
             mixed precision the fp32 masters cast to bf16 HERE, inside
-            the differentiated function, so grads come back fp32."""
+            the differentiated function, so grads come back fp32.  The
+            MoE families add the Switch load-balance aux scaled by the
+            slice's valid count, so the final divide-once by the global
+            count leaves mean-NLL + aux_weight * (count-weighted) aux."""
             if mp_on:
                 params = sharded_fit.mp_cast(params)
-            hidden = tfm.encode(cfg, params, ids, None, None, key)
+            if is_moe:
+                hidden, aux = moe_lm.encode(cfg, params, ids,
+                                            attn_fn=attn_fn,
+                                            ffn_fn=moe_ffn_fn)
+            else:
+                hidden = tfm.encode(cfg, params, ids, None, None, key,
+                                    attn_fn=attn_fn)
             logits = gpt.lm_logits(cfg, params, hidden[:, :-1])
             logp = jax.nn.log_softmax(logits, axis=-1)
             ll = jnp.take_along_axis(logp, ids[:, 1:, None],
                                      axis=-1)[..., 0]
-            return -jnp.sum(ll * rmask[:, None])
+            nll = -jnp.sum(ll * rmask[:, None])
+            if is_moe:
+                count = jnp.sum(rmask) * (ids.shape[1] - 1)
+                nll = nll + cfg.aux_loss_weight * aux * count
+            return nll
 
         def dp_step(params, ustate, batch, key, iteration):
             if mp_on:
@@ -196,13 +254,20 @@ class CausalLM:
                 s = loss_sum(p, xi, mi, ki)
                 return (s * scale if mp_on else s), s
 
-            if accum == 1:
+            if n_micro == 1:
                 (_, lsum), grads = jax.value_and_grad(
                     scaled_obj, has_aux=True)(params, ids, rmask, key)
             else:
-                micro = B // accum
-                xm = ids.reshape(accum, micro, T)
-                mm = rmask.reshape(accum, micro)
+                # the in-step GPipe schedule: accum * pipe_microbatches
+                # slices walked by a lax.scan whose (grads, loss) carry
+                # is donated across iterations — HBM stays flat at one
+                # grad tree regardless of the microbatch count, and on a
+                # pipe-sharded mesh each slice streams through the
+                # stage-laid-out layers while XLA overlaps the
+                # stage-boundary transfers of the next slice
+                micro = B // n_micro
+                xm = ids.reshape(n_micro, micro, T)
+                mm = rmask.reshape(n_micro, micro)
 
                 def micro_body(carry, inp):
                     g_acc, s_acc = carry
@@ -218,7 +283,7 @@ class CausalLM:
                     lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 (grads, lsum), _ = lax.scan(
                     micro_body, (g0, jnp.float32(0.0)),
-                    (xm, mm, jnp.arange(accum)))
+                    (xm, mm, jnp.arange(n_micro)))
                 grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
                                      grads, params)
 
@@ -271,13 +336,15 @@ class CausalLM:
             fn.takes_n_valid = True
             fn.init_ustate = init_ustate
             fn.mixed_precision = mp_on
+            fn.pipe_microbatches = self.pipe_microbatches
+            fn.pipe_degree = p_deg
+            fn.expert_degree = e_deg
         return (train_step, train_epochs, ())
 
     # -- DP driver hooks (shared with MultiLayerNetwork) -------------------
-    @staticmethod
-    def _pad_chunk(mesh, accum: int) -> int:
+    def _pad_chunk(self, mesh, accum: int) -> int:
         ndp = mesh.shape[DATA_AXIS] if mesh is not None else 1
-        return ndp * max(accum, 1)
+        return ndp * max(accum, 1) * self.pipe_microbatches
 
     @staticmethod
     def _pad_rows(arr: Array, target: int) -> Array:
@@ -327,6 +394,9 @@ class CausalLM:
                             data_degree=(mesh.shape[DATA_AXIS]
                                          if mesh is not None else 1),
                             model_degree=model_degree(mesh),
+                            pipe_degree=pipe_degree(mesh),
+                            expert_degree=expert_degree(mesh),
+                            pipe_microbatches=self.pipe_microbatches,
                             steps=num_epochs * len(batches)):
             params, ustate, scores, skips = train_epochs(
                 params, ustate, (xs, ys, nvs), jax.random.key(seed), 0,

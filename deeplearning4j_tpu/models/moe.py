@@ -116,19 +116,24 @@ def param_specs(cfg: MoETransformerConfig) -> PyTree:
     return {"embed": embed, "blocks": blocks}
 
 
-def shard_specs(cfg: MoETransformerConfig, model_degree: int = 1) -> PyTree:
-    """data×model GSPMD specs for the MoE family (meshes WITHOUT an
-    ``expert`` axis — the sharded-fit/serving convention): the expert
-    tables, which dominate the footprint, shard their EXPERT axis over
-    ``model`` (expert parallelism riding the model axis), attention
-    heads over ``model``, and the token embedding over vocab when the
-    degree divides it.  The all_to_all dispatch of the shard_map path
-    becomes GSPMD-inserted collectives here."""
+def shard_specs(cfg: MoETransformerConfig, model_degree: int = 1,
+                pipe_degree: int = 1, expert_degree: int = 1) -> PyTree:
+    """data×model(×pipe×expert) GSPMD specs for the MoE family.  The
+    expert tables, which dominate the footprint, shard their EXPERT
+    axis over the mesh ``expert`` axis when ``expert_degree > 1`` (the
+    parallel/expert.py shard_map dispatch consumes the same layout), or
+    over ``model`` otherwise (expert parallelism riding the model axis
+    — the sharded-fit/serving convention for meshes without an
+    ``expert`` axis).  Attention heads shard over ``model``, the token
+    embedding over vocab when the degree divides it, and the stacked
+    layer axis splits into contiguous pipeline stages over ``pipe``.
+    The all_to_all dispatch of the shard_map path becomes
+    GSPMD-inserted collectives here."""
     from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
 
-    m = MODEL_AXIS
+    m = MODEL_AXIS if model_degree > 1 else None
     if model_degree > 1:
-        if cfg.n_experts % model_degree:
+        if expert_degree == 1 and cfg.n_experts % model_degree:
             raise ValueError(
                 f"n_experts={cfg.n_experts} not divisible by model "
                 f"degree {model_degree} — expert tables shard their "
@@ -137,6 +142,14 @@ def shard_specs(cfg: MoETransformerConfig, model_degree: int = 1) -> PyTree:
             raise ValueError(
                 f"n_heads={cfg.n_heads} not divisible by model degree "
                 f"{model_degree} — attention heads shard over `model`")
+    e = m
+    if expert_degree > 1:
+        if cfg.n_experts % expert_degree:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} not divisible by expert "
+                f"degree {expert_degree} — expert tables shard their "
+                f"expert axis over `expert`")
+        e = EXPERT_AXIS
     blocks = {
         "wq": P(None, None, m, None), "wk": P(None, None, m, None),
         "wv": P(None, None, m, None), "wo": P(None, m, None, None),
@@ -145,31 +158,45 @@ def shard_specs(cfg: MoETransformerConfig, model_degree: int = 1) -> PyTree:
         "ln1_g": P(None, None), "ln1_b": P(None, None),
         "ln2_g": P(None, None), "ln2_b": P(None, None),
         "router": P(None, None, None),
-        "wi": P(None, m, None, None),       # [L, E, H, F]: experts over m
-        "wo_e": P(None, m, None, None),
+        "wi": P(None, e, None, None),       # [L, E, H, F]: experts over e
+        "wo_e": P(None, e, None, None),
     }
     tok = (P(m, None) if model_degree > 1
            and cfg.vocab_size % model_degree == 0 else P(None, None))
     embed = {"tok": tok, "pos": P(None, None),
              "ln_g": P(None), "ln_b": P(None)}
-    return {"embed": embed, "blocks": blocks}
+    specs = {"embed": embed, "blocks": blocks}
+    if pipe_degree > 1:
+        specs["blocks"] = tfm.pipe_stage_specs(specs["blocks"], cfg,
+                                               pipe_degree)
+    return specs
 
 
 def _block(cfg: MoETransformerConfig, x: Array, p: dict,
            moe_axis: Optional[str],
            stat_axes: Tuple[str, ...] = (),
-           attn_fn=tfm.attention) -> Tuple[Array, Array]:
+           attn_fn=tfm.attention,
+           ffn_fn: Optional[Callable] = None) -> Tuple[Array, Array]:
     """One post-LN (BERT convention) causal block with an MoE FFN:
     x [b, T, H] fp32 -> (x', aux_loss).  The attention half is the
-    shared ``tfm._attention_sublayer``; only the FFN differs."""
+    shared ``tfm._attention_sublayer``; only the FFN differs.
+
+    ``ffn_fn`` overrides the dispatch: a callable ``(layer_params, tok)
+    -> (y, aux)`` with ``layer_params = {"router", "wi", "wo"}`` and
+    ``tok [N, H]`` — the hook the GSPMD fit spine uses to route the FFN
+    through ``parallel/expert.make_gspmd_moe_ffn``'s shard_map on the
+    mesh ``expert`` axis from INSIDE a jitted global-view program."""
     cdt = jnp.dtype(cfg.compute_dtype)
     x, _ = tfm._attention_sublayer(cfg, x, p, None, None, attn_fn)
 
     b, T, H = x.shape
     tok = x.reshape(b * T, H).astype(cdt)
-    y, aux = moe_ffn({"router": p["router"], "wi": p["wi"],
-                      "wo": p["wo_e"]}, tok, cfg.moe, axis_name=moe_axis,
-                     stat_axes=stat_axes)
+    lp = {"router": p["router"], "wi": p["wi"], "wo": p["wo_e"]}
+    if ffn_fn is not None:
+        y, aux = ffn_fn(lp, tok)
+    else:
+        y, aux = moe_ffn(lp, tok, cfg.moe, axis_name=moe_axis,
+                         stat_axes=stat_axes)
     x = tfm.layer_norm(x + y.reshape(b, T, H).astype(jnp.float32),
                        p["ln2_g"], p["ln2_b"], cfg.layer_norm_eps)
     return x, aux
@@ -178,7 +205,8 @@ def _block(cfg: MoETransformerConfig, x: Array, p: dict,
 def encode(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
            moe_axis: Optional[str] = None,
            stat_axes: Tuple[str, ...] = (),
-           attn_fn=tfm.attention) -> Tuple[Array, Array]:
+           attn_fn=tfm.attention,
+           ffn_fn: Optional[Callable] = None) -> Tuple[Array, Array]:
     """ids [b, T] -> (hidden [b, T, H] fp32, mean aux loss over layers)."""
     e = params["embed"]
     T = token_ids.shape[-1]
@@ -186,7 +214,7 @@ def encode(cfg: MoETransformerConfig, params: PyTree, token_ids: Array,
     x = tfm.layer_norm(x, e["ln_g"], e["ln_b"], cfg.layer_norm_eps)
 
     def body(x, p):
-        return _block(cfg, x, p, moe_axis, stat_axes, attn_fn)
+        return _block(cfg, x, p, moe_axis, stat_axes, attn_fn, ffn_fn)
 
     if cfg.remat:
         body = jax.checkpoint(body)

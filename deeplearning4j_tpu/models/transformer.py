@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                              PIPE_AXIS, SEQ_AXIS)
 
 Array = jax.Array
 PyTree = Any
@@ -122,15 +123,35 @@ def param_specs(cfg: TransformerConfig) -> PyTree:  # jaxlint: disable=spec-with
     return {"embed": embed, "blocks": blocks}
 
 
-def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
-    """Per-layer weight sharding specs for data×model GSPMD training
-    and serving (parallel/sharded_fit GSPMD mode, serving/decode model
-    sharding): ``param_specs``'s tensor-parallel rules — attention
-    heads and MLP hidden over ``model`` — PLUS the token embedding
-    (and, via weight tying, the output projection) sharded over vocab
-    when the degree divides it.  Validates divisibility up front so a
-    bad (cfg, mesh) pairing fails at build time with the real
-    constraint, not deep inside XLA partitioning."""
+def pipe_stage_specs(block_specs: PyTree, cfg, pipe_degree: int) -> PyTree:
+    """Lay the stacked ``[n_layers, ...]`` block leaves out over the
+    ``pipe`` axis: each pipe shard holds a contiguous group of
+    ``n_layers / pipe_degree`` layers — the GPipe stage slicing
+    expressed as a ``NamedSharding`` layout instead of a hand-written
+    schedule (the layer ``lax.scan`` walks the stages in order; XLA
+    owns the stage-boundary transfers).  Validates the real constraint
+    up front: layers must split evenly into stages."""
+    if cfg.n_layers % pipe_degree:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe degree "
+            f"{pipe_degree} — stacked layers split into equal "
+            f"contiguous pipeline stages over `pipe`")
+    return jax.tree.map(lambda s: P(PIPE_AXIS, *tuple(s)[1:]), block_specs,
+                        is_leaf=lambda s: isinstance(s, P))
+
+
+def shard_specs(cfg: TransformerConfig, model_degree: int = 1,
+                pipe_degree: int = 1) -> PyTree:
+    """Per-layer weight sharding specs for data×model(×pipe) GSPMD
+    training and serving (parallel/sharded_fit GSPMD mode,
+    serving/decode model sharding): ``param_specs``'s tensor-parallel
+    rules — attention heads and MLP hidden over ``model`` — PLUS the
+    token embedding (and, via weight tying, the output projection)
+    sharded over vocab when the degree divides it, PLUS the stacked
+    layer axis split into contiguous pipeline stages over ``pipe`` when
+    ``pipe_degree > 1``.  Validates divisibility up front so a bad
+    (cfg, mesh) pairing fails at build time with the real constraint,
+    not deep inside XLA partitioning."""
     if model_degree > 1:
         if cfg.n_heads % model_degree:
             raise ValueError(
@@ -143,6 +164,8 @@ def shard_specs(cfg: TransformerConfig, model_degree: int = 1) -> PyTree:
     specs = param_specs(cfg)
     if model_degree > 1 and cfg.vocab_size % model_degree == 0:
         specs["embed"]["tok"] = P(MODEL_AXIS, None)
+    if pipe_degree > 1:
+        specs["blocks"] = pipe_stage_specs(specs["blocks"], cfg, pipe_degree)
     return specs
 
 

@@ -20,15 +20,17 @@ import jax
 
 KERNELS = ("auto", "pallas", "xla")
 
-#: attention kernel modes share the NLP vocabulary — one policy, one spelling
-ATTN_KERNELS = KERNELS
+#: attention kernel modes add "ring" (sequence-parallel ring attention,
+#: parallel/ring_attention.py) to the shared vocabulary — one policy,
+#: one spelling
+ATTN_KERNELS = KERNELS + ("ring",)
 
 
 def resolve_attn_kernel(kernel: str, *, k_len: int, aligned: bool,
                         on_tpu: bool, blocked: Optional[str] = None,
                         autotuned_impl: Optional[str] = None,
-                        min_seq: int, desc: str = "flash attention"
-                        ) -> Tuple[str, bool]:
+                        min_seq: int, desc: str = "flash attention",
+                        seq_degree: int = 1) -> Tuple[str, bool]:
     """(impl, interpret) for a requested attention ``kernel`` mode.
 
     ``aligned`` is the Mosaic-tileability verdict for the shape,
@@ -36,16 +38,39 @@ def resolve_attn_kernel(kernel: str, *, k_len: int, aligned: bool,
     context at all (seq-parallel mesh, indivisible sharding, ...).
     ``autotuned_impl`` is a persisted sweep winner ("pallas"/"xla") that
     overrides the ``min_seq`` heuristic for auto mode on TPU.
+    ``seq_degree`` is the mesh's sequence-parallel degree: above 1, ring
+    attention (parallel/ring_attention.py) owns the axis — auto selects
+    impl "ring" (unless an autotuned winner says plain XLA is faster at
+    this shape), an explicit ``kernel='ring'`` demands it, and an
+    explicit ``kernel='pallas'`` raises (the flash kernel has no ring
+    schedule).
 
     Contract (same as :func:`resolve_kernel` for word2vec/glove): auto
-    degrades silently, an explicit ``kernel='pallas'`` raises instead of
-    falling back, and a forced Pallas kernel off-TPU runs through the
-    interpreter (the CPU test harness)."""
+    degrades silently, an explicit ``kernel='pallas'``/``'ring'`` raises
+    instead of falling back, and a forced Pallas kernel off-TPU runs
+    through the interpreter (the CPU test harness)."""
     if kernel not in ATTN_KERNELS:
         raise ValueError(
             f"kernel must be one of {ATTN_KERNELS}, got {kernel!r}")
+    if kernel == "ring":
+        if seq_degree <= 1 or blocked is not None:
+            raise ValueError(
+                f"kernel='ring' but {desc} cannot run ring attention: "
+                f"{blocked or f'no sharded sequence axis (seq degree {seq_degree})'}"
+                f" — never a silent fallback on an explicit request")
+        return "ring", False
     if kernel == "xla":
         return "xla", False
+    if seq_degree > 1:
+        if kernel == "pallas":
+            raise ValueError(
+                f"kernel='pallas' but {desc} runs under sequence "
+                f"parallelism (seq degree {seq_degree}) — ring attention "
+                f"owns a sharded sequence axis; request kernel='ring' or "
+                f"'auto'")
+        if autotuned_impl == "xla":
+            return "xla", False
+        return "ring", False
     if aligned and blocked is None:
         if kernel == "pallas":
             return "pallas", not on_tpu

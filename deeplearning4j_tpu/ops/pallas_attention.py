@@ -460,6 +460,8 @@ class AttnDecision:
 
     @property
     def kernel_name(self) -> str:
+        if self.impl == "ring":
+            return "ring"
         if self.impl != "pallas":
             return "xla"
         return "pallas-interpret" if self.interpret else "pallas"
@@ -509,16 +511,23 @@ def make_attn_fn(kernel: str = "auto", mesh=None, *, local: bool = False,
         on_tpu = jax.devices()[0].platform == "tpu"
         aligned = _aligned_for_tpu(Tq, Tk, D)
         blocked = None
+        sp = 1
         if mesh is not None and not local:
-            if mesh.shape.get(SEQ_AXIS, 1) > 1:
-                blocked = ("mesh has a seq axis — ring attention owns "
-                           "sequence parallelism")
-            else:
-                dp = mesh.shape.get(DATA_AXIS, 1)
-                tp = mesh.shape.get(MODEL_AXIS, 1)
-                if B % dp != 0 or NH % tp != 0:
-                    blocked = (f"batch {B} / heads {NH} do not divide "
-                               f"the mesh degrees (data={dp}, model={tp})")
+            dp = mesh.shape.get(DATA_AXIS, 1)
+            tp = mesh.shape.get(MODEL_AXIS, 1)
+            sp = mesh.shape.get(SEQ_AXIS, 1)
+            if sp > 1:
+                # ring attention owns a sharded sequence axis — but only
+                # when the shapes divide its shard_map placement
+                if B % dp != 0 or NH % tp != 0 or Tq % sp or Tk % sp:
+                    blocked = (f"batch {B} / heads {NH} / seq {Tq}x{Tk} "
+                               f"do not divide the seq-parallel mesh "
+                               f"degrees (data={dp}, model={tp}, "
+                               f"seq={sp})")
+                    sp = 1
+            elif B % dp != 0 or NH % tp != 0:
+                blocked = (f"batch {B} / heads {NH} do not divide "
+                           f"the mesh degrees (data={dp}, model={tp})")
         elif (mesh is None and not local and kernel == "auto"
               and on_tpu and jax.device_count() > 1):
             # an auto-selected pallas_call inside a GSPMD-partitioned jit
@@ -528,11 +537,14 @@ def make_attn_fn(kernel: str = "auto", mesh=None, *, local: bool = False,
 
         record = None
         # consult only where the verdict can matter: auto on TPU (impl
-        # override) or a forced pallas anywhere (block-size override) —
-        # auto off-TPU is XLA unconditionally, and booking consults for
-        # it would inflate the mfu family's cache-miss evidence
-        if (autotune and aligned and blocked is None
-                and (on_tpu or kernel == "pallas")):
+        # override — for a seq-sharded mesh a swept "xla" winner beats
+        # the ring default) or a forced pallas anywhere (block-size
+        # override) — auto off-TPU is XLA-or-ring unconditionally, and
+        # booking consults for it would inflate the mfu family's
+        # cache-miss evidence
+        if (autotune and blocked is None
+                and ((aligned and (on_tpu or kernel == "pallas"))
+                     or (sp > 1 and on_tpu))):
             from deeplearning4j_tpu.runtime import autotune as at
             record = at.lookup_attention(Tq, Tk, D, causal)
 
@@ -540,13 +552,17 @@ def make_attn_fn(kernel: str = "auto", mesh=None, *, local: bool = False,
             kernel, k_len=Tk, aligned=aligned, on_tpu=on_tpu,
             blocked=blocked,
             autotuned_impl=record["impl"] if record else None,
-            min_seq=FLASH_MIN_SEQ, desc="training attention")
+            min_seq=FLASH_MIN_SEQ, desc="training attention",
+            seq_degree=sp)
         bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
         if impl == "pallas" and record and record.get("impl") == "pallas":
             bq = int(record.get("block_q", bq))
             bk = int(record.get("block_k", bk))
         if kernel != "auto":
             source = "forced"
+        elif impl == "ring":
+            source = ("autotuned" if record else
+                      f"seq-sharded (seq={sp} — ring owns the axis)")
         elif impl == "xla" and (blocked or not aligned or not on_tpu):
             source = (blocked or
                       ("shape not Mosaic-tileable" if not aligned
@@ -561,6 +577,25 @@ def make_attn_fn(kernel: str = "auto", mesh=None, *, local: bool = False,
         from deeplearning4j_tpu.models import transformer as tfm
 
         d = describe(q.shape, k.shape, causal)
+        if d.impl == "ring":
+            from jax.sharding import PartitionSpec as P
+
+            from deeplearning4j_tpu.compat import shard_map
+            from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS,
+                                                          MODEL_AXIS,
+                                                          SEQ_AXIS)
+            from deeplearning4j_tpu.parallel.ring_attention import (
+                ring_attention)
+            qspec = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
+            mspec = P(DATA_AXIS, SEQ_AXIS)
+            if mask is None:
+                mask = jnp.ones((q.shape[0], k.shape[1]), jnp.float32)
+            f = shard_map(
+                lambda q, k, v, m: ring_attention(
+                    q, k, v, m, causal, axis_name=SEQ_AXIS),
+                mesh=mesh, in_specs=(qspec, qspec, qspec, mspec),
+                out_specs=qspec, check_vma=False)
+            return f(q, k, v, mask)
         if d.impl != "pallas":
             return tfm.attention(q, k, v, mask, causal)
         if mesh is not None and not local and mesh.size > 1:

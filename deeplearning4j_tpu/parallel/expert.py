@@ -199,3 +199,40 @@ def make_moe_layer(mesh: Mesh, cfg: MoEConfig):
 
     return shard_map(inner, mesh=mesh, in_specs=(pspec, tok_spec),
                      out_specs=(tok_spec, P()), check_vma=False)
+
+
+def make_gspmd_moe_ffn(mesh: Optional[Mesh], cfg: MoEConfig):
+    """The per-layer MoE dispatch for the GSPMD fit spine: a callable
+    ``(layer_params, tok) -> (y, aux)`` with ``layer_params =
+    {"router", "wi" [E,H,F], "wo" [E,F,H]}`` and ``tok [N, H]``, legal
+    to call from INSIDE a jitted global-view program (the sharded-fit
+    scanned-epoch step calls it from the layer ``lax.scan`` body via
+    ``models/moe.encode(..., ffn_fn=...)``).
+
+    With an ``expert`` axis of size > 1 in ``mesh`` this is a nested
+    ``shard_map``: tokens shard over (``data``, ``expert``), expert
+    tables over ``expert``, and the two ``lax.all_to_all`` dispatch
+    collectives from ``moe_ffn`` run on the ``expert`` axis exactly as
+    in the standalone ``make_moe_layer`` path.  Without one it degrades
+    to the single-shard dispatch math (GSPMD still shards the einsums
+    over whatever the specs say).  The aux scalar comes back replicated
+    and already globally pmean-ed over the token shards."""
+    ep = 1 if mesh is None else int(mesh.shape.get(EXPERT_AXIS, 1))
+    if ep == 1:
+        def apply(params, x):
+            return moe_ffn(params, x, cfg, axis_name=None)
+        return apply
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
+                         f"expert degree {ep}")
+    tok_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS)
+                     if mesh.shape.get(a, 1) > 1)
+    tok_spec = P(tok_axes) if tok_axes else P()
+    pspec = expert_param_specs(cfg)
+
+    def inner(params, x):
+        return moe_ffn(params, x, cfg, axis_name=EXPERT_AXIS,
+                       stat_axes=tok_axes)
+
+    return shard_map(inner, mesh=mesh, in_specs=(pspec, tok_spec),
+                     out_specs=(tok_spec, P()), check_vma=False)

@@ -214,6 +214,27 @@ def model_degree(mesh: Optional[Mesh]) -> int:
     return int(mesh.shape.get(MODEL_AXIS, 1))
 
 
+def pipe_degree(mesh: Optional[Mesh]) -> int:
+    """Pipeline-parallel degree of ``mesh`` (1 when absent/None)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(PIPE_AXIS, 1))
+
+
+def expert_degree(mesh: Optional[Mesh]) -> int:
+    """Expert-parallel degree of ``mesh`` (1 when absent/None)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(EXPERT_AXIS, 1))
+
+
+def seq_degree(mesh: Optional[Mesh]) -> int:
+    """Sequence-parallel degree of ``mesh`` (1 when absent/None)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(SEQ_AXIS, 1))
+
+
 def per_device_bytes(tree) -> Dict[int, int]:
     """Bytes each device ACTUALLY holds for ``tree``'s arrays, summed
     from their addressable shards — the HBM-accounting primitive behind
@@ -268,6 +289,15 @@ def auto_data_mesh(devices: Optional[Sequence[jax.Device]] = None
 
 # -- elastic re-meshing (device loss / preemption survival) -----------------
 
+class RemeshError(ValueError):
+    """A device loss the host-side driver cannot recover from by
+    shrinking the data axis: the survivors cannot field even ONE intact
+    ``model``×``pipe``(×``seq``×``expert``) group, or nothing survived
+    at all.  Typed (not a silent fallback) so ``ResilientFit`` and the
+    multihost drills can distinguish "re-mesh and continue" from "this
+    fleet is dead — restore onto new hardware"."""
+
+
 def surviving_devices(mesh: Mesh, lost_ids) -> list:
     """The mesh's devices minus the lost ones, in mesh order."""
     lost = set(int(i) for i in lost_ids)
@@ -276,57 +306,56 @@ def surviving_devices(mesh: Mesh, lost_ids) -> list:
 
 def elastic_remesh(mesh: Mesh, lost_ids,
                    grad_accum: int = 1) -> Tuple[Optional[Mesh], int]:
-    """Rebuild a ``data``(×``model``) mesh over the survivors of a
-    device loss while PRESERVING the effective batch: returns
-    ``(new_mesh, new_accum)`` with ``new_data_degree * new_accum ==
-    old_data_degree * grad_accum`` — the PR 5 sum-loss formulation
-    makes the re-meshed run BIT-identical to the uninterrupted one at
-    equal effective batch, so "same run, smaller mesh" is an
-    equivalence, not an approximation.
+    """Rebuild a mesh over the survivors of a device loss while
+    PRESERVING the effective batch: returns ``(new_mesh, new_accum)``
+    with ``new_data_degree * new_accum == old_data_degree * grad_accum``
+    — the PR 5 sum-loss formulation makes the re-meshed run
+    BIT-identical to the uninterrupted one at equal effective batch, so
+    "same run, smaller mesh" is an equivalence, not an approximation.
 
-    Only the DATA axis shrinks.  A ``model`` degree > 1 is preserved
-    verbatim — the tensor-parallel layout is baked into every weight
-    shard, so the recovery keeps whole model groups and drops data
-    replicas: the new data degree is the LARGEST group count the
-    survivors can field that divides the old effective factor.  When
-    the survivors cannot hold even ONE intact model group, the loss is
-    unrecoverable by a host-side driver and raises with the surviving
-    count and the required divisor (restoring onto fewer-than-model
-    devices needs a resharding restore, which no snapshot here
-    carries).  Pipe/seq/expert-sharded meshes still refuse outright.
+    Only the DATA axis shrinks.  Every OTHER degree — ``model``,
+    ``pipe``, ``seq``, ``expert`` — is preserved verbatim: those
+    layouts are baked into the weight/activation shards, so the
+    recovery keeps whole ``model``×``pipe``(×``seq``×``expert``) groups
+    and drops data replicas.  The new data degree is the LARGEST group
+    count the survivors can field that divides the old effective
+    factor.  When the survivors cannot hold even ONE intact group, the
+    loss is unrecoverable by a host-side driver and raises a typed
+    ``RemeshError`` naming the surviving count and the required divisor
+    (restoring onto fewer devices than one group needs a resharding
+    restore onto a shape chosen by the operator, see
+    ``load_pytree_sharded``).
 
     For pure data meshes, ``new_mesh`` is None when only one device
     survives or only degree 1 divides: the caller continues
     single-device with ``new_accum = old_degree * grad_accum``.  A
-    data×model mesh never collapses to None — a ``1×model`` mesh is
-    still a mesh (the weights stay sharded)."""
-    for axis in (PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS):
-        if axis in mesh.shape and mesh.shape[axis] > 1:
-            raise ValueError(
-                f"elastic_remesh only shrinks data(×model) meshes; this "
-                f"mesh has {axis}={mesh.shape[axis]} (re-laying-out "
-                f"{axis}-sharded state needs a resharding restore, see "
-                f"load_pytree_sharded)")
+    mesh with any non-data degree > 1 never collapses to None — a
+    ``1×model×pipe`` mesh is still a mesh (the weights stay sharded)."""
     survivors = surviving_devices(mesh, lost_ids)
     if not survivors:
-        raise ValueError(
+        raise RemeshError(
             f"device loss {sorted(set(int(i) for i in lost_ids))} leaves "
             "no survivors in this mesh — nothing to resume on")
     model = int(mesh.shape.get(MODEL_AXIS, 1))
+    pipe = int(mesh.shape.get(PIPE_AXIS, 1))
+    seq = int(mesh.shape.get(SEQ_AXIS, 1))
+    expert = int(mesh.shape.get(EXPERT_AXIS, 1))
+    group = model * pipe * seq * expert
     eff = mesh.shape[DATA_AXIS] * max(grad_accum, 1)
-    if model > 1:
-        groups = len(survivors) // model
+    if group > 1:
+        groups = len(survivors) // group
         if groups < 1:
-            raise ValueError(
+            raise RemeshError(
                 f"device loss leaves {len(survivors)} surviving "
-                f"device(s), fewer than one intact model={model} group: "
-                f"the survivor count must be divisible into groups of "
-                f"{model} (required divisor {model}) to keep the "
-                f"tensor-parallel weight layout — restore onto a fleet "
-                f"of at least {model} devices instead")
+                f"device(s), fewer than one intact model×pipe group of "
+                f"model*pipe*seq*expert={group}: the survivor count must "
+                f"be divisible into groups of {group} (required divisor "
+                f"{group}) to keep the sharded weight layout — restore "
+                f"onto a fleet of at least {group} devices instead")
         degree = next(n for n in range(groups, 0, -1) if eff % n == 0)
-        return (make_mesh(MeshSpec(data=degree, model=model),
-                          devices=survivors[:degree * model]),
+        return (make_mesh(MeshSpec(data=degree, model=model, pipe=pipe,
+                                   seq=seq, expert=expert),
+                          devices=survivors[:degree * group]),
                 eff // degree)
     degree = next(n for n in range(len(survivors), 0, -1) if eff % n == 0)
     new_accum = eff // degree

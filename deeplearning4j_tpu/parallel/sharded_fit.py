@@ -22,19 +22,28 @@ its guard semantics: a skip decision must be computed from COLLECTIVE
 values (post-psum grads/score) so every replica skips identically and
 replicated params never diverge.
 
-data×model meshes (the model-parallel tentpole): passing
+data×model×pipe(×expert) meshes (the 4D-parallelism tentpole): passing
 ``param_specs`` (a pytree of ``PartitionSpec`` over the params, e.g.
 ``models/transformer.shard_specs`` — attention heads and MLP hidden
-over ``model``, embeddings over vocab) switches both builders to
-GSPMD mode: the step is a GLOBAL-view function (no shard_map, no
-hand-written psums — XLA inserts the collectives from the shardings),
-params and updater state are laid out with ``NamedSharding`` from the
-specs instead of replicated, the batch stays sharded over ``data``,
-and donation aliases each weight shard in place on its own device.
-Because every value in a GSPMD program is logically GLOBAL, the PR 2
-guard-skip verdict and the PR 11 loss-scale transition are replica-
-consistent across BOTH axes by construction — there is one verdict,
-not one per shard.
+over ``model``, embeddings over vocab, the stacked layer axis split
+into contiguous GPipe stages over ``pipe``) switches both builders to
+GSPMD mode: the step is a GLOBAL-view function (no hand-written psums
+— XLA inserts the collectives from the shardings), params and updater
+state are laid out with ``NamedSharding`` from the specs instead of
+replicated, the batch stays sharded over ``data``, and donation
+aliases each weight shard in place on its own device.  The step MAY
+nest explicit ``shard_map`` regions for the manual-collective kernels
+— ring attention over ``seq`` (ops/pallas_attention.make_attn_fn picks
+it at trace time), the MoE all_to_all dispatch over ``expert``
+(parallel/expert.make_gspmd_moe_ffn) — GSPMD and the manual regions
+compose inside one jitted program.  Because every value in a GSPMD
+program is logically GLOBAL, the PR 2 guard-skip verdict and the PR 11
+loss-scale transition are replica-consistent across ALL axes by
+construction — there is one verdict, not one per shard.  A mesh-shape
+change that only moves the ``pipe`` degree is a pure LAYOUT change
+(per-layer math and reduction order are untouched), so training the
+same schedule at different pipe degrees is bit-exact — the property
+the two-shape multihost drill gates.
 
 Engine keys: callers that want cross-instance sharing pass
 ``engine_key`` including ``mesh.mesh_signature(mesh)`` — mesh shape AND
@@ -161,6 +170,38 @@ def stacked_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, DATA_AXIS))
 
 
+def spec_axis_names(specs: PyTree):
+    """Every mesh axis name referenced by a ``PartitionSpec`` tree."""
+    names = set()
+    for s in jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)):
+        if not isinstance(s, P):
+            continue
+        for entry in s:
+            if entry is None:
+                continue
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                names.add(ax)
+    return names
+
+
+def validate_specs_against_mesh(mesh: Mesh, specs: PyTree,
+                                what: str = "param_specs") -> None:
+    """Every axis a spec tree names must be a declared axis of ``mesh``
+    — the runtime twin of jaxlint's ``spec-axis-outside-mesh`` rule.  A
+    ``pipe`` spec consumed against a mesh built without a ``pipe`` axis
+    would otherwise surface as an opaque XLA partitioning error (or,
+    worse, a silent replication); here it fails at build time naming
+    the spec axis and the mesh's actual axes."""
+    missing = sorted(spec_axis_names(specs) - set(mesh.axis_names))
+    if missing:
+        raise ValueError(
+            f"{what} names mesh axes {missing} that the mesh does not "
+            f"declare (mesh axes: {tuple(mesh.axis_names)}) — build the "
+            f"mesh with those axes (parallel/mesh.make_mesh declares "
+            f"all of data/model/pipe/seq/expert) or drop them from the "
+            f"specs")
+
+
 def named_shardings(mesh: Mesh, specs: PyTree) -> PyTree:
     """``PartitionSpec`` (prefix) tree -> ``NamedSharding`` tree over
     ``mesh`` — the layout half of GSPMD mode.  ``specs=None`` means
@@ -179,6 +220,11 @@ def _gspmd_shardings(mesh: Mesh, param_specs: PyTree, ustate_specs: PyTree,
     ``batch_specs``, scalars replicated.  ``ustate_specs`` defaults to
     ``param_specs`` (updater accumulators mirror the weights they
     smooth)."""
+    for what, tree in (("param_specs", param_specs),
+                       ("ustate_specs", ustate_specs),
+                       ("batch_specs", batch_specs)):
+        if tree is not None:
+            validate_specs_against_mesh(mesh, tree, what)
     psh = named_shardings(mesh, param_specs)
     ush = named_shardings(
         mesh, ustate_specs if ustate_specs is not None else param_specs)

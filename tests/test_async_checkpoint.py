@@ -605,17 +605,19 @@ def test_elastic_remesh_preserves_effective_batch():
 
 
 def test_elastic_remesh_shrinks_model_parallel_data_axis():
-    """data×model meshes are elastic along their DATA axis (model
-    groups stay intact — tests/test_model_parallel.py covers the full
-    matrix); pipe/seq/expert still refuse."""
+    """Multi-axis meshes are elastic along their DATA axis: whole
+    model×pipe×seq×expert groups stay intact (PR 18 generalized the
+    model-group logic; tests/test_model_parallel.py and
+    tests/test_parallel_4d.py cover the full matrix)."""
     mesh = make_mesh(MeshSpec(data=2, model=2),
                      devices=jax.devices()[:4])
     new_mesh, new_accum = elastic_remesh(mesh, lost_ids=[0])
     assert new_mesh.shape["data"] == 1 and new_mesh.shape["model"] == 2
     assert new_accum == 2
     pipe = make_mesh(MeshSpec(data=2, pipe=2), devices=jax.devices()[:4])
-    with pytest.raises(ValueError, match="pipe"):
-        elastic_remesh(pipe, lost_ids=[0])
+    new_mesh, new_accum = elastic_remesh(pipe, lost_ids=[0])
+    assert new_mesh.shape["data"] == 1 and new_mesh.shape["pipe"] == 2
+    assert new_accum == 2
 
 
 def test_device_loss_mid_fit_resumes_bit_exact(tmp_path):

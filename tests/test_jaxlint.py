@@ -2351,3 +2351,77 @@ def test_health_monitor_scope_and_suppression():
 
 def test_health_monitor_rule_registered_in_concurrency_family():
     assert REGISTRY["blocking-in-health-monitor"].family == "concurrency"
+
+
+# ---------------------------------------------------------------------------
+# PR 18: spec-axis-outside-mesh (4D mesh-shape contract)
+# ---------------------------------------------------------------------------
+
+def test_spec_axis_outside_mesh_flags_undeclared_axis():
+    """A module that pins its mesh axes with a literal tuple must draw
+    every resolvable spec axis from that tuple — 'pipe' is in the
+    package vocabulary but not on THIS mesh, so only the stricter rule
+    fires."""
+    src = '''
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    MESH = Mesh(devs, ("data", "model"))
+    GOOD = P("data", "model")
+    BAD = P(None, "pipe")
+    '''
+    assert only(src, "spec-axis-outside-mesh") == [6]
+    assert only(src, "unknown-axis-in-partition-spec",
+                path=MODELS_PATH) == []
+
+
+def test_spec_axis_outside_mesh_resolves_axis_order_and_constants():
+    """make_mesh's axis_order= kwarg declares the mesh too, through
+    the exported axis constants; spec entries resolve through local
+    aliases exactly like the vocabulary rule."""
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, MeshSpec, make_mesh)
+
+    MESH = make_mesh(MeshSpec(data=2, model=2),
+                     axis_order=(DATA_AXIS, MODEL_AXIS))
+
+    def specs(model_degree=1):
+        m = MODEL_AXIS if model_degree > 1 else None
+        return {"w": P(None, m), "x": P(DATA_AXIS), "bad": P("expert")}
+    '''
+    assert only(src, "spec-axis-outside-mesh") == [11]
+
+
+def test_spec_axis_outside_mesh_opaque_builder_stays_silent():
+    """An unresolvable axis tuple (a parameter, a computed value)
+    means the run-time axis set is unknowable — the rule must not
+    guess.  parallel/mesh.py itself is this shape, which is why the
+    shipped baseline stays empty."""
+    src = '''
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    def build(devs, axis_order):
+        return Mesh(devs, axis_order)
+
+    SPEC = P("pipe", "expert")
+    '''
+    assert only(src, "spec-axis-outside-mesh") == []
+
+
+def test_spec_axis_outside_mesh_no_builder_out_of_scope():
+    src = '''
+    from jax.sharding import PartitionSpec as P
+    SPEC = P("pipe")
+    '''
+    assert only(src, "spec-axis-outside-mesh") == []
+
+
+def test_spec_axis_outside_mesh_suppression_and_registry():
+    sup = '''
+    from jax.sharding import Mesh, PartitionSpec as P
+    MESH = Mesh(devs, ("data",))
+    SPEC = P("model")  # jaxlint: disable=spec-axis-outside-mesh — fixture
+    '''
+    assert only(sup, "spec-axis-outside-mesh") == []
+    assert REGISTRY["spec-axis-outside-mesh"].family == "sharding-layout"

@@ -296,10 +296,13 @@ def test_elastic_remesh_shrinks_data_axis_only(devices):
         elastic_remesh(m14, lost_ids=[0])
     with pytest.raises(ValueError, match="required divisor 4"):
         elastic_remesh(m14, lost_ids=[0])
-    # pipe/seq/expert still refuse outright
+    # non-data axes survive a shrink intact: a data×seq mesh drops the
+    # data replica and keeps the whole seq group (PR 18 generalized the
+    # model-group logic to model×pipe×seq×expert)
     mseq = make_mesh(MeshSpec(data=2, seq=2), devices=jax.devices()[:4])
-    with pytest.raises(ValueError, match="seq"):
-        elastic_remesh(mseq, lost_ids=[0])
+    new_mesh, new_accum = elastic_remesh(mseq, lost_ids=[0], grad_accum=1)
+    assert new_mesh.shape["data"] == 1 and new_mesh.shape["seq"] == 2
+    assert new_accum == 2
 
 
 def test_resilient_fit_data_model_resume_bit_exact(devices, tmp_path):

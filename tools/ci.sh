@@ -79,8 +79,11 @@ JAX_PLATFORMS=cpu python -m tools.preemption_drill || exit 1
 # re-mesh resume bit-exact) + a REAL 2-process jax.distributed drill
 # (join, control plane, cluster-committed snapshots, SIGKILLed host ->
 # survivor restore) — skipping the 2-process half cleanly where
-# bring-up is unavailable.  The ROADMAP item 2 contract.
-echo "[ci] multihost gate"
+# bring-up is unavailable.  The ROADMAP item 2 contract.  Phase C is
+# the ISSUE 18 two-shape 4D drill: training at two mesh shapes that
+# differ only in pipe degree must be bit-exact, donation intact,
+# compile_delta==0 when warmed.
+echo "[ci] multihost gate (incl. two-shape 4D drill)"
 JAX_PLATFORMS=cpu python -m tools.multihost_gate || exit 1
 
 if [ "${1:-}" = "--slow" ]; then

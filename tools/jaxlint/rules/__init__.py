@@ -17,7 +17,8 @@ Six families:
               barrier/commit protocols
 - sharding-layout (PR 15): unknown-axis-in-partition-spec,
               spec-without-divisibility-guard — the PR 12 GSPMD weight
-              layout contracts
+              layout contracts — and spec-axis-outside-mesh (PR 18):
+              specs must draw axes from the module's own declared mesh
 - compile-stability (PR 15): unstable-cache-key,
               host-sync-on-serving-worker — the zero-steady-state-
               compile and never-stall-the-decode-worker invariants of
@@ -35,6 +36,7 @@ from tools.jaxlint.rules import (  # noqa: F401
     host_sync,
     impure_jit,
     impure_signal_handler,
+    mesh_axes,
     partition_spec,
     raw_shard_map,
     serving_worker_sync,

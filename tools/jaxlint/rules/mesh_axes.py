@@ -1,0 +1,109 @@
+"""spec-axis-outside-mesh: PartitionSpec axes must come from the
+module's own declared mesh axes.
+
+``unknown-axis-in-partition-spec`` checks specs against the
+package-wide vocabulary (``parallel/mesh.ALL_AXES``) — a typo net.
+This rule is stricter where the module itself pins the mesh shape: a
+module that constructs its mesh with an explicit LITERAL axis tuple
+(``Mesh(devs, ("data", "model"))`` or ``make_mesh(spec,
+axis_order=(DATA_AXIS, MODEL_AXIS))``) has declared, in source, which
+axes exist at run time.  A ``P(..., "pipe")`` in that module names an
+axis the mesh will never carry — NamedSharding construction raises
+``KeyError``/``ValueError`` only when the spec is consumed, on the
+pod, far from the literal that caused it (the 4D-parallelism PR made
+this a real hazard: five package axes, but any given mesh binds only
+the ones its builder listed).
+
+Mechanics: collect every mesh-builder call in the module whose axis
+tuple is a resolvable literal (string constants, the exported axis
+constants, local aliases).  If any builder's tuple is opaque — a
+parameter, a computed value — the module's run-time axis set is
+unknowable and the rule stays silent (``parallel/mesh.py`` itself,
+whose ``axis_order`` is a parameter, is the canonical example; that is
+why the baseline is empty).  Otherwise every resolvable PartitionSpec
+entry must name a declared axis.  The runtime twin of this check is
+``sharded_fit.validate_specs_against_mesh``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.jaxlint import astutil
+from tools.jaxlint.core import Finding, Rule, register
+
+#: callables that bind a mesh's axis-name tuple, and where the tuple
+#: lives in each: ``Mesh(devs, axis_names)`` — positional slot 1 or
+#: ``axis_names=``; repo ``make_mesh(spec, devices, axis_order)`` —
+#: ``axis_order=`` (positional use would be slot 2, but the repo
+#: spells it as a keyword; an unrecognised spelling is simply not a
+#: declaration, never a false positive).
+_MESH_BUILDERS = {"Mesh": (1, ("axis_names",)),
+                  "make_mesh": (None, ("axis_order", "axis_names"))}
+
+
+def _axis_tuple_expr(call: ast.Call) -> Optional[ast.AST]:
+    leaf = (astutil.dotted_name(call.func) or "").rsplit(".", 1)[-1]
+    slot_kws = _MESH_BUILDERS.get(leaf)
+    if slot_kws is None:
+        return None
+    slot, kws = slot_kws
+    for kw in call.keywords:
+        if kw.arg in kws:
+            return kw.value
+    if slot is not None and len(call.args) > slot:
+        return call.args[slot]
+    return None
+
+
+@register
+class SpecAxisOutsideMeshRule(Rule):
+    name = "spec-axis-outside-mesh"
+    severity = "error"
+    family = "sharding-layout"
+    description = ("PartitionSpec names an axis the module's own mesh "
+                   "builder never declares — the NamedSharding fails "
+                   "when consumed on the pod, not at build time")
+
+    def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        calls = astutil.partition_spec_calls(tree)
+        if not calls:
+            return
+        chain = astutil.enclosing_chain(tree)
+
+        declared: Set[str] = set()
+        builders: List[ast.Call] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            axes_expr = _axis_tuple_expr(node)
+            if axes_expr is None:
+                continue
+            builders.append(node)
+            if not isinstance(axes_expr, (ast.Tuple, ast.List)):
+                return          # opaque tuple: run-time axes unknowable
+            for elt in axes_expr.elts:
+                values = astutil.resolve_axis_entry(
+                    elt, tree, chain.get(id(elt), []))
+                if not values:
+                    return      # opaque element: same story
+                declared |= values
+        if not builders:
+            return              # module declares no mesh — out of scope
+
+        for call in calls:
+            for entry in astutil.partition_spec_entries(call):
+                values = astutil.resolve_axis_entry(
+                    entry, tree, chain.get(id(entry), []))
+                if values is None:
+                    continue
+                loose = sorted(v for v in values if v not in declared)
+                if loose:
+                    yield self.finding(
+                        posix_path, call,
+                        f"PartitionSpec names axis {loose[0]!r}, but this "
+                        "module's mesh builder only declares "
+                        f"({', '.join(sorted(declared))}) — the sharding "
+                        "fails when the spec is consumed on the target "
+                        "mesh, not here")

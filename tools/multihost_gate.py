@@ -30,6 +30,11 @@ form cross-process device computations:
    cluster-committed snapshot restores, and the finished run is
    bit-exact vs an uninterrupted single-process run.
 
+**Phase C — two-shape 4D drill (ISSUE 18, always runs).**  The same
+``CausalLM`` trained at two 3D mesh shapes differing only in pipe
+degree must be byte-identical, with ``compile_delta == 0`` on the
+warmed steady-state fit and no copy-on-donate warnings.
+
 Exits 0 with a SKIP note for phase B when 2-process bring-up is
 unavailable or times out; any contract violation exits non-zero.
 """
@@ -303,10 +308,78 @@ def phase_b(tmp: str) -> bool:
     return True
 
 
+def phase_c() -> None:
+    """Two-shape 4D drill (ISSUE 18 tentpole proof): the same CausalLM
+    trained at two 3D mesh shapes differing ONLY in pipe degree —
+    (2,2,2) on 8 chips vs (2,2,1) on 4 — must produce byte-identical
+    final params (pipe moves the stacked-layer LAYOUT, never the
+    reduction order), with the warmed steady-state fit showing
+    ``compile_delta == 0`` and zero copy-on-donate warnings (donation
+    survives the 4D layouts)."""
+    import dataclasses
+    import warnings
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import gpt
+    from deeplearning4j_tpu.models.lm_fit import CausalLM
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.runtime.telemetry import registry
+
+    cfg = dataclasses.replace(gpt.gpt_tiny(vocab_size=64, max_len=16),
+                              hidden=32, n_layers=4, n_heads=4,
+                              ffn_dim=64, compute_dtype="float32")
+    rng = np.random.RandomState(0)
+    batches = [DataSet(jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32),
+                       jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32))
+               for _ in range(2)]
+
+    def fit(mesh):
+        net = CausalLM(cfg, lr=0.05, momentum=0.9,
+                       pipe_microbatches=2).init(0)
+        net.fit_backprop(batches, num_epochs=2, mesh=mesh)
+        return net
+
+    mesh_a = make_mesh(MeshSpec(data=2, model=2, pipe=2),
+                       devices=jax.devices()[:8])
+    mesh_b = make_mesh(MeshSpec(data=2, model=2, pipe=1),
+                       devices=jax.devices()[:4])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        fit(mesh_a)                           # compiles banked
+        registry.mark()
+        net_a = fit(mesh_a)                   # warmed steady state
+        delta = registry.compile_delta_since_mark()
+        net_b = fit(mesh_b)
+    donate = [w for w in caught if "donat" in str(w.message).lower()]
+    if donate:
+        print(f"[multihost-gate] FAIL: {len(donate)} copy-on-donate "
+              f"warning(s) on the 4D fit: {donate[0].message}")
+        sys.exit(1)
+    if delta != 0:
+        print(f"[multihost-gate] FAIL: warmed (2,2,2) fit compiled "
+              f"{delta} new program(s)")
+        sys.exit(1)
+    pa = np.asarray(net_a.params_flat())
+    pb = np.asarray(net_b.params_flat())
+    if not (np.isfinite(pa).all() and np.array_equal(pa, pb)):
+        print(f"[multihost-gate] FAIL: two-shape drill not bit-exact "
+              f"(finite={np.isfinite(pa).all()}, "
+              f"max|a-b|={np.abs(pa - pb).max()})")
+        sys.exit(1)
+    print("[multihost-gate] phase C ok: (2,2,2) vs (2,2,1) training "
+          "bit-exact, warmed steady-state compile_delta=0, donation "
+          "clean")
+
+
 def main() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         phase_a(tmp)
         phase_b(tmp)
+    phase_c()
     print("[multihost-gate] ok")
     return 0
 
