@@ -2425,3 +2425,730 @@ def test_spec_axis_outside_mesh_suppression_and_registry():
     '''
     assert only(sup, "spec-axis-outside-mesh") == []
     assert REGISTRY["spec-axis-outside-mesh"].family == "sharding-layout"
+
+
+# ---------------------------------------------------------------------------
+# PR 19: two-pass linked analysis — summaries, linking, cross-module rules
+# ---------------------------------------------------------------------------
+
+from tools.jaxlint.link import check_linked_sources, link_sources  # noqa: E402
+
+
+def linked_only(srcs, rule):
+    """(path, line) pairs at which ``rule`` fired across a linked
+    in-memory fixture tree."""
+    out = []
+    for path, findings in sorted(check_linked_sources(srcs).items()):
+        out.extend((path, f.line) for f in findings if f.rule == rule)
+    return out
+
+
+_ALLOCATOR_MOD = '''\
+class KVPagesExhausted(RuntimeError):
+    pass
+
+class PageAllocator:
+    def alloc(self, n):
+        return list(range(n))
+    def share(self, pids):
+        return pids
+    def free(self, pids):
+        pass
+'''
+
+
+def test_registry_ships_cross_module_family():
+    cross = {"cross-module-use-after-donate", "cross-module-spec-mesh",
+             "page-refcount-balance", "unstable-imported-cache-key"}
+    assert cross <= set(REGISTRY)
+    assert len(REGISTRY) >= 21
+    for name in cross:
+        assert REGISTRY[name].family == "cross-module"
+        assert REGISTRY[name].requires_link
+    # and no other rule requires linking
+    for name, rule in REGISTRY.items():
+        if name not in cross:
+            assert not rule.requires_link
+
+
+def test_cross_module_rules_skipped_without_link_context():
+    """A single-module check_source call (no LinkContext) must not
+    half-run a linking rule — it is skipped entirely."""
+    src = '''
+    from pkg.dep import train
+    def go(params, batch):
+        out = train(params, batch)
+        print(params)
+    '''
+    assert fired(src, path="pkg/use.py") == []
+
+
+# -- cross-module-use-after-donate ------------------------------------------
+
+_DONATING_DEP = '''\
+from runtime.compile_cache import cached_jit
+
+def train(params, batch):
+    step = cached_jit(_body, donate_argnums=(0,))
+    return step(params, batch)
+'''
+
+
+def test_cross_module_donate_flags_read_after_call():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/dep.py": _DONATING_DEP,
+        "pkg/use.py": ("from pkg.dep import train\n"
+                       "def go(params, batch):\n"
+                       "    out = train(params, batch)\n"
+                       "    print(params)\n"
+                       "    return out\n"),
+    }
+    assert linked_only(srcs, "cross-module-use-after-donate") \
+        == [("pkg/use.py", 4)]
+    # the message carries the summary provenance: module + position
+    (f,) = check_linked_sources(srcs)["pkg/use.py"]
+    assert "pkg.dep" in f.message and "donates positional arg" in f.message
+
+
+def test_cross_module_donate_rebind_from_result_is_clean():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/dep.py": _DONATING_DEP,
+        "pkg/use.py": ("from pkg.dep import train\n"
+                       "def go(params, batch):\n"
+                       "    params = train(params, batch)\n"
+                       "    return params\n"),
+    }
+    assert linked_only(srcs, "cross-module-use-after-donate") == []
+
+
+def test_cross_module_donate_forwarding_chain_links():
+    """A re-export wrapper donates too: the linker closes donation over
+    forwarding chains, so the fact crosses TWO module boundaries."""
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/dep.py": _DONATING_DEP,
+        "pkg/wrap.py": ("from pkg.dep import train\n"
+                        "def fit(params, batch):\n"
+                        "    return train(params, batch)\n"),
+        "pkg/use.py": ("from pkg.wrap import fit\n"
+                       "def go(params, batch):\n"
+                       "    out = fit(params, batch)\n"
+                       "    print(params)\n"),
+    }
+    assert linked_only(srcs, "cross-module-use-after-donate") \
+        == [("pkg/use.py", 4)]
+
+
+def test_cross_module_donate_suppression():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/dep.py": _DONATING_DEP,
+        "pkg/use.py": (
+            "from pkg.dep import train\n"
+            "def go(params, batch):\n"
+            "    out = train(params, batch)\n"
+            "    print(params)  # jaxlint: disable=cross-module-use-after-donate — fixture\n"),
+    }
+    assert linked_only(srcs, "cross-module-use-after-donate") == []
+
+
+# -- cross-module-spec-mesh -------------------------------------------------
+
+_SPEC_FACTORY = '''\
+from jax.sharding import PartitionSpec as P
+
+def shard_specs(conf):
+    return {"w": P("model", None), "b": P(None)}
+'''
+
+
+def test_cross_module_spec_mesh_flags_undeclared_axis():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/gpt.py": _SPEC_FACTORY,
+        "pkg/driver.py": ("from jax.sharding import Mesh\n"
+                          "from pkg.gpt import shard_specs\n"
+                          "def run(devs, conf):\n"
+                          "    mesh = Mesh(devs, ('data',))\n"
+                          "    return mesh, shard_specs(conf)\n"),
+    }
+    assert linked_only(srcs, "cross-module-spec-mesh") \
+        == [("pkg/driver.py", 5)]
+    (f,) = check_linked_sources(srcs)["pkg/driver.py"]
+    assert "pkg.gpt" in f.message and "'model'" in f.message
+
+
+def test_cross_module_spec_mesh_declared_axis_is_clean():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/gpt.py": _SPEC_FACTORY,
+        "pkg/driver.py": ("from jax.sharding import Mesh\n"
+                          "from pkg.gpt import shard_specs\n"
+                          "def run(devs, conf):\n"
+                          "    mesh = Mesh(devs, ('data', 'model'))\n"
+                          "    return mesh, shard_specs(conf)\n"),
+    }
+    assert linked_only(srcs, "cross-module-spec-mesh") == []
+
+
+def test_cross_module_spec_mesh_abstains_without_local_mesh():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/gpt.py": _SPEC_FACTORY,
+        "pkg/driver.py": ("from pkg.gpt import shard_specs\n"
+                          "def run(conf):\n"
+                          "    return shard_specs(conf)\n"),
+    }
+    assert linked_only(srcs, "cross-module-spec-mesh") == []
+
+
+def test_cross_module_spec_mesh_abstains_on_opaque_mesh_or_specs():
+    # opaque mesh tuple: run-time axes unknowable
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/gpt.py": _SPEC_FACTORY,
+        "pkg/driver.py": ("from jax.sharding import Mesh\n"
+                          "from pkg.gpt import shard_specs\n"
+                          "def run(devs, conf, axis_order):\n"
+                          "    mesh = Mesh(devs, axis_order)\n"
+                          "    return mesh, shard_specs(conf)\n"),
+    }
+    assert linked_only(srcs, "cross-module-spec-mesh") == []
+    # opaque factory (spec entry not resolvable): summary abstains
+    srcs["pkg/gpt.py"] = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "def shard_specs(conf, ax):\n"
+        "    return {'w': P(ax)}\n")
+    srcs["pkg/driver.py"] = (
+        "from jax.sharding import Mesh\n"
+        "from pkg.gpt import shard_specs\n"
+        "def run(devs, conf):\n"
+        "    mesh = Mesh(devs, ('data',))\n"
+        "    return mesh, shard_specs(conf, 'model')\n")
+    assert linked_only(srcs, "cross-module-spec-mesh") == []
+
+
+def test_cross_module_spec_mesh_suppression():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/gpt.py": _SPEC_FACTORY,
+        "pkg/driver.py": (
+            "from jax.sharding import Mesh\n"
+            "from pkg.gpt import shard_specs\n"
+            "def run(devs, conf):\n"
+            "    mesh = Mesh(devs, ('data',))\n"
+            "    return mesh, shard_specs(conf)  # jaxlint: disable=cross-module-spec-mesh — host-only specs\n"),
+    }
+    assert linked_only(srcs, "cross-module-spec-mesh") == []
+
+
+# -- page-refcount-balance --------------------------------------------------
+
+def test_page_refcount_pr17_reconstruction_flags_handler_raise():
+    """The shipped incident, as a fixture: pages alloc'd BEFORE a try,
+    freed only in the try body, re-raised from the handler — the
+    exception path leaks the pages (this is the leak the PR 17 finally
+    fixed)."""
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/admit.py": (
+            "from pkg.alloc import PageAllocator, KVPagesExhausted\n"
+            "def admit(pool: PageAllocator, req):\n"
+            "    pages = pool.alloc(req.n)\n"
+            "    try:\n"
+            "        dispatch(req, pages)\n"
+            "        pool.free(pages)\n"
+            "    except KVPagesExhausted:\n"
+            "        raise\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") \
+        == [("pkg/admit.py", 8)]
+    (f,) = check_linked_sources(srcs)["pkg/admit.py"]
+    assert "raise" in f.message and "pkg.alloc" in f.message
+
+
+def test_page_refcount_finally_fix_is_clean():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/admit.py": (
+            "from pkg.alloc import PageAllocator\n"
+            "def admit(pool: PageAllocator, req):\n"
+            "    pages = pool.alloc(req.n)\n"
+            "    try:\n"
+            "        dispatch(req, pages)\n"
+            "    finally:\n"
+            "        pool.free(pages)\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") == []
+
+
+def test_page_refcount_handler_that_frees_before_reraise_is_clean():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/admit.py": (
+            "from pkg.alloc import PageAllocator, KVPagesExhausted\n"
+            "def admit(pool: PageAllocator, req):\n"
+            "    pages = pool.alloc(req.n)\n"
+            "    try:\n"
+            "        dispatch(req, pages)\n"
+            "        pool.free(pages)\n"
+            "    except KVPagesExhausted:\n"
+            "        pool.free(pages)\n"
+            "        raise\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") == []
+
+
+def test_page_refcount_call_argument_is_not_a_transfer():
+    """dispatch(pages) then falling off the end IS the leak shape —
+    passing the name as a call argument transfers nothing."""
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/go.py": ("from pkg.alloc import PageAllocator\n"
+                      "def go(pool: PageAllocator, n):\n"
+                      "    pages = pool.alloc(n)\n"
+                      "    dispatch(pages)\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") \
+        == [("pkg/go.py", 3)]
+
+
+def test_page_refcount_ownership_transfers_are_silent():
+    base = {"pkg/__init__.py": "", "pkg/alloc.py": _ALLOCATOR_MOD}
+    for body in (
+            "    return pages\n",                 # returned
+            "    slot.pages = pages\n",           # stored into an attr
+            "    table[k] = pages\n",             # stored into a subscript
+            "    queue.append(pages)\n"):         # handed to a container
+        srcs = dict(base)
+        srcs["pkg/go.py"] = ("from pkg.alloc import PageAllocator\n"
+                             "def go(pool: PageAllocator, n, slot, table,"
+                             " queue, k):\n"
+                             "    pages = pool.alloc(n)\n" + body)
+        assert linked_only(srcs, "page-refcount-balance") == [], body
+
+
+def test_page_refcount_discard_and_share_and_conditional_free():
+    base = {"pkg/__init__.py": "", "pkg/alloc.py": _ALLOCATOR_MOD}
+    # result discarded on the spot
+    srcs = dict(base)
+    srcs["pkg/go.py"] = ("from pkg.alloc import PageAllocator\n"
+                         "def go(pool: PageAllocator, n):\n"
+                         "    pool.alloc(n)\n")
+    assert linked_only(srcs, "page-refcount-balance") \
+        == [("pkg/go.py", 3)]
+    # share takes a reference too — receiver typed via constructor
+    srcs = dict(base)
+    srcs["pkg/go.py"] = ("from pkg.alloc import PageAllocator\n"
+                         "def go(pages):\n"
+                         "    pool = PageAllocator()\n"
+                         "    pool.share(pages)\n"
+                         "    broadcast(pages)\n")
+    assert linked_only(srcs, "page-refcount-balance") \
+        == [("pkg/go.py", 4)]
+    # released only inside a branch: the normal path leaks
+    srcs = dict(base)
+    srcs["pkg/go.py"] = ("from pkg.alloc import PageAllocator\n"
+                         "def go(pool: PageAllocator, n, cond):\n"
+                         "    pages = pool.alloc(n)\n"
+                         "    if cond:\n"
+                         "        pool.free(pages)\n")
+    assert linked_only(srcs, "page-refcount-balance") \
+        == [("pkg/go.py", 3)]
+
+
+def test_page_refcount_abstains_when_acquire_inside_try_body():
+    """An except handler of the try whose BODY holds the alloc may run
+    with the alloc never having happened (the alloc itself raised) —
+    the rule cannot prove a leak there (decode.py's prefill shape)."""
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/go.py": ("from pkg.alloc import PageAllocator\n"
+                      "def go(pool: PageAllocator, b, slot, n):\n"
+                      "    try:\n"
+                      "        fresh = pool.alloc(n)\n"
+                      "    except RuntimeError:\n"
+                      "        raise\n"
+                      "    b.ptab[slot] = fresh\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") == []
+
+
+def test_page_refcount_self_attr_receiver_and_early_return():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/engine.py": (
+            "from pkg.alloc import PageAllocator\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._pool = PageAllocator()\n"
+            "    def step(self, n, cond):\n"
+            "        pages = self._pool.alloc(n)\n"
+            "        if cond:\n"
+            "            return None\n"
+            "        run(pages)\n"
+            "        self._pool.free(pages)\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") \
+        == [("pkg/engine.py", 8)]
+
+
+def test_page_refcount_suppression():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/alloc.py": _ALLOCATOR_MOD,
+        "pkg/go.py": (
+            "from pkg.alloc import PageAllocator\n"
+            "def go(pool: PageAllocator, n):\n"
+            "    pages = pool.alloc(n)  # jaxlint: disable=page-refcount-balance — freed by callee\n"
+            "    dispatch(pages)\n"),
+    }
+    assert linked_only(srcs, "page-refcount-balance") == []
+
+
+# -- unstable-imported-cache-key --------------------------------------------
+
+_KEY_HELPERS = '''\
+import time
+import json
+
+def run_tag():
+    return f"run-{time.time()}"
+
+def conf_key(conf):
+    return json.dumps(conf, sort_keys=True)
+'''
+
+
+def test_unstable_imported_cache_key_flags_and_carries_reason():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": _KEY_HELPERS,
+        "pkg/use.py": (
+            "from runtime.compile_cache import cached_jit\n"
+            "from pkg.keys import run_tag\n"
+            "def build(step):\n"
+            "    return cached_jit(step, key=run_tag())\n"),
+    }
+    assert linked_only(srcs, "unstable-imported-cache-key") \
+        == [("pkg/use.py", 4)]
+    (f,) = check_linked_sources(srcs)["pkg/use.py"]
+    assert "pkg.keys" in f.message and "time.time()" in f.message
+
+
+def test_unstable_imported_cache_key_transitive_provenance():
+    """Impurity two modules deep still reaches the call site, and the
+    reason names the chain."""
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": _KEY_HELPERS,
+        "pkg/mid.py": ("from pkg.keys import run_tag\n"
+                       "def wrapper():\n"
+                       "    return run_tag()\n"),
+        "pkg/use.py": (
+            "from runtime.compile_cache import cached_jit\n"
+            "from pkg.mid import wrapper\n"
+            "def build(step):\n"
+            "    return cached_jit(step, key=wrapper())\n"),
+    }
+    assert linked_only(srcs, "unstable-imported-cache-key") \
+        == [("pkg/use.py", 4)]
+    (f,) = check_linked_sources(srcs)["pkg/use.py"]
+    assert "wrapper" in f.message and "run_tag" in f.message
+
+
+def test_unstable_imported_cache_key_pure_helper_is_clean():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": _KEY_HELPERS,
+        "pkg/use.py": (
+            "from runtime.compile_cache import cached_jit\n"
+            "from pkg.keys import conf_key\n"
+            "def build(step, conf):\n"
+            "    return cached_jit(step, key=conf_key(conf))\n"),
+    }
+    assert linked_only(srcs, "unstable-imported-cache-key") == []
+
+
+def test_unstable_imported_cache_key_suppression():
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": _KEY_HELPERS,
+        "pkg/use.py": (
+            "from runtime.compile_cache import cached_jit\n"
+            "from pkg.keys import run_tag\n"
+            "def build(step):\n"
+            "    return cached_jit(step, key=run_tag())  # jaxlint: disable=unstable-imported-cache-key — bench harness\n"),
+    }
+    assert linked_only(srcs, "unstable-imported-cache-key") == []
+
+
+# -- linking mechanics ------------------------------------------------------
+
+def test_import_cycle_summaries_converge():
+    """Mutually importing modules must link by fixpoint, not recursion:
+    donation and purity facts settle, and no RecursionError escapes."""
+    srcs = {
+        "pkg/__init__.py": "",
+        "pkg/a.py": ("from runtime.compile_cache import cached_jit\n"
+                     "from pkg.b import pong\n"
+                     "def ping(params, batch):\n"
+                     "    step = cached_jit(_body, donate_argnums=(0,))\n"
+                     "    return step(params, batch)\n"
+                     "def akey():\n"
+                     "    return pong()\n"),
+        "pkg/b.py": ("import time\n"
+                     "from pkg.a import ping\n"
+                     "def fit(params, batch):\n"
+                     "    return ping(params, batch)\n"
+                     "def pong():\n"
+                     "    return time.time()\n"),
+    }
+    ctxs = link_sources(srcs)
+    (_tree, ctx) = ctxs["pkg/a.py"]
+    # donation flowed a -> b through the cycle
+    assert ctx.function_summary("pkg.b", "fit")["donates_linked"] == [0]
+    # impurity flowed b -> a through the cycle, with provenance
+    akey = ctx.function_summary("pkg.a", "akey")
+    assert akey["key_pure"] is False
+    assert "pong" in akey["key_impure_reason"]
+
+
+# -- summary cache + dependency-aware result cache --------------------------
+
+_DEP_DONATING = '''\
+from runtime.compile_cache import cached_jit
+
+def train(params, batch):
+    step = cached_jit(_body, donate_argnums=(0,))
+    return step(params, batch)
+'''
+
+_DEP_PLAIN = '''\
+def train(params, batch):
+    return _body(params, batch)
+'''
+
+_USE_SRC = '''\
+from pkg.dep import train
+
+def go(params, batch):
+    out = train(params, batch)
+    print(params)
+    return out
+'''
+
+
+def _linked_pkg(tmp_path, dep_src=_DEP_DONATING):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "dep.py").write_text(dep_src)
+    (pkg / "use.py").write_text(_USE_SRC)
+    return pkg
+
+
+def test_warm_run_reextracts_zero_summaries(tmp_path):
+    """The acceptance criterion: a warm re-run with nothing changed
+    re-extracts NO summaries — every one is served from the store."""
+    pkg = _linked_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    stats: dict = {}
+    run_paths([pkg], cache_path=cache, stats=stats)
+    assert stats["summaries_extracted"] >= 3  # pkg + dep + use
+    assert stats["summaries_cached"] == 0
+    stats2: dict = {}
+    findings = run_paths([pkg], cache_path=cache, stats=stats2)
+    assert stats2["summaries_extracted"] == 0
+    assert stats2["summaries_cached"] == stats["summaries_extracted"]
+    assert [f.rule for f in findings] == ["cross-module-use-after-donate"]
+
+
+def test_dependency_edit_relinks_importer(tmp_path):
+    """The v4 staleness fix: editing dep.py's CONTRACT must re-lint
+    use.py even though use.py's own text (and cache key) is unchanged."""
+    pkg = _linked_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    f1 = run_paths([pkg], cache_path=cache)
+    assert [f.rule for f in f1] == ["cross-module-use-after-donate"]
+    # dependency stops donating: the importer's finding must vanish
+    (pkg / "dep.py").write_text(_DEP_PLAIN)
+    stats: dict = {}
+    f2 = run_paths([pkg], cache_path=cache, stats=stats)
+    assert f2 == []
+    assert stats["summaries_extracted"] == 1  # only dep re-extracted
+    # and back: the finding returns (nothing stale in either direction)
+    (pkg / "dep.py").write_text(_DEP_DONATING)
+    f3 = run_paths([pkg], cache_path=cache)
+    assert [f.rule for f in f3] == ["cross-module-use-after-donate"]
+
+
+def test_docstring_only_dep_edit_keeps_importer_cached(tmp_path):
+    """Summary fingerprints are content hashes of the SUMMARY, not the
+    source: a docstring edit in dep.py re-extracts dep's summary but
+    must not re-lint use.py.  Proven by poisoning use.py's cache entry
+    — the poison is served only if the cache hit."""
+    pkg = _linked_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    run_paths([pkg], cache_path=cache)
+    data = json.loads(cache.read_text())
+    use_key = next(k for k in data if k.endswith("use.py"))
+    data[use_key]["findings"] = []          # poison
+    cache.write_text(json.dumps(data))
+    (pkg / "dep.py").write_text('"""docs only."""\n' + _DEP_DONATING)
+    f = run_paths([pkg], cache_path=cache)
+    assert f == []                          # poison served: cache hit
+    # whereas a contract edit busts it (the poison is NOT served)
+    data = json.loads(cache.read_text())
+    data[use_key]["findings"] = []
+    cache.write_text(json.dumps(data))
+    (pkg / "dep.py").write_text(_DEP_PLAIN + "\ndef extra():\n    pass\n")
+    (pkg / "dep.py").write_text(_DEP_DONATING.replace(
+        "donate_argnums=(0,)", "donate_argnums=(0, 1)"))
+    f = run_paths([pkg], cache_path=cache)
+    assert [x.rule for x in f] == ["cross-module-use-after-donate"]
+
+
+def test_module_rename_invalidates_importer(tmp_path):
+    """Renaming dep.py changes use.py's resolvable dependency set, so
+    its cached (linked) result must not be served."""
+    pkg = _linked_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    f1 = run_paths([pkg], cache_path=cache)
+    assert [f.rule for f in f1] == ["cross-module-use-after-donate"]
+    data = json.loads(cache.read_text())
+    use_key = next(k for k in data if k.endswith("use.py"))
+    bogus = dict(data[use_key]["findings"][0])
+    bogus["message"] = "stale-poison"
+    data[use_key]["findings"] = [bogus]
+    cache.write_text(json.dumps(data))
+    (pkg / "dep.py").rename(pkg / "helper.py")
+    f2 = run_paths([pkg], cache_path=cache)
+    # the import no longer resolves: no summary, no cross-module
+    # finding — and the poisoned stale entry was NOT served
+    assert not any(x.message == "stale-poison" for x in f2)
+    assert [x.rule for x in f2] == []
+
+
+def test_schema_bump_discards_store_and_reextracts(tmp_path, monkeypatch):
+    """A summary-schema version bump must re-extract EVERYTHING — the
+    store is discarded whole, never half-read."""
+    from tools.jaxlint import summary as summary_mod
+
+    pkg = _linked_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    stats: dict = {}
+    run_paths([pkg], cache_path=cache, stats=stats)
+    total = stats["summaries_extracted"]
+    monkeypatch.setattr(summary_mod, "SCHEMA_VERSION",
+                        summary_mod.SCHEMA_VERSION + 1)
+    stats2: dict = {}
+    run_paths([pkg], cache_path=cache, stats=stats2)
+    assert stats2["summaries_extracted"] == total
+    assert stats2["summaries_cached"] == 0
+    # warm again under the NEW schema: fully cached once more
+    stats3: dict = {}
+    run_paths([pkg], cache_path=cache, stats=stats3)
+    assert stats3["summaries_extracted"] == 0
+
+
+def test_linked_jobs_output_is_deterministic(tmp_path, capsys):
+    """--jobs N determinism holds for the linked pipeline too: the
+    summary table is read-only during pass 2, results stitch back in
+    file order (ISSUE 19 satellite #3)."""
+    pkg = _linked_pkg(tmp_path)
+    for i in range(4):
+        (pkg / f"use{i}.py").write_text(_USE_SRC)
+    outs = []
+    for jobs in ("1", "4"):
+        assert jaxlint_main([str(pkg), "--no-baseline",
+                             "--jobs", jobs]) == 1
+        outs.append(capsys.readouterr().out)
+    assert outs[0] == outs[1]
+    assert outs[0].count("cross-module-use-after-donate") == 5
+
+
+# -- CLI: --dump-summaries, --no-link, json timings, baseline ---------------
+
+def test_cli_dump_summaries_module(tmp_path, capsys):
+    pkg = _linked_pkg(tmp_path)
+    assert jaxlint_main(["--dump-summaries=pkg.dep", str(pkg)]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["module"] == "pkg.dep"
+    assert data["functions"]["train"]["donates_linked"] == [0]
+
+
+def test_cli_dump_summaries_all_and_unknown_module(tmp_path, capsys):
+    pkg = _linked_pkg(tmp_path)
+    # flag LAST: the nargs="?" form would swallow a following path as
+    # the module name (the help text says --dump-summaries=MODULE)
+    assert jaxlint_main([str(pkg), "--dump-summaries"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert {"pkg", "pkg.dep", "pkg.use"} <= set(data)
+    assert jaxlint_main(["--dump-summaries=no.such.mod", str(pkg)]) == 2
+    assert "no export summary" in capsys.readouterr().err
+
+
+def test_cli_format_json_reports_pass_timings(tmp_path, capsys):
+    pkg = _linked_pkg(tmp_path)
+    assert jaxlint_main([str(pkg), "--no-baseline",
+                         "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["summary_ms"] >= 0.0 and data["link_ms"] >= 0.0
+    assert data["summaries_extracted"] >= 3
+    (rec,) = [r for r in data["findings"]
+              if r["rule"] == "cross-module-use-after-donate"]
+    assert rec["family"] == "cross-module"
+
+
+def test_cli_no_link_skips_cross_module_rules(tmp_path, capsys):
+    pkg = _linked_pkg(tmp_path)
+    assert jaxlint_main([str(pkg), "--no-baseline", "--no-link",
+                         "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"] == []
+    assert data["summaries_extracted"] == 0
+
+
+def test_write_baseline_round_trips_cross_module_findings(tmp_path,
+                                                          capsys):
+    """A cross-module finding baselines like any other: location is the
+    CALL SITE (consumer file), and a subsequent run is clean against
+    the written baseline (ISSUE 19 satellite #5)."""
+    pkg = _linked_pkg(tmp_path)
+    bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(pkg), "--baseline", str(bl),
+                         "--write-baseline"]) == 0
+    capsys.readouterr()
+    entries = json.loads(bl.read_text())["entries"]
+    (entry,) = [e for e in entries
+                if e["rule"] == "cross-module-use-after-donate"]
+    assert entry["path"].endswith("use.py")     # call site, not callee
+    assert jaxlint_main([str(pkg), "--baseline", str(bl)]) == 0
+
+
+# -- docs drift guard -------------------------------------------------------
+
+def test_readme_rule_table_matches_registry():
+    """The README 'Static analysis' rule tables must name EXACTLY the
+    registered rule set — a new rule without docs (or a renamed rule
+    with stale docs) fails here (ISSUE 19 satellite #4)."""
+    text = (REPO_ROOT / "README.md").read_text(encoding="utf-8")
+    start = text.index("## Static analysis")
+    end = text.index("\n## ", start + 1)
+    documented = set()
+    for line in text[start:end].splitlines():
+        stripped = line.strip()
+        if stripped.startswith("| `") and "` |" in stripped:
+            documented.add(stripped[3:stripped.index("`", 3)])
+    assert documented == set(REGISTRY), (
+        f"README-only: {sorted(documented - set(REGISTRY))}; "
+        f"undocumented: {sorted(set(REGISTRY) - documented)}")

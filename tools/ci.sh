@@ -19,14 +19,54 @@ cd "$REPO"
 # Static analysis first: jaxlint machine-checks the JAX invariants
 # (engine-routed jits, donation discipline, compat-only shard_map, pure
 # host-sync-free steps, SPMD collective discipline, thread/lock/signal
-# contracts) in milliseconds — no point booting jax for the test tier
-# if the tree already violates them.  Non-zero on any finding not in
-# tools/jaxlint/baseline.json.  --format json emits file/line/rule/
-# severity records so a CI front-end can render findings as inline
-# annotations; the exit code contract is identical to text mode.
-echo "[ci] jaxlint"
+# contracts, and since v4 the cross-module linking family: donation/
+# spec/purity contracts checked at call sites against callee export
+# summaries, plus the PR 17 page-refcount balance) — no point booting
+# jax for the test tier if the tree already violates them.  Non-zero on
+# any finding not in tools/jaxlint/baseline.json.  --format json emits
+# file/line/rule/severity records plus summary_ms/link_ms pass timings;
+# the exit code contract is identical to text mode.  The cache file
+# makes repeat CI runs warm (summaries + per-file results persist).
+echo "[ci] jaxlint (two-pass linked analysis)"
 python -m tools.jaxlint deeplearning4j_tpu bench.py tools \
-  --format json --jobs 4 || exit 1
+  --format json --jobs 4 --cache-file .jaxlint_ci_cache.json || exit 1
+
+# Linked-analysis wall-clock budget: the v4 two-pass pipeline earns its
+# keep only if linking stays cheap once warm — a WARM two-pass run must
+# cost <= 1.5x a warm v3 single-pass run (small absolute grace for
+# timer noise on this 1-core host), and must re-extract ZERO summaries.
+# A broken summary/result cache shows up here as an 18 s cold re-link
+# and fails the stage, not as a silent CI slowdown.
+echo "[ci] jaxlint linked-analysis budget"
+python - <<'EOF' || exit 1
+import time
+from pathlib import Path
+from tools.jaxlint import rules  # noqa: F401 — registers the rule set
+from tools.jaxlint.core import run_paths
+
+paths = [Path("deeplearning4j_tpu"), Path("bench.py"), Path("tools")]
+nolink = Path(".jaxlint_ci_nolink.json")
+linked = Path(".jaxlint_ci_cache.json")   # warmed by the stage above
+run_paths(paths, cache_path=nolink, link=False)          # warm v3 cache
+t0 = time.perf_counter()
+run_paths(paths, cache_path=nolink, link=False)
+single = time.perf_counter() - t0
+stats = {}
+t0 = time.perf_counter()
+run_paths(paths, cache_path=linked, stats=stats)
+two_pass = time.perf_counter() - t0
+budget = 1.5 * single + 0.25
+print(f"[ci] warm single-pass {single * 1000:.0f} ms, "
+      f"warm two-pass {two_pass * 1000:.0f} ms "
+      f"(budget {budget * 1000:.0f} ms, "
+      f"re-extracted {stats['summaries_extracted']} summaries)")
+if stats["summaries_extracted"] != 0:
+    raise SystemExit("[ci] warm run re-extracted summaries — "
+                     "the summary cache is broken")
+if two_pass > budget:
+    raise SystemExit(f"[ci] linked analysis over budget: "
+                     f"{two_pass * 1000:.0f} ms > {budget * 1000:.0f} ms")
+EOF
 
 # The analyzer's own type soundness: the linter that gates CI should
 # not itself be type-unsound.  Zero-error config committed at

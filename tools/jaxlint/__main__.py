@@ -3,4 +3,9 @@ import sys
 from tools.jaxlint.cli import main
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout was a pipe whose reader exited (jaxlint ... | head);
+        # the findings already written made it through — not an error.
+        sys.exit(0)

@@ -4,10 +4,17 @@ Exit codes: 0 = clean (or every finding baselined/suppressed),
 1 = at least one non-baselined finding, 2 = usage error.
 
 ``--format json`` emits one machine-readable object (file/line/col/
-rule/severity/family/message records plus the summary) on stdout with
-the SAME exit codes, so CI renders findings as annotations instead of
-scraping text; ``--jobs N`` fans per-file analysis out over N workers
-with byte-identical output ordering.
+rule/severity/family/message records plus the summary, including
+``summary_ms``/``link_ms`` pass timings and the summary-cache hit
+counts) on stdout with the SAME exit codes, so CI renders findings as
+annotations instead of scraping text; ``--jobs N`` fans per-file
+analysis out over N workers with byte-identical output ordering.
+
+v4 adds the two-pass linked analysis: ``--no-link`` falls back to the
+v3 single-pass behavior (cross-module rules skipped), and
+``--dump-summaries [MODULE]`` prints the linked export summaries pass
+1 extracted — the debugging window into what the cross-module rules
+actually saw.
 """
 
 from __future__ import annotations
@@ -19,6 +26,7 @@ from pathlib import Path
 from typing import Optional, Sequence
 
 from tools.jaxlint import baseline as baseline_mod
+from tools.jaxlint import core as core_mod
 from tools.jaxlint import rules  # noqa: F401 — registers the rule set
 from tools.jaxlint.core import REGISTRY, iter_python_files, run_paths
 
@@ -64,6 +72,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="analyze N files concurrently (files are "
                          "independent; output order is deterministic "
                          "regardless of N)")
+    ap.add_argument("--no-link", action="store_true",
+                    help="skip pass 1 (summary extraction) and pass-2 "
+                         "linking; cross-module rules don't run — the "
+                         "v3 single-pass behavior")
+    ap.add_argument("--dump-summaries", nargs="?", const="", default=None,
+                    metavar="MODULE",
+                    help="print the extracted (linked) export summary "
+                         "of MODULE as JSON and exit — or every "
+                         "summary in the run's closure when MODULE is "
+                         "omitted (spell it --dump-summaries=MODULE "
+                         "when positional paths follow)")
     args = ap.parse_args(argv)
 
     if args.jobs < 1:
@@ -89,9 +108,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.select else None
     cache_path = args.cache_file if args.cache_file is not None \
         else (DEFAULT_CACHE if args.cache else None)
+
+    if args.dump_summaries is not None:
+        files = iter_python_files([Path(p) for p in args.paths])
+        pass1 = core_mod._build_summaries(files, args.paths, cache_path)
+        if args.dump_summaries:
+            summ = pass1.linked.get(args.dump_summaries)
+            if summ is None:
+                print(f"error: no export summary for module "
+                      f"{args.dump_summaries!r} in the scanned closure "
+                      f"({len(pass1.linked)} modules); module names are "
+                      "dotted, rooted at the repo",
+                      file=sys.stderr)
+                return 2
+            print(json.dumps(summ, indent=2, sort_keys=True))
+        else:
+            print(json.dumps(pass1.linked, indent=2, sort_keys=True))
+        return 0
+
+    stats: dict = {}
     try:
         findings = run_paths(args.paths, select, cache_path=cache_path,
-                             jobs=args.jobs)
+                             jobs=args.jobs, link=not args.no_link,
+                             stats=stats)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
@@ -136,6 +175,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "warnings": warnings,
             "baselined": len(grandfathered),
             "rules": len(REGISTRY) if select is None else len(select),
+            "summary_ms": stats.get("summary_ms", 0.0),
+            "link_ms": stats.get("link_ms", 0.0),
+            "summaries_extracted": stats.get("summaries_extracted", 0),
+            "summaries_cached": stats.get("summaries_cached", 0),
             "findings": [{
                 "file": f.path, "line": f.line, "col": f.col,
                 "rule": f.rule, "severity": f.severity,

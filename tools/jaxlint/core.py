@@ -57,18 +57,33 @@ class Finding:
 class Rule:
     """Base class: subclass, set ``name``/``severity``/``description``
     (and ``family`` for non-tracing rules), implement ``check``.
-    Register with ``@register``."""
+    Register with ``@register``.
+
+    Cross-module rules (the v4 ``cross-module`` family) set
+    ``requires_link = True`` and implement ``check_linked`` instead:
+    they only run when the two-pass pipeline hands them a
+    ``link.LinkContext`` (module identity + the linked export summaries
+    of the run's dependency closure).  Without a context — plain
+    ``check_source`` calls, ``--no-link`` runs — they are silently
+    skipped, never half-run."""
 
     name: str = ""
     severity: str = "error"
     description: str = ""
     family: str = "tracing"    # "tracing" | "collective" | "concurrency"
+    requires_link: bool = False
 
     def applies_to(self, posix_path: str) -> bool:
         """Path filter (POSIX string).  Default: every file."""
         return True
 
     def check(self, tree: ast.Module, posix_path: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def check_linked(self, tree: ast.Module, posix_path: str,
+                     ctx) -> Iterable[Finding]:
+        """Linked check (``requires_link`` rules only).  ``ctx`` is a
+        ``tools.jaxlint.link.LinkContext``."""
         raise NotImplementedError
 
     # helper so rules build findings without repeating themselves
@@ -216,21 +231,34 @@ def iter_python_files(paths: Sequence[Path]) -> List[Path]:
 
 def check_source(source: str, posix_path: str,
                  rules: Optional[Sequence[Rule]] = None,
-                 filename: Optional[str] = None) -> List[Finding]:
+                 filename: Optional[str] = None,
+                 link_ctx=None,
+                 tree: Optional[ast.Module] = None) -> List[Finding]:
     """Run ``rules`` (default: all registered) over one source blob.
 
     Returns only findings that survive inline suppressions.  Exposed
     directly so tests can lint fixture snippets without touching disk.
+    ``link_ctx`` (a ``link.LinkContext``) enables the cross-module
+    rules; without it they are skipped — a single-module call cannot
+    half-run a linking rule.  ``tree`` reuses a pre-parsed AST (pass 1
+    already parsed summary-cache misses; a cold two-pass run must not
+    pay the parse twice).
     """
-    tree = ast.parse(source, filename=filename or posix_path)
+    if tree is None:
+        tree = ast.parse(source, filename=filename or posix_path)
     sup = Suppressions(source, tree)
     active = list(REGISTRY.values()) if rules is None else list(rules)
     findings: List[Finding] = []
     for rule in active:
         if not rule.applies_to(posix_path):
             continue
-        findings.extend(f for f in rule.check(tree, posix_path)
-                        if not sup.hides(f))
+        if rule.requires_link:
+            if link_ctx is None:
+                continue
+            found = rule.check_linked(tree, posix_path, link_ctx)
+        else:
+            found = rule.check(tree, posix_path)
+        findings.extend(f for f in found if not sup.hides(f))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -272,18 +300,161 @@ def _analyzer_fingerprint(root: Optional[Path] = None) -> str:
     return _ANALYZER_FP
 
 
+def summary_cache_path(cache_path: Path) -> Path:
+    """The summary store rides beside the result cache:
+    ``.jaxlint_cache.json`` -> ``.jaxlint_cache.json.summaries``."""
+    return cache_path.with_name(cache_path.name + ".summaries")
+
+
+class _Pass1:
+    """Everything pass 1 (summary extraction) hands to pass 2."""
+
+    def __init__(self) -> None:
+        self.resolver = None             # summary.Resolver
+        self.module_by_path: Dict[str, Tuple[str, bool]] = {}
+        self.linked: Dict[str, dict] = {}    # linked summaries
+        self.fp_by_module: Dict[str, str] = {}   # RAW summary content fp
+        self.closure: Dict[str, List[str]] = {}
+        self.sources: Dict[str, str] = {}
+        self.trees: Dict[str, ast.Module] = {}   # parsed on cache miss
+        self.extracted = 0
+        self.cached = 0
+
+    def deps_for(self, posix: str) -> Dict[str, str]:
+        """The summary fingerprints this file's linking consumed — what
+        its result-cache entry must record.  Closing over the TRANSITIVE
+        import set matters: the donation/purity fixpoints flow facts
+        through intermediate modules, so a dep-of-a-dep edit can change
+        what linking concludes here."""
+        mod_pkg = self.module_by_path.get(posix)
+        if mod_pkg is None:
+            return {}
+        return {m: self.fp_by_module[m]
+                for m in self.closure.get(mod_pkg[0], [])
+                if m in self.fp_by_module}
+
+    def context_for(self, posix: str):
+        mod_pkg = self.module_by_path.get(posix)
+        if mod_pkg is None:
+            return None
+        from tools.jaxlint.link import LinkContext
+        return LinkContext(module=mod_pkg[0], is_package=mod_pkg[1],
+                           resolver=self.resolver,
+                           summaries=self.linked)
+
+
+def _build_summaries(files: List[Path], paths: Sequence,
+                     cache_path: Optional[Path]) -> _Pass1:
+    """Pass 1: extract (or load) the export summary of every scanned
+    file AND of every intra-repo module in their transitive import
+    closure — single-file runs still link against the full summaries of
+    what they import.  Persisted beside the result cache, keyed on
+    (analyzer fingerprint, schema version, file source): a warm run
+    re-extracts nothing."""
+    import json
+    from tools.jaxlint import link as link_mod
+    from tools.jaxlint import summary as summary_mod
+
+    out = _Pass1()
+    out.resolver = summary_mod.Resolver(
+        summary_mod.default_roots([Path(p) for p in paths]))
+
+    store: dict = {}
+    spath = summary_cache_path(cache_path) if cache_path else None
+    if spath is not None and spath.exists():
+        try:
+            data = json.loads(spath.read_text(encoding="utf-8"))
+            # a schema mismatch discards the WHOLE store: summaries
+            # must be re-extracted in full, never half-read
+            if isinstance(data, dict) \
+                    and data.get("schema") == summary_mod.SCHEMA_VERSION:
+                store = data.get("entries", {})
+                if not isinstance(store, dict):
+                    store = {}
+        except (OSError, ValueError):
+            store = {}
+
+    raw: Dict[str, dict] = {}
+    dirty = False
+    queue: List[Path] = list(files)
+    seen_paths: Set[str] = set()
+    while queue:
+        path = queue.pop(0)
+        posix = path.as_posix()
+        if posix in seen_paths:
+            continue
+        seen_paths.add(posix)
+        module = out.resolver.module_name(path)
+        if module is None or module in raw:
+            continue
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            continue
+        out.sources[posix] = source
+        is_pkg = out.resolver.is_package(path)
+        out.module_by_path[posix] = (module, is_pkg)
+        key = summary_mod.cache_key(source)
+        entry = store.get(posix)
+        if entry is not None and entry.get("key") == key:
+            summ = entry["summary"]
+            out.cached += 1
+        else:
+            try:
+                tree = ast.parse(source, filename=posix)
+            except SyntaxError:
+                continue        # pass 2 reports the parse error
+            out.trees[posix] = tree
+            summ = summary_mod.extract(tree, module, is_pkg,
+                                       out.resolver)
+            store[posix] = {"key": key, "module": module,
+                            "summary": summ}
+            out.extracted += 1
+            dirty = True
+        raw[module] = summ
+        out.fp_by_module[module] = summary_mod.fingerprint(summ)
+        for dep in summ.get("imports", []):
+            dep_file = out.resolver.module_file(dep)
+            if dep_file is not None \
+                    and dep_file.as_posix() not in seen_paths:
+                queue.append(dep_file)
+
+    out.linked = link_mod.resolve(raw)
+    out.closure = link_mod.dependency_closure(
+        link_mod.import_graph(raw))
+
+    if spath is not None and dirty:
+        # prune entries whose file vanished (renames/moves would
+        # otherwise accrete forever), then persist
+        store = {p: e for p, e in store.items() if Path(p).exists()}
+        try:
+            spath.write_text(json.dumps(
+                {"schema": summary_mod.SCHEMA_VERSION,
+                 "entries": store}, sort_keys=True), encoding="utf-8")
+        except OSError:
+            pass
+    return out
+
+
 def _lint_file(path: Path, rules: Optional[Sequence[Rule]],
-               rule_names: Sequence[str], cache: Optional[dict]
-               ) -> Tuple[str, List[Finding], Optional[str], bool]:
+               rule_names: Sequence[str], cache: Optional[dict],
+               pass1: Optional[_Pass1]
+               ) -> Tuple[str, List[Finding], Optional[str], bool,
+                          Dict[str, str]]:
     """One file's worth of work: returns (posix path, findings, cache
-    key or None, hit) — pure w.r.t. shared state, so files can run on
-    any worker in any order."""
+    key or None, hit, consumed summary fingerprints) — pure w.r.t.
+    shared state, so files can run on any worker in any order."""
     posix = path.as_posix()
-    try:
-        source = path.read_text(encoding="utf-8")
-    except (OSError, UnicodeDecodeError) as e:
-        return posix, [Finding("parse-error", posix, 1, 0,
-                               f"unreadable: {e}", "error")], None, False
+    source = pass1.sources.get(posix) if pass1 is not None else None
+    if source is None:
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            return posix, [Finding("parse-error", posix, 1, 0,
+                                   f"unreadable: {e}", "error")], \
+                None, False, {}
+    link_ctx = pass1.context_for(posix) if pass1 is not None else None
+    deps = pass1.deps_for(posix) if pass1 is not None else {}
     key = None
     if cache is not None:
         import hashlib
@@ -292,35 +463,58 @@ def _lint_file(path: Path, rules: Optional[Sequence[Rule]],
              + "\x00".join(rule_names) + "\x00" + source)
             .encode("utf-8")).hexdigest()
         hit = cache.get(posix)
-        if hit is not None and hit.get("key") == key:
+        # a hit must ALSO have been produced under the same linking
+        # conditions: same linked/unlinked mode, and the very summary
+        # fingerprints this file's dependency closure carries NOW —
+        # otherwise editing module A would serve B's stale cross-module
+        # findings from B's unchanged text (the v3 staleness hole)
+        if hit is not None and hit.get("key") == key \
+                and bool(hit.get("linked")) == (link_ctx is not None) \
+                and hit.get("deps", {}) == deps:
             return posix, [Finding(**f) for f in hit["findings"]], \
-                key, True
+                key, True, deps
+    tree = pass1.trees.get(posix) if pass1 is not None else None
     try:
-        file_findings = check_source(source, posix, rules)
+        file_findings = check_source(source, posix, rules,
+                                     link_ctx=link_ctx, tree=tree)
     except SyntaxError as e:
         file_findings = [Finding("parse-error", posix, e.lineno or 1,
                                  e.offset or 0,
                                  f"syntax error: {e.msg}", "error")]
-    return posix, file_findings, key, False
+    return posix, file_findings, key, False, deps
 
 
 def run_paths(paths: Sequence, select: Optional[Sequence[str]] = None,
               cache_path: Optional[Path] = None,
-              jobs: int = 1) -> List[Finding]:
+              jobs: int = 1, link: bool = True,
+              stats: Optional[dict] = None) -> List[Finding]:
     """Lint every .py under ``paths``; returns unsuppressed findings.
 
     ``select`` restricts to a subset of rule names.  Baseline filtering
     is layered on top by the CLI (``baseline.apply``) so API callers see
     the raw truth.  With ``cache_path`` a per-file result cache is
     consulted and updated — keyed on (analyzer sources, rule selection,
-    file source), so editing either the file or ANY jaxlint source
-    (rules, astutil, core) re-lints.
+    file source) PLUS, since v4, the summary fingerprints of the file's
+    intra-repo dependency closure: editing module A re-links (re-lints)
+    every importer of A whose cross-module findings could change, while
+    a docstring-only edit that leaves A's export summary intact does
+    not.
+
+    ``link`` enables the v4 two-pass pipeline: pass 1 extracts/loads
+    per-module export summaries (cached beside the result cache),
+    pass 2 runs every rule with a ``LinkContext`` so the cross-module
+    family can check call sites against callee summaries.  With
+    ``link=False`` only the single-module rules run (the v3 behavior).
 
     ``jobs`` > 1 analyzes files concurrently — files are independent
-    (rules are stateless instances, the cache is read-only during the
-    run) and results are stitched back in file order, so the output is
-    byte-identical whatever the worker count.
+    (rules are stateless instances, the caches and the linked summary
+    table are read-only during the run) and results are stitched back
+    in file order, so the output is byte-identical whatever the worker
+    count.  ``stats``, when given, is filled with ``summary_ms``/
+    ``link_ms`` timings and summary-cache hit counts.
     """
+    import time
+
     if select is not None:
         unknown = set(select) - set(REGISTRY)
         if unknown:
@@ -344,20 +538,39 @@ def run_paths(paths: Sequence, select: Optional[Sequence[str]] = None,
                 cache = {}
 
     files = iter_python_files([Path(p) for p in paths])
+
+    pass1: Optional[_Pass1] = None
+    t0 = time.perf_counter()
+    if link:
+        pass1 = _build_summaries(files, paths, cache_path)
+    t1 = time.perf_counter()
+
     if jobs > 1 and len(files) > 1:
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=jobs) as pool:
             results = list(pool.map(
-                lambda p: _lint_file(p, rules, rule_names, cache), files))
+                lambda p: _lint_file(p, rules, rule_names, cache, pass1),
+                files))
     else:
-        results = [_lint_file(p, rules, rule_names, cache) for p in files]
+        results = [_lint_file(p, rules, rule_names, cache, pass1)
+                   for p in files]
+    t2 = time.perf_counter()
+
+    if stats is not None:
+        stats["summary_ms"] = round((t1 - t0) * 1000.0, 3)
+        stats["link_ms"] = round((t2 - t1) * 1000.0, 3)
+        stats["summaries_extracted"] = pass1.extracted if pass1 else 0
+        stats["summaries_cached"] = pass1.cached if pass1 else 0
 
     findings: List[Finding] = []
     dirty = False
-    for posix, file_findings, key, hit in results:
+    for posix, file_findings, key, hit, deps in results:
         findings.extend(file_findings)
         if cache is not None and key is not None and not hit:
             cache[posix] = {"key": key,
+                            "linked": pass1 is not None
+                            and pass1.context_for(posix) is not None,
+                            "deps": deps,
                             "findings": [vars(f) for f in file_findings]}
             dirty = True
 

@@ -1,0 +1,205 @@
+"""Linking pass — pass 2 of the jaxlint v4 two-pass analyzer.
+
+Pass 1 (``summary.py``) extracted one export summary per module, purely
+locally.  This module turns the pile of summaries into linked facts:
+
+- the intra-repo **import graph** and each module's transitive
+  dependency closure (what a result-cache entry must fingerprint);
+- the **donation fixpoint**: a function donates param ``i`` if its own
+  body does, or if it forwards ``i`` positionally into a callee whose
+  summary donates that slot — closed iteratively, so import cycles
+  converge (the closure is monotone) instead of recursing;
+- the **purity fixpoint**: a cache-key helper is impure if its own body
+  trips the ``key_impurities`` walker or any intra-repo callee is
+  impure — same monotone iteration, with the originating reason
+  threaded through for the finding message.
+
+Cross-module rules subclass :class:`tools.jaxlint.core.Rule` with
+``family = "cross-module"`` and ``requires_link = True``, and implement
+``check_linked(tree, posix_path, ctx)``; without a :class:`LinkContext`
+(single-module API calls, ``check_source`` in tests) they simply don't
+run.  ``link_sources`` links a dict of in-memory fixture sources so
+rule tests never touch disk.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.jaxlint import summary as summary_mod
+from tools.jaxlint.summary import Resolver
+
+#: fixpoint iteration cap — a safety net only; both closures are
+#: monotone over finite sets, so they converge in <= |functions| rounds
+_MAX_ROUNDS = 64
+
+
+def _split_ref(ref: str) -> Tuple[str, str]:
+    mod, _, name = ref.partition(":")
+    return mod, name
+
+
+def resolve(summaries: Dict[str, Dict]) -> Dict[str, Dict]:
+    """Close the raw summaries into LINKED summaries (a new dict; the
+    inputs are not mutated).  Adds, per function:
+
+    - ``donates_linked`` — ``donates`` closed over donation forwards;
+    - ``key_pure`` / ``key_impure_reason`` — the purity verdict and a
+      human reason carrying provenance through call chains.
+    """
+    linked: Dict[str, Dict] = {}
+    for mod, s in summaries.items():
+        fns = {}
+        for name, f in s.get("functions", {}).items():
+            g = dict(f)
+            g["donates_linked"] = sorted(f.get("donates", []))
+            impure = list(f.get("key_impure", []))
+            g["key_pure"] = not impure
+            g["key_impure_reason"] = impure[0] if impure else None
+            fns[name] = g
+        t = dict(s)
+        t["functions"] = fns
+        linked[mod] = t
+
+    def fn_entry(ref: str) -> Optional[Dict]:
+        mod, name = _split_ref(ref)
+        s = linked.get(mod)
+        if s is None:
+            return None
+        return s.get("functions", {}).get(name)
+
+    for _ in range(_MAX_ROUNDS):
+        changed = False
+        for s in linked.values():
+            for f in s.get("functions", {}).values():
+                # donation closure
+                donates: Set[int] = set(f["donates_linked"])
+                for param_idx, ref, pos in f.get("donation_forwards", []):
+                    callee = fn_entry(ref)
+                    if callee is not None \
+                            and pos in callee["donates_linked"] \
+                            and param_idx not in donates:
+                        donates.add(param_idx)
+                if donates != set(f["donates_linked"]):
+                    f["donates_linked"] = sorted(donates)
+                    changed = True
+                # purity closure
+                if f["key_pure"]:
+                    for ref in f.get("key_calls", []):
+                        callee = fn_entry(ref)
+                        if callee is not None and not callee["key_pure"]:
+                            f["key_pure"] = False
+                            why = callee["key_impure_reason"] \
+                                or "transitively impure"
+                            mod, name = _split_ref(ref)
+                            f["key_impure_reason"] = \
+                                f"calls {name}() ({mod}): {why}"
+                            changed = True
+                            break
+        if not changed:
+            break
+    return linked
+
+
+def import_graph(summaries: Dict[str, Dict]) -> Dict[str, List[str]]:
+    """module -> its direct intra-repo imports (only edges into modules
+    we hold a summary for — stdlib/jax edges were already filtered by
+    the resolver in pass 1)."""
+    return {mod: sorted(d for d in s.get("imports", [])
+                        if d in summaries)
+            for mod, s in summaries.items()}
+
+
+def dependency_closure(graph: Dict[str, List[str]]
+                       ) -> Dict[str, List[str]]:
+    """module -> its TRANSITIVE dependency set (sorted, self excluded).
+    Iterative worklist, so cycles terminate trivially.  This is the set
+    whose summary fingerprints a result-cache entry must record: a
+    change anywhere in the closure can change what linking concludes
+    about the importer."""
+    out: Dict[str, List[str]] = {}
+    for mod in graph:
+        seen: Set[str] = set()
+        frontier = list(graph.get(mod, []))
+        while frontier:
+            d = frontier.pop()
+            if d in seen or d == mod:
+                continue
+            seen.add(d)
+            frontier.extend(graph.get(d, []))
+        out[mod] = sorted(seen)
+    return out
+
+
+@dataclass
+class LinkContext:
+    """Everything a cross-module rule needs at one file's check time."""
+    module: str
+    is_package: bool
+    resolver: Resolver
+    #: LINKED summaries (post-:func:`resolve`) for every module in the
+    #: run's closure — rules index it by the callee's dotted module
+    summaries: Dict[str, Dict] = field(default_factory=dict)
+
+    def bindings(self, tree: ast.Module
+                 ) -> Dict[str, Tuple[str, Optional[str]]]:
+        return summary_mod.import_bindings(
+            tree, self.module, self.is_package, self.resolver)
+
+    def function_summary(self, module: str, name: str) -> Optional[Dict]:
+        s = self.summaries.get(module)
+        if s is None:
+            return None
+        return s.get("functions", {}).get(name)
+
+    def class_protocol(self, module: str, cls: str) -> Optional[Dict]:
+        s = self.summaries.get(module)
+        if s is None:
+            return None
+        return s.get("classes", {}).get(cls)
+
+
+def link_sources(sources: Dict[str, str]
+                 ) -> Dict[str, Tuple[ast.Module, LinkContext]]:
+    """Link a dict of in-memory sources (posix relpath -> source), for
+    tests: ``{"pkg/a.py": ..., "pkg/b.py": ...}`` behaves like a tree
+    rooted at a virtual root.  Returns path -> (tree, LinkContext)."""
+    modules: Dict[str, Tuple[str, ast.Module, bool]] = {}
+    names: Set[str] = set()
+    for path, src in sources.items():
+        parts = path.split("/")
+        is_pkg = parts[-1] == "__init__.py"
+        mod_parts = parts[:-1] if is_pkg \
+            else parts[:-1] + [parts[-1][:-3]]
+        mod = ".".join(mod_parts)
+        names.add(mod)
+        # parents are importable packages too (``from pkg import dep``)
+        for i in range(1, len(mod_parts)):
+            names.add(".".join(mod_parts[:i]))
+        modules[path] = (mod, ast.parse(src, filename=path), is_pkg)
+    resolver = Resolver(roots=[], known=names)
+    raw: Dict[str, Dict] = {}
+    for path, (mod, tree, is_pkg) in modules.items():
+        raw[mod] = summary_mod.extract(tree, mod, is_pkg, resolver)
+    linked = resolve(raw)
+    out: Dict[str, Tuple[ast.Module, LinkContext]] = {}
+    for path, (mod, tree, is_pkg) in modules.items():
+        out[path] = (tree, LinkContext(module=mod, is_package=is_pkg,
+                                       resolver=resolver,
+                                       summaries=linked))
+    return out
+
+
+def check_linked_sources(sources: Dict[str, str],
+                         rules: Optional[List] = None
+                         ) -> Dict[str, List]:
+    """Convenience for tests: link ``sources`` and run the full rule
+    set (or ``rules``) over each file WITH its LinkContext.  Returns
+    path -> findings."""
+    from tools.jaxlint.core import check_source
+    ctxs = link_sources(sources)
+    return {path: check_source(src, path, rules=rules,
+                               link_ctx=ctxs[path][1])
+            for path, src in sources.items()}

@@ -1,6 +1,6 @@
 """Rule modules register themselves on import (see core.register).
 
-Six families:
+Seven families:
 
 - tracing   (PR 4): stray-jit, use-after-donate, host-sync-in-hot-path,
               raw-shard-map, impure-jit
@@ -23,12 +23,21 @@ Six families:
               host-sync-on-serving-worker — the zero-steady-state-
               compile and never-stall-the-decode-worker invariants of
               PRs 7/11/14
+- cross-module (PR 19): cross-module-use-after-donate,
+              cross-module-spec-mesh, page-refcount-balance,
+              unstable-imported-cache-key — the linked rules; they run
+              only when the two-pass driver hands each file a
+              LinkContext built from its dependencies' export
+              summaries (``requires_link = True``), and are silently
+              skipped by single-module API calls and ``--no-link``
 """
 
 from tools.jaxlint.rules import (  # noqa: F401
     blocking_under_lock,
     cluster_divergent,
     coordinator_write,
+    cross_module_donate,
+    cross_module_spec_mesh,
     divergent_collective,
     divisibility_guard,
     donation_across_collective,
@@ -36,7 +45,9 @@ from tools.jaxlint.rules import (  # noqa: F401
     host_sync,
     impure_jit,
     impure_signal_handler,
+    imported_cache_key,
     mesh_axes,
+    page_refcount,
     partition_spec,
     raw_shard_map,
     serving_worker_sync,

@@ -1,0 +1,122 @@
+"""cross-module-use-after-donate: reusing a tree after handing it to a
+function whose EXPORT SUMMARY donates that position.
+
+``use-after-donate`` catches the scope-local shape — a name read after
+being passed into a literal ``donate_argnums`` slot of a jit call the
+same module built.  But the repo's training entry points hide the
+donation behind a module boundary::
+
+    # parallel/sharded_fit.py
+    def fit_step(params, ustate, batch):        # donates 0 and 1
+        step = cached_jit(body, donate_argnums=(0, 1))
+        return step(params, ustate, batch)
+
+    # somewhere else
+    from parallel.sharded_fit import fit_step
+    out = fit_step(params, ustate, batch)
+    debug_norm(params)          # <-- deleted buffer; invisible to v3
+
+Pass 1 records, per exported function, which positional params flow
+into donated slots (closed over forwarding chains by the linker, so a
+re-export wrapper donates too); this rule replays the PROVEN v3
+read-after-donate dataflow — same-statement ordering, mutually
+exclusive branches, conditional-rebind taint — against call sites of
+those imports.  The finding message carries the summary provenance
+(callee module and position) so a baseline entry or CI annotation
+points at the contract, not just the line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from tools.jaxlint.core import Finding, Rule, register
+from tools.jaxlint.rules.use_after_donate import (DonationTable,
+                                                  UseAfterDonateRule)
+
+
+class _LinkedChecker(UseAfterDonateRule):
+    """Throwaway per-call checker: inherits the v3 dataflow, swaps the
+    donation tables for summary-derived ones and the message for one
+    that names the exporting module.  Never registered — the public
+    rule below instantiates one per ``check_linked`` call, so the
+    registered instance stays stateless across threads."""
+
+    direct_form = False
+
+    def __init__(self, provenance: Dict[str, Tuple[str, str, List[int]]]):
+        self._prov = provenance
+
+    def _message(self, name: str, label: str, line: int) -> str:
+        mod, fname, donated = self._prov.get(label, ("?", label, []))
+        pos = ",".join(str(i) for i in donated)
+        return (f"{name!r} read after being passed to {label}() at line "
+                f"{line} — the export summary of {mod} says {fname}() "
+                f"donates positional arg(s) {pos}; the buffer is deleted "
+                "on return; copy before the call or rebind from the "
+                "result")
+
+
+@register
+class CrossModuleUseAfterDonateRule(Rule):
+    name = "cross-module-use-after-donate"
+    severity = "error"
+    family = "cross-module"
+    requires_link = True
+    description = ("variable read after being passed to an imported "
+                   "function whose export summary donates that "
+                   "positional argument — the buffer is deleted across "
+                   "the module boundary")
+
+    def check(self, tree: ast.Module, posix_path: str
+              ) -> Iterable[Finding]:
+        return ()               # linking-only rule
+
+    def check_linked(self, tree: ast.Module, posix_path: str,
+                     ctx) -> Iterable[Finding]:
+        bindings = ctx.bindings(tree)
+        # local alias -> donated positions + provenance, for imports of
+        # functions whose LINKED summary donates something
+        table: DonationTable = {}
+        provenance: Dict[str, Tuple[str, str, List[int]]] = {}
+        binder_by_name: Dict[str, ast.stmt] = {}
+        for stmt in ast.walk(tree):
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                for a in stmt.names:
+                    binder_by_name[(a.asname or a.name).split(".")[0]] \
+                        = stmt
+        for local, (mod, attr) in bindings.items():
+            if attr is None:
+                continue        # module object; attribute calls are
+                                # rarer and summaries stay name-keyed
+            entry = ctx.function_summary(mod, attr)
+            if entry is None:
+                continue
+            donated = list(entry.get("donates_linked",
+                                     entry.get("donates", [])))
+            if not donated:
+                continue
+            binder = binder_by_name.get(local)
+            if binder is None:
+                continue
+            table[local] = (set(donated), binder)
+            provenance[local] = (mod, attr, donated)
+        if not table:
+            return
+        checker = _LinkedChecker(provenance)
+        checker.name = self.name
+        checker.severity = self.severity
+        scopes: List[ast.AST] = [tree]
+        scopes.extend(n for n in ast.walk(tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)))
+        for scope in scopes:
+            if scope is tree:
+                # module scope: the import stmt is the binding, so the
+                # "last binding wins" check applies via the local table
+                yield from checker._check_scope(scope, table, {},
+                                                posix_path)
+            else:
+                yield from checker._check_scope(scope, {}, table,
+                                                posix_path)

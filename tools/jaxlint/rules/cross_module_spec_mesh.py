@@ -1,0 +1,103 @@
+"""cross-module-spec-mesh: importing a spec factory whose axes the
+local mesh never declares.
+
+``spec-axis-outside-mesh`` (v3) checks a module's OWN PartitionSpec
+literals against its OWN mesh builder.  But the repo's layering puts
+the two on opposite sides of an import: ``models/gpt.shard_specs()``
+emits ``P("model", None)`` trees, and a driver builds
+``Mesh(devs, ("data",))`` and feeds the imported specs straight into
+``NamedSharding`` — the KeyError fires on the pod at consumption time.
+
+Pass 1 records, per exported function, the union of axis names its
+PartitionSpec entries resolve to (``spec_axes``); ``None`` means the
+factory had at least one opaque entry and the summary abstains.  This
+rule runs in the CONSUMER: if the consuming module pins its mesh with
+a literal axis tuple (same builder recognition and same opacity
+bail-outs as the v3 rule), every call to an imported spec factory must
+only need axes that mesh declares.  The finding sits at the call site
+and names the factory's module — the v3 rule still owns the
+factory-side literal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.jaxlint import astutil, summary as summary_mod
+from tools.jaxlint.core import Finding, Rule, register
+from tools.jaxlint.rules.mesh_axes import _axis_tuple_expr
+
+
+def _declared_axes(tree: ast.Module) -> Optional[Set[str]]:
+    """The axis set this module's mesh builders pin, or None when the
+    module declares no mesh / any builder or element is opaque (the
+    same abstention contract as spec-axis-outside-mesh)."""
+    chain = astutil.enclosing_chain(tree)
+    declared: Set[str] = set()
+    builders: List[ast.Call] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        axes_expr = _axis_tuple_expr(node)
+        if axes_expr is None:
+            continue
+        builders.append(node)
+        if not isinstance(axes_expr, (ast.Tuple, ast.List)):
+            return None
+        for elt in axes_expr.elts:
+            values = astutil.resolve_axis_entry(
+                elt, tree, chain.get(id(elt), []))
+            if not values:
+                return None
+            declared |= values
+    if not builders:
+        return None
+    return declared
+
+
+@register
+class CrossModuleSpecMeshRule(Rule):
+    name = "cross-module-spec-mesh"
+    severity = "error"
+    family = "cross-module"
+    requires_link = True
+    description = ("call to an imported spec factory whose export "
+                   "summary emits PartitionSpec axes the local mesh "
+                   "builder never declares")
+
+    def check(self, tree: ast.Module, posix_path: str
+              ) -> Iterable[Finding]:
+        return ()               # linking-only rule
+
+    def check_linked(self, tree: ast.Module, posix_path: str,
+                     ctx) -> Iterable[Finding]:
+        declared = _declared_axes(tree)
+        if declared is None:
+            return
+        bindings = ctx.bindings(tree)
+        if not bindings:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ref = summary_mod.resolve_imported_callee(node.func, bindings)
+            if ref is None:
+                continue
+            mod, fname = ref
+            entry = ctx.function_summary(mod, fname)
+            if entry is None:
+                continue
+            axes = entry.get("spec_axes")
+            if not axes:        # [] = emits no specs; None = opaque
+                continue
+            loose = sorted(a for a in axes if a not in declared)
+            if loose:
+                yield self.finding(
+                    posix_path, node,
+                    f"{fname}() ({mod}) emits PartitionSpec axis "
+                    f"{loose[0]!r} per its export summary, but this "
+                    "module's mesh builder only declares "
+                    f"({', '.join(sorted(declared))}) — the sharding "
+                    "fails when the imported specs are consumed on "
+                    "this mesh")

@@ -1,0 +1,92 @@
+"""unstable-imported-cache-key: a compile-cache key built by calling
+an imported helper whose export summary says it is impure.
+
+``unstable-cache-key`` (v3) walks the key expression lexically, so
+``cached_jit(f, key=f"{time.time()}")`` is caught — but the moment the
+instability hides behind a def the walker goes blind::
+
+    # runtime/keys.py
+    def run_tag():
+        return f"run-{time.time()}"     # impure, per pass 1
+
+    # elsewhere
+    from runtime.keys import run_tag
+    eng = cached_jit(step, key=run_tag())    # fresh compile per call
+
+Pass 1 runs the same ``key_impurities`` walker over every function
+body and records the verdict plus the reason; the linker closes it
+over intra-repo call chains (``run_tag`` calling an impure helper two
+modules away is still impure, with the provenance chain threaded into
+the reason).  This rule re-checks the v3 call sites —
+``cached_jit``/``get_or_build`` key and label expressions — for CALLS
+to imported helpers and flags the ones whose linked summary says
+``key_pure: false``.  Helpers without a summary (stdlib, jax, opaque)
+are skipped: the rule only speaks when the summary gives it grounds.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.jaxlint import astutil, summary as summary_mod
+from tools.jaxlint.core import Finding, Rule, register
+from tools.jaxlint.rules.unstable_cache_key import _ENGINE_CALLS
+
+
+@register
+class UnstableImportedCacheKeyRule(Rule):
+    name = "unstable-imported-cache-key"
+    severity = "error"
+    family = "cross-module"
+    requires_link = True
+    description = ("compile-cache key/label calls an imported helper "
+                   "whose export summary is impure — the instability "
+                   "is hidden behind the module boundary, but the "
+                   "steady-state recompile is the same")
+
+    def check(self, tree: ast.Module, posix_path: str
+              ) -> Iterable[Finding]:
+        return ()               # linking-only rule
+
+    def check_linked(self, tree: ast.Module, posix_path: str,
+                     ctx) -> Iterable[Finding]:
+        bindings = ctx.bindings(tree)
+        if not bindings:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.dotted_name(node.func)
+            if name is None:
+                continue
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in _ENGINE_CALLS:
+                continue
+            key_exprs: List[ast.AST] = []
+            if leaf == "get_or_build" and node.args:
+                key_exprs.append(node.args[0])
+            for kw in node.keywords:
+                if kw.arg in ("key", "label"):
+                    key_exprs.append(kw.value)
+            for expr in key_exprs:
+                for call in ast.walk(expr):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    ref = summary_mod.resolve_imported_callee(
+                        call.func, bindings)
+                    if ref is None:
+                        continue
+                    mod, fname = ref
+                    entry = ctx.function_summary(mod, fname)
+                    if entry is None or entry.get("key_pure", True):
+                        continue
+                    why = entry.get("key_impure_reason") \
+                        or "impure per its export summary"
+                    yield self.finding(
+                        posix_path, call,
+                        f"compile-cache key for {leaf}() calls "
+                        f"{fname}() ({mod}), which is impure per its "
+                        f"export summary — {why}; the key never "
+                        "matches an existing entry, so steady state "
+                        "recompiles per call")

@@ -1,0 +1,515 @@
+"""page-refcount-balance: acquired KV pages must be released on every
+exit path of the acquiring scope.
+
+``serving/decode.PageAllocator`` hands out paged-KV page ids with a
+refcount protocol — ``alloc``/``share`` take a reference, ``free``
+drops one — and a slot admission bug shipped exactly once: a dispatch
+path alloc'd pages, hit the capacity ``raise`` inside an ``except``
+handler, and re-raised without freeing, bleeding the page pool one
+request per failure until the server OOM-killed.  The fix was a
+``finally``; this rule is that incident as a lint, generalized through
+the export summaries so it fires across module boundaries.
+
+Pass 1 records, per class, which methods match the refcount protocol
+by name convention (at least one of ``alloc``/``acquire``/``admit``
+AND one of ``free``/``release``/``recycle``; ``share`` where present).
+This rule then types receivers in the CONSUMING module — constructor
+assignments, annotations (params, AnnAssign), ``self.x`` attributes
+set from a typed constructor or parameter — and tracks each
+scope-local acquisition::
+
+    pages = pool.alloc(n)        # acquire: 'pages' owns refs
+    pool.share(pages)            # acquire: an extra ref on 'pages'
+
+to one of three verdicts:
+
+- **ownership transferred** (silent): the pages are returned/yielded,
+  stored into an attribute/subscript/container, or aliased — someone
+  else's problem now.  Passing the bare name as a CALL ARGUMENT is
+  NOT a transfer; ``dispatch(pages)`` then falling off the end is the
+  original leak shape.
+- **balanced** (silent): a matching ``free``/``release``/``recycle``
+  on the same receiver covers the normal exit, and every
+  ``return``/``raise`` after the acquisition either runs after a free
+  on its own path, or sits under a ``try`` whose ``finally`` frees.
+- **leaked** (flagged): never released, released only on some
+  branches, discarded without binding, or — the incident shape — an
+  exception path (an ``except`` handler's ``raise``/``return``)
+  escapes while the only free sits in the ``try`` body the exception
+  just aborted.
+
+The lexical path model is shared with use-after-donate: statement
+order, located ancestors, mutually exclusive branches.  ``try`` and
+``with`` bodies and ``finally`` blocks count as unconditional on the
+normal path; ``if``/loop/handler bodies are conditional.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.jaxlint import astutil, summary as summary_mod
+from tools.jaxlint.core import Finding, Rule, register
+from tools.jaxlint.rules.use_after_donate import (_exclusive_with,
+                                                  _immediate_walk,
+                                                  _scope_statements)
+
+#: container mutators that take ownership of an argument
+_SINK_METHODS = {"append", "extend", "add", "insert", "put",
+                 "setdefault", "push"}
+
+#: (module, class name, protocol dict from the class summary)
+ProtoRef = Tuple[str, str, Dict[str, List[str]]]
+
+#: located ancestor: (id of compound stmt, field tag)
+_Loc = Tuple[int, str]
+
+
+def _located_ancestors(body: List[ast.stmt]
+                       ) -> Tuple[Dict[int, Set[_Loc]],
+                                  Dict[int, ast.stmt]]:
+    """id(stmt) -> {(id(compound), field)} for every enclosing compound
+    statement WITH the field it entered through, plus id -> stmt for
+    the compounds.  The field matters: a statement in a ``try`` body
+    and one in that try's handler share the compound but not the path.
+    """
+    anc: Dict[int, Set[_Loc]] = {}
+    stmt_by_id: Dict[int, ast.stmt] = {}
+
+    def build(stmts: List[ast.stmt], stack: Set[_Loc]) -> None:
+        for s in stmts:
+            anc[id(s)] = set(stack)
+            stmt_by_id[id(s)] = s
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for tag in ("body", "orelse", "finalbody"):
+                group = getattr(s, tag, None)
+                if group:
+                    build(list(group), stack | {(id(s), tag)})
+            for handler in getattr(s, "handlers", []) or []:
+                build(list(handler.body), stack | {(id(s), "handler")})
+            for case in getattr(s, "cases", []) or []:
+                build(list(case.body), stack | {(id(s), "case")})
+
+    build(body, set())
+    return anc, stmt_by_id
+
+
+def _unconditional(parent: ast.stmt, tag: str) -> bool:
+    """Does entering ``parent`` guarantee this field runs on the normal
+    (no-exception) path?  try/with bodies and finally blocks: yes.
+    if/loop/handler/orelse/case: no."""
+    if isinstance(parent, (ast.With, ast.AsyncWith)):
+        return tag == "body"
+    if isinstance(parent, ast.Try):
+        return tag in ("body", "finalbody")
+    return False
+
+
+class _Tracked:
+    """One scope-local acquisition being balanced."""
+
+    __slots__ = ("name", "stmt", "idx", "recv", "proto_ref", "method")
+
+    def __init__(self, name: str, stmt: ast.stmt, idx: int, recv: str,
+                 proto_ref: ProtoRef, method: str):
+        self.name = name
+        self.stmt = stmt
+        self.idx = idx
+        self.recv = recv
+        self.proto_ref = proto_ref
+        self.method = method
+
+
+def _contains_load(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               and isinstance(n.ctx, ast.Load)
+               for n in ast.walk(node))
+
+
+def _aliases(value: ast.AST, name: str) -> bool:
+    """Is ``value`` the bare name or a container literal holding it —
+    the forms that create a second owner we can't track?"""
+    if isinstance(value, ast.Name) and value.id == name:
+        return True
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        return any(_aliases(e, name) for e in value.elts)
+    if isinstance(value, ast.Dict):
+        return any(v is not None and _aliases(v, name)
+                   for v in list(value.keys) + list(value.values))
+    return False
+
+
+@register
+class PageRefcountBalanceRule(Rule):
+    name = "page-refcount-balance"
+    severity = "error"
+    family = "cross-module"
+    requires_link = True
+    description = ("pages acquired from a refcounted allocator "
+                   "(per its class export summary) are not released "
+                   "on every exit path — normal AND exception exits "
+                   "must free or transfer ownership")
+
+    def check(self, tree: ast.Module, posix_path: str
+              ) -> Iterable[Finding]:
+        return ()               # linking-only rule
+
+    # -- receiver typing ------------------------------------------------
+
+    def _name_protocols(self, tree: ast.Module, ctx
+                        ) -> Dict[str, ProtoRef]:
+        """Local bare name -> protocol class it refers to: classes
+        DEFINED here (own module's summary) plus imported ones."""
+        out: Dict[str, ProtoRef] = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                proto = ctx.class_protocol(ctx.module, node.name)
+                if proto:
+                    out[node.name] = (ctx.module, node.name, proto)
+        for local, (mod, attr) in ctx.bindings(tree).items():
+            if attr is None:
+                continue
+            proto = ctx.class_protocol(mod, attr)
+            if proto:
+                out[local] = (mod, attr, proto)
+        return out
+
+    def _expr_protocol(self, expr: ast.AST, names: Dict[str, ProtoRef],
+                       bindings, ctx) -> Optional[ProtoRef]:
+        """Protocol ref for a class-naming expression: a bare local
+        name, or a module attribute (``decode.PageAllocator``)."""
+        dotted = astutil.dotted_name(expr)
+        if dotted is None:
+            return None
+        if dotted in names:
+            return names[dotted]
+        ref = summary_mod.resolve_imported_callee(expr, bindings)
+        if ref is not None:
+            proto = ctx.class_protocol(*ref)
+            if proto:
+                return (ref[0], ref[1], proto)
+        return None
+
+    def _value_protocol(self, value: Optional[ast.AST],
+                        names: Dict[str, ProtoRef], bindings, ctx
+                        ) -> Optional[ProtoRef]:
+        if isinstance(value, ast.Call):
+            return self._expr_protocol(value.func, names, bindings, ctx)
+        return None
+
+    def _scope_receivers(self, scope: ast.AST,
+                         names: Dict[str, ProtoRef], bindings, ctx
+                         ) -> Dict[str, ProtoRef]:
+        """dotted receiver -> protocol, from ctor assignments and
+        annotations visible in ``scope`` (params included)."""
+        typed: Dict[str, ProtoRef] = {}
+        args = getattr(scope, "args", None)
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                if a.annotation is not None:
+                    ref = self._expr_protocol(a.annotation, names,
+                                              bindings, ctx)
+                    if ref:
+                        typed[a.arg] = ref
+        for stmt, _depth in _scope_statements(scope):
+            target: Optional[ast.AST] = None
+            ref: Optional[ProtoRef] = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                ref = self._value_protocol(stmt.value, names, bindings,
+                                           ctx)
+            elif isinstance(stmt, ast.AnnAssign):
+                target = stmt.target
+                ref = self._expr_protocol(stmt.annotation, names,
+                                          bindings, ctx) \
+                    or self._value_protocol(stmt.value, names, bindings,
+                                            ctx)
+            if target is None or ref is None:
+                continue
+            dotted = astutil.dotted_name(target)
+            if dotted is not None:
+                typed[dotted] = ref
+        return typed
+
+    def _class_attr_receivers(self, cls: ast.ClassDef,
+                              names: Dict[str, ProtoRef], bindings, ctx
+                              ) -> Dict[str, ProtoRef]:
+        """``self.x`` receivers typed anywhere in the class: assigned
+        from a protocol constructor, or from a parameter annotated as
+        a protocol class."""
+        typed: Dict[str, ProtoRef] = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            params = self._scope_receivers(method, names, bindings, ctx)
+            for stmt in ast.walk(method):
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1):
+                    continue
+                dotted = astutil.dotted_name(stmt.targets[0])
+                if dotted is None or not dotted.startswith("self."):
+                    continue
+                ref = self._value_protocol(stmt.value, names, bindings,
+                                           ctx)
+                if ref is None and isinstance(stmt.value, ast.Name):
+                    ref = params.get(stmt.value.id)
+                if ref is not None:
+                    typed[dotted] = ref
+        return typed
+
+    # -- the check ------------------------------------------------------
+
+    def check_linked(self, tree: ast.Module, posix_path: str,
+                     ctx) -> Iterable[Finding]:
+        names = self._name_protocols(tree, ctx)
+        if not names:
+            return
+        bindings = ctx.bindings(tree)
+        module_typed = self._scope_receivers(tree, names, bindings, ctx)
+        class_of: Dict[int, ast.ClassDef] = {}
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                for m in cls.body:
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        class_of[id(m)] = cls
+        attr_typed_by_class: Dict[int, Dict[str, ProtoRef]] = {}
+
+        scopes: List[ast.AST] = [tree]
+        scopes.extend(n for n in ast.walk(tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)))
+        for scope in scopes:
+            typed = dict(module_typed)
+            cls = class_of.get(id(scope))
+            if cls is not None:
+                if id(cls) not in attr_typed_by_class:
+                    attr_typed_by_class[id(cls)] = \
+                        self._class_attr_receivers(cls, names, bindings,
+                                                   ctx)
+                typed.update(attr_typed_by_class[id(cls)])
+            if scope is not tree:
+                typed.update(self._scope_receivers(scope, names,
+                                                   bindings, ctx))
+            if typed:
+                yield from self._check_scope(scope, typed, posix_path)
+
+    def _protocol_call(self, node: ast.AST,
+                       typed: Dict[str, ProtoRef], kinds: Tuple[str, ...]
+                       ) -> Optional[Tuple[str, ProtoRef, str, ast.Call]]:
+        """Match ``<typed receiver>.<protocol method>(...)`` where the
+        method belongs to one of the given protocol kinds; returns
+        (receiver dotted, proto ref, method, call)."""
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return None
+        recv = astutil.dotted_name(node.func.value)
+        if recv is None or recv not in typed:
+            return None
+        ref = typed[recv]
+        proto = ref[2]
+        for kind in kinds:
+            if node.func.attr in proto.get(kind, []):
+                return recv, ref, node.func.attr, node
+        return None
+
+    def _check_scope(self, scope: ast.AST, typed: Dict[str, ProtoRef],
+                     posix_path: str) -> Iterator[Finding]:
+        stmts = list(_scope_statements(scope))
+        top = [s for s, d in stmts if d == 0]
+        anc, compound = _located_ancestors(top)
+
+        tracked: List[_Tracked] = []
+        for i, (stmt, _depth) in enumerate(stmts):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                hit = self._protocol_call(stmt.value, typed,
+                                          ("acquire",))
+                if hit is not None:
+                    recv, ref, meth, _call = hit
+                    tracked.append(_Tracked(stmt.targets[0].id, stmt, i,
+                                            recv, ref, meth))
+                    continue
+            if isinstance(stmt, ast.Expr):
+                hit = self._protocol_call(stmt.value, typed,
+                                          ("acquire",))
+                if hit is not None:
+                    recv, ref, meth, _call = hit
+                    mod, cls, _proto = ref
+                    yield self.finding(
+                        posix_path, stmt,
+                        f"{cls}.{meth}() result discarded — the "
+                        "acquired pages are unreachable and can never "
+                        f"be released (class summary of {mod})")
+                    continue
+            # share: an extra reference on an existing name, whether
+            # the call's result is bound or not
+            value = stmt.value if isinstance(stmt,
+                                             (ast.Expr, ast.Assign)) \
+                else None
+            if value is not None:
+                hit = self._protocol_call(value, typed, ("share",))
+                if hit is not None and hit[3].args \
+                        and isinstance(hit[3].args[0], ast.Name):
+                    recv, ref, meth, call = hit
+                    tracked.append(_Tracked(call.args[0].id, stmt, i,
+                                            recv, ref, meth))
+
+        for t in tracked:
+            yield from self._balance(t, stmts, top, anc, compound,
+                                     posix_path)
+
+    def _balance(self, t: _Tracked,
+                 stmts: List[Tuple[ast.stmt, int]],
+                 top: List[ast.stmt],
+                 anc: Dict[int, Set[_Loc]],
+                 compound: Dict[int, ast.stmt],
+                 posix_path: str) -> Iterator[Finding]:
+        mod, cls, proto = t.proto_ref
+        release = set(proto.get("release", []))
+        exclusive = _exclusive_with(top, t.stmt)
+        a_loc = anc.get(id(t.stmt), set())
+        # trys whose BODY holds the acquisition: their handlers may run
+        # with the acquisition never having executed (the alloc itself
+        # raised), so exits there cannot be proven to leak — abstain
+        a_try_bodies = {cid for cid, tag in a_loc
+                        if tag == "body"
+                        and isinstance(compound.get(cid), ast.Try)}
+
+        def frees_name(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in release
+                    and astutil.dotted_name(node.func.value) == t.recv
+                    and any(isinstance(a, ast.Name) and a.id == t.name
+                            for a in node.args))
+
+        def compatible(f_loc: Set[_Loc], e_loc: Set[_Loc]) -> bool:
+            """Did a free at f_loc run on the path reaching e_loc
+            (given both are past the acquisition)?"""
+            e_ids = {cid for cid, _tag in e_loc}
+            for cid, tag in f_loc:
+                if (cid, tag) in e_loc or (cid, tag) in a_loc:
+                    continue
+                parent = compound.get(cid)
+                if parent is not None and _unconditional(parent, tag) \
+                        and cid not in e_ids:
+                    continue
+                return False
+            return True
+
+        def finally_covers(e_loc: Set[_Loc]) -> bool:
+            """A finally block of a try enclosing this point frees the
+            name — runs on return/raise propagation too."""
+            for cid, _tag in e_loc | a_loc:
+                parent = compound.get(cid)
+                if isinstance(parent, ast.Try):
+                    for s in parent.finalbody:
+                        if any(frees_name(n) for n in ast.walk(s)):
+                            return True
+            return False
+
+        free_locs: List[Tuple[int, Set[_Loc]]] = []
+        for i in range(t.idx + 1, len(stmts)):
+            stmt, _depth = stmts[i]
+            if id(stmt) in exclusive:
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            s_loc = anc.get(id(stmt), set())
+
+            # _immediate_walk: a free nested in a child statement of a
+            # compound belongs to THAT statement's entry (with its own
+            # located ancestors), not to the compound's header
+            if any(frees_name(n) for n in _immediate_walk(stmt)):
+                free_locs.append((i, s_loc))
+                continue
+
+            # ownership transfers / aliasing end the tracking
+            if isinstance(stmt, (ast.Return, ast.Expr)) \
+                    and stmt.value is not None:
+                inner = stmt.value
+                if isinstance(inner, (ast.Yield, ast.YieldFrom)):
+                    if inner.value is not None \
+                            and _contains_load(inner.value, t.name):
+                        return
+                elif isinstance(stmt, ast.Return) \
+                        and _contains_load(inner, t.name):
+                    return
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                stores_out = any(
+                    isinstance(n, (ast.Attribute, ast.Subscript))
+                    for tgt in targets for n in ast.walk(tgt))
+                value = stmt.value
+                if value is not None:
+                    if stores_out and _contains_load(value, t.name):
+                        return
+                    if _aliases(value, t.name):
+                        return
+                if any(isinstance(n, ast.Name) and n.id == t.name
+                       and isinstance(n.ctx, (ast.Store, ast.Del))
+                       for tgt in targets for n in ast.walk(tgt)):
+                    return      # rebound; the old binding is gone
+            if isinstance(stmt, ast.Delete) \
+                    and any(isinstance(n, ast.Name) and n.id == t.name
+                            for tgt in stmt.targets
+                            for n in ast.walk(tgt)):
+                return
+            for node in _immediate_walk(stmt):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SINK_METHODS \
+                        and any(_contains_load(a, t.name)
+                                for a in node.args):
+                    return      # stored into a container
+
+            # exits: must run after a free on their own path
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                if any(cid in a_try_bodies for cid, tag in s_loc
+                       if tag == "handler"):
+                    continue    # the acquisition may never have run
+                if finally_covers(s_loc):
+                    continue
+                if any(fi < i and compatible(f_loc, s_loc)
+                       for fi, f_loc in free_locs):
+                    continue
+                kind = "return" if isinstance(stmt, ast.Return) \
+                    else "raise"
+                yield self.finding(
+                    posix_path, stmt,
+                    f"this {kind} exits without releasing {t.name!r} "
+                    f"(acquired via {cls}.{t.method}() at line "
+                    f"{t.stmt.lineno}) — pages leak on this path; "
+                    "free them first or move the release into a "
+                    f"finally (class summary of {mod})")
+                return
+
+        # normal fall-off: some free must cover the acquisition's own
+        # continuation (or a finally does)
+        if finally_covers(a_loc):
+            return
+        if any(compatible(f_loc, a_loc) for _fi, f_loc in free_locs):
+            return
+        if free_locs:
+            yield self.finding(
+                posix_path, t.stmt,
+                f"{t.name!r} (acquired via {cls}.{t.method}() here) is "
+                "released only on some branches — the normal exit "
+                "path leaks the pages; release on the acquisition's "
+                f"own continuation or in a finally (class summary of "
+                f"{mod})")
+        else:
+            yield self.finding(
+                posix_path, t.stmt,
+                f"{t.name!r} (acquired via {cls}.{t.method}() here) is "
+                "never released in this scope and never transferred — "
+                f"the pages leak (class summary of {mod})")

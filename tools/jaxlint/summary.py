@@ -1,0 +1,447 @@
+"""Per-module export summaries — pass 1 of the jaxlint v4 linker.
+
+jaxlint's rules were single-module by design; the invariants that have
+actually bitten us lately are cross-module: the PR 17 page leak (a
+failed-dispatch path dropped a ``PageAllocator`` pool's page-table
+references), and the ``shard_specs``-vs-mesh contract the
+``spec-axis-outside-mesh`` rule can only check when spec and mesh live
+in the same file.  The classic fix is summary-based interprocedural
+analysis (Infer's bi-abduction summaries, arXiv:1505.04055;
+FlowDroid's taint summaries, PLDI'14): pass 1 extracts, per module, a
+small JSON **export summary** of the facts other modules need; pass 2
+(``link.py``) resolves call sites against the callee's summary.
+
+What a summary records, per module-level function:
+
+- ``donates`` — positional parameter indices whose buffers the function
+  consumes (its body passes them into a literal ``donate_argnums``
+  position of a jit-like call, or the function itself is decorated with
+  one);
+- ``donation_forwards`` — ``[param_idx, "dep.module:callee", pos]``
+  edges where a param is forwarded positionally into an IMPORTED
+  callable: the linker closes ``donates`` over these (fixpoint, so
+  import cycles converge instead of recursing);
+- ``spec_axes`` — the mesh axis names its ``PartitionSpec`` literals
+  emit (``None`` when any entry is statically opaque — an unknowable
+  spec is the caller's contract, never a finding);
+- ``key_impure`` — the PR 15 ``key_impurities`` walker's verdicts over
+  the body (a cache-key helper is pure iff this is empty and, at link
+  time, every intra-repo callee it calls is pure too);
+- ``key_calls`` — intra-repo callees, for the purity fixpoint.
+
+And per class: a refcount **resource protocol** — method names that
+acquire (``alloc``/``acquire``/``admit``), share (``share``), and
+release (``free``/``release``/``recycle``) refcounted resources, for
+classes that define both sides (``PageAllocator`` is the canonical
+instance).
+
+Summaries are persisted beside the result cache (``<cache>.summaries``)
+keyed on (analyzer fingerprint, schema version, file source), so a warm
+run re-extracts nothing.  The summary FINGERPRINT hashes the summary
+CONTENT, not the source — editing a dependency's docstring doesn't
+re-link its importers, changing its donation contract does.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint import astutil
+
+#: bump when the summary shape changes — a version mismatch discards
+#: the whole summary cache (full re-extraction), never a partial read
+SCHEMA_VERSION = 1
+
+#: refcount-protocol method-name conventions.  A class exposes the
+#: protocol iff it defines at least one acquire AND one release name;
+#: ``share`` additionally bumps refcounts where present.
+ACQUIRE_METHOD_NAMES = {"alloc", "acquire", "admit"}
+SHARE_METHOD_NAMES = {"share"}
+RELEASE_METHOD_NAMES = {"free", "release", "recycle"}
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+# ---------------------------------------------------------------------------
+# module naming and intra-repo import resolution
+# ---------------------------------------------------------------------------
+
+class Resolver:
+    """Maps files <-> dotted module names for one run.
+
+    ``roots`` are package roots — the repo root plus the parent of
+    every scanned directory, so linting a scratch tree (``run_paths([
+    tmp / 'pkg'])``) resolves ``pkg.dep`` imports exactly like linting
+    ``deeplearning4j_tpu`` from the checkout does.  ``known`` seeds
+    extra module names with no backing file — the in-memory fixture
+    path tests link through (``link.link_sources``).
+    """
+
+    def __init__(self, roots: Sequence[Path],
+                 known: Iterable[str] = ()) -> None:
+        self.roots = [Path(r).resolve() for r in roots]
+        self.known: Set[str] = set(known)
+
+    def module_name(self, path: Path) -> Optional[str]:
+        """``<root>/pkg/mod.py`` -> ``pkg.mod`` (``__init__.py`` -> the
+        package itself) under the first containing root; None when no
+        root contains the file — such a file cannot be imported by
+        name, so it neither exports a summary address nor links."""
+        p = Path(path)
+        p = p if p.is_absolute() else p.resolve()
+        for root in self.roots:
+            try:
+                rel = p.resolve().relative_to(root)
+            except ValueError:
+                continue
+            parts = list(rel.parts)
+            if not parts or not parts[-1].endswith(".py"):
+                continue
+            parts[-1] = parts[-1][:-3]
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            if parts:
+                return ".".join(parts)
+        return None
+
+    def module_file(self, module: str) -> Optional[Path]:
+        """Inverse mapping (plain module first, then package
+        ``__init__``), under the first root that has it."""
+        rel = Path(*module.split("."))
+        for root in self.roots:
+            for cand in (root / rel.with_suffix(".py"),
+                         root / rel / "__init__.py"):
+                if cand.is_file():
+                    return cand
+        return None
+
+    def is_package(self, path: Path) -> bool:
+        return Path(path).name == "__init__.py"
+
+    def has_module(self, module: str) -> bool:
+        return module in self.known \
+            or self.module_file(module) is not None
+
+
+def default_roots(paths: Sequence[Path]) -> List[Path]:
+    roots: List[Path] = [_REPO_ROOT]
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            parent = p.resolve().parent
+            if parent not in roots:
+                roots.append(parent)
+    return roots
+
+
+def _resolve_relative(base_module: str, is_package: bool, level: int,
+                      target: Optional[str]) -> Optional[str]:
+    """``from ..mod import x`` inside ``base_module`` -> absolute dotted
+    module, mirroring Python's resolution (level 1 = own package)."""
+    parts = base_module.split(".")
+    if not is_package:
+        parts = parts[:-1]
+    drop = level - 1
+    if drop > len(parts):
+        return None
+    if drop:
+        parts = parts[:-drop]
+    if target:
+        parts += target.split(".")
+    return ".".join(parts) if parts else None
+
+
+def import_bindings(tree: ast.Module, module: str, is_package: bool,
+                    resolver: Resolver
+                    ) -> Dict[str, Tuple[str, Optional[str]]]:
+    """Local name -> (intra-repo dotted module, attr-or-None) for every
+    import that resolves under the resolver's roots.
+
+    ``from pkg.dep import f``      -> ``f: ("pkg.dep", "f")``
+    ``from pkg import dep``        -> ``dep: ("pkg.dep", None)`` when
+                                      ``pkg.dep`` is itself a module,
+                                      else ``dep: ("pkg", "dep")``
+    ``import pkg.dep as d``        -> ``d: ("pkg.dep", None)``
+    ``from .dep import f``         -> resolved against ``module``
+    """
+    out: Dict[str, Tuple[str, Optional[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if not resolver.has_module(a.name):
+                    continue
+                if a.asname is not None:
+                    out[a.asname] = (a.name, None)
+                else:
+                    # ``import pkg.sub`` binds ``pkg``; the attribute-
+                    # chain walk in resolve_imported_callee recovers
+                    # ``pkg.sub.f`` calls from the head binding
+                    head = a.name.split(".")[0]
+                    out[head] = (head, None)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                src = _resolve_relative(module, is_package, node.level,
+                                        node.module)
+            else:
+                src = node.module
+            if src is None or not resolver.has_module(src):
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                if resolver.has_module(f"{src}.{a.name}"):
+                    out[local] = (f"{src}.{a.name}", None)
+                else:
+                    out[local] = (src, a.name)
+    return out
+
+
+def intra_repo_imports(tree: ast.Module, module: str, is_package: bool,
+                       resolver: Resolver) -> List[str]:
+    """The intra-repo modules this module imports (sorted, deduped) —
+    the edges of the linker's import graph."""
+    deps = {t[0] for t in
+            import_bindings(tree, module, is_package, resolver).values()}
+    deps.discard(module)
+    return sorted(deps)
+
+
+def resolve_imported_callee(expr: ast.AST,
+                            bindings: Dict[str, Tuple[str, Optional[str]]]
+                            ) -> Optional[Tuple[str, str]]:
+    """Resolve a call's func expression to ``(module, name)`` when it
+    names an intra-repo import: a bare imported name (``f(...)`` after
+    ``from pkg.dep import f``) or a module attribute (``dep.f(...)``
+    after ``from pkg import dep`` / ``import pkg.dep``)."""
+    dotted = astutil.dotted_name(expr)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    bound = bindings.get(head)
+    if bound is None:
+        return None
+    mod, attr = bound
+    if attr is not None:
+        # the name was imported as an attribute: only the bare spelling
+        # resolves (``f.sub`` would be an attribute OF the function)
+        return (mod, attr) if not rest else None
+    if not rest:
+        return None                 # a bare module reference, not a call
+    # ``pkg.sub.f(...)``: everything but the last attribute extends the
+    # module path (``import pkg.sub`` binds just ``pkg`` above)
+    parts = rest.split(".")
+    return (".".join([mod] + parts[:-1]) if len(parts) > 1 else mod,
+            parts[-1])
+
+
+# ---------------------------------------------------------------------------
+# per-function fact extraction
+# ---------------------------------------------------------------------------
+
+def _local_donation_positions(fn: astutil.FunctionNode) -> Set[int]:
+    """Positional-param indices ``fn``'s own body (or decorator)
+    provably donates: decorated ``@partial(jit, donate_argnums=...)``;
+    ``g = cached_jit(body, donate_argnums=(k,))`` then ``g(p, ...)``;
+    or the direct form ``cached_jit(body, donate_argnums=(k,))(p,...)``."""
+    params = astutil.positional_params(fn)
+    index = {p: i for i, p in enumerate(params)}
+    donated: Set[int] = set()
+
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            jit_like = astutil.is_jit_reference(dec.func) or (
+                (astutil.dotted_name(dec.func) or "").rsplit(".", 1)[-1]
+                == "partial" and dec.args
+                and astutil.is_jit_reference(dec.args[0]))
+            if jit_like:
+                donated |= {i for i in astutil.donated_argnums(dec)
+                            if i < len(params)}
+
+    # names bound (anywhere in the body) to a jit call with donation
+    jit_names: Dict[str, Set[int]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and astutil.is_jit_reference(node.value.func):
+            nums = astutil.donated_argnums(node.value)
+            if nums:
+                jit_names[node.targets[0].id] = nums
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id in jit_names:
+            nums = jit_names[node.func.id]
+        elif isinstance(node.func, ast.Call) \
+                and astutil.is_jit_reference(node.func.func):
+            nums = astutil.donated_argnums(node.func)
+        else:
+            continue
+        for pos, arg in enumerate(node.args):
+            if pos in nums and isinstance(arg, ast.Name) \
+                    and arg.id in index:
+                donated.add(index[arg.id])
+    return donated
+
+
+def _donation_forwards(fn: astutil.FunctionNode,
+                       bindings: Dict[str, Tuple[str, Optional[str]]]
+                       ) -> List[List[object]]:
+    """``[param_idx, "module:callee", callee_pos]`` for every positional
+    forwarding of a param into an intra-repo imported callable — the
+    linker's fixpoint edges."""
+    index = {p: i for i, p in enumerate(astutil.positional_params(fn))}
+    out: List[List[object]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = resolve_imported_callee(node.func, bindings)
+        if callee is None:
+            continue
+        for pos, arg in enumerate(node.args):
+            if isinstance(arg, ast.Name) and arg.id in index:
+                edge: List[object] = [index[arg.id],
+                                      f"{callee[0]}:{callee[1]}", pos]
+                if edge not in out:
+                    out.append(edge)
+    return out
+
+
+def _spec_axes(fn: astutil.FunctionNode, tree: ast.Module,
+               chain: Dict[int, List[ast.AST]]) -> Optional[List[str]]:
+    """Axis names the function's ``PartitionSpec`` literals emit.
+
+    ``[]`` — the function builds no specs; ``None`` — it builds at
+    least one spec whose entries are statically opaque (the axis set is
+    the caller's contract); else the sorted union of resolved names.
+    """
+    aliases = astutil.partition_spec_aliases(tree)
+    axes: Set[str] = set()
+    saw_spec = False
+    opaque = False
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.dotted_name(node.func)
+        if name is None:
+            continue
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf != "PartitionSpec" and name not in aliases:
+            continue
+        saw_spec = True
+        for entry in astutil.partition_spec_entries(node):
+            values = astutil.resolve_axis_entry(
+                entry, tree, chain.get(id(entry), []))
+            if values is None:
+                opaque = True
+            else:
+                axes |= values
+    if not saw_spec:
+        return []
+    if opaque:
+        return None
+    return sorted(axes)
+
+
+def _key_facts(fn: astutil.FunctionNode,
+               bindings: Dict[str, Tuple[str, Optional[str]]]
+               ) -> Tuple[List[str], List[str]]:
+    """(impurity reasons, intra-repo callees) for the purity fixpoint:
+    a cache-key helper is pure iff its own body carries no
+    ``key_impurities`` AND every intra-repo callee is pure."""
+    reasons: List[str] = []
+    for stmt in fn.body:
+        for _node, why in astutil.key_impurities(stmt):
+            if why not in reasons:
+                reasons.append(why)
+    calls: List[str] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = resolve_imported_callee(node.func, bindings)
+            if callee is not None:
+                ref = f"{callee[0]}:{callee[1]}"
+                if ref not in calls:
+                    calls.append(ref)
+    return reasons, calls
+
+
+def _class_protocols(tree: ast.Module) -> Dict[str, Dict[str, List[str]]]:
+    """Classes exposing the refcount resource protocol, by method-name
+    convention: at least one acquire-named AND one release-named method
+    (``share`` recorded where present).  The summary is the contract
+    pass 2's ``page-refcount-balance`` checks call sites against."""
+    out: Dict[str, Dict[str, List[str]]] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {s.name for s in cls.body
+                   if isinstance(s, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        acquire = sorted(methods & ACQUIRE_METHOD_NAMES)
+        release = sorted(methods & RELEASE_METHOD_NAMES)
+        if acquire and release:
+            out[cls.name] = {
+                "acquire": acquire,
+                "share": sorted(methods & SHARE_METHOD_NAMES),
+                "release": release,
+            }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the summary itself
+# ---------------------------------------------------------------------------
+
+def extract(tree: ast.Module, module: str, is_package: bool,
+            resolver: Resolver) -> Dict[str, object]:
+    """One module's export summary (a pure-JSON dict, schema-versioned).
+
+    Every module-level function is summarized (not only public ones —
+    the linker needs private helpers for its fixpoints); consumers that
+    care about the public surface filter on the leading underscore.
+    """
+    bindings = import_bindings(tree, module, is_package, resolver)
+    chain = astutil.enclosing_chain(tree)
+    functions: Dict[str, Dict[str, object]] = {}
+    for name, fn in astutil.module_functions(tree).items():
+        impure, key_calls = _key_facts(fn, bindings)
+        functions[name] = {
+            "params": astutil.positional_params(fn),
+            "donates": sorted(_local_donation_positions(fn)),
+            "donation_forwards": _donation_forwards(fn, bindings),
+            "spec_axes": _spec_axes(fn, tree, chain),
+            "key_impure": impure,
+            "key_calls": key_calls,
+        }
+    deps = sorted({t[0] for t in bindings.values()} - {module})
+    return {
+        "schema": SCHEMA_VERSION,
+        "module": module,
+        "imports": deps,
+        "functions": functions,
+        "classes": _class_protocols(tree),
+    }
+
+
+def fingerprint(summary: Dict[str, object]) -> str:
+    """Content hash of a summary — what importers' cache entries record.
+    Hashing the summary (not the source) means an edit that leaves the
+    export contract intact doesn't re-link a single importer."""
+    blob = json.dumps(summary, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def cache_key(source: str) -> str:
+    """Summary-cache key for one file: analyzer fingerprint + schema
+    version + the file's own source.  (Dependency fingerprints are NOT
+    part of this key — extraction is purely local; it's the RESULT
+    cache whose entries record consumed summary fingerprints.)"""
+    from tools.jaxlint.core import _analyzer_fingerprint
+    return hashlib.sha256(
+        (_analyzer_fingerprint() + "\x00" + str(SCHEMA_VERSION)
+         + "\x00" + source).encode("utf-8")).hexdigest()
