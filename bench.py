@@ -1728,6 +1728,151 @@ def bench_resilience(batch_size: int = 64, n_batches: int = 16,
     }
 
 
+def bench_data_service(batch_size: int = 256, n_batches: int = 16,
+                       num_epochs: int = 6):
+    """Distributed data service row (datasets/data_service.py): the
+    per-host shard-reader ingest vs the legacy whole-batch staging.
+    Reports (1) warmed ResilientFit step rate through the service's
+    depth-k prefetch vs the legacy path, bit-exact check included,
+    (2) the ingest/compute overlap fraction — how much of the staging
+    cost the producer thread hides behind device compute, (3) the
+    per-host IO contract at the store layer: bytes a 2-host read plan
+    fetches for its slice vs the global fetch (must be <= 0.6x), and
+    (4) ``compile_delta`` over the timed service fit, which must be 0
+    — staged batches land pre-padded, so the service adds no shapes."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.cloud.artifacts import LocalArtifactStore
+    from deeplearning4j_tpu.datasets.data_service import (
+        DataService, ReadPlan, StoreShardSource, write_sharded_batches)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.runtime.metrics import (compile_metrics,
+                                                    ingest_metrics)
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+
+    platform, _, n_dev = _platform_info()
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(64).lr(0.05).momentum(0.5).use_adagrad(False)
+            .num_iterations(1).activation("tanh")
+            .list(3).hidden_layer_sizes(128, 64)
+            .override(2, kind=LayerKind.OUTPUT, n_out=10,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    rng = np.random.RandomState(0)
+    raw = [(rng.randn(batch_size, 64).astype(np.float32),
+            np.eye(10, dtype=np.float32)[
+                rng.randint(0, 10, batch_size)])
+           for _ in range(n_batches)]
+    batches = [DataSet(jnp.asarray(x), jnp.asarray(y)) for x, y in raw]
+    mesh = make_mesh(MeshSpec(data=n_dev))
+
+    def one_fit(use_service):
+        """One full fit; returns (net, wall_s, consumer_wait_s)."""
+        net = MultiLayerNetwork(conf).init(seed=0)
+        waits = []
+        if use_service:
+            svc = DataService.from_batches(batches, seed=1)
+            orig = svc.staged
+
+            def timed(epoch, pos, order):
+                t0 = time.perf_counter()
+                ds = orig(epoch, pos, order)
+                waits.append(time.perf_counter() - t0)
+                return ds
+            svc.staged = timed
+            data = svc
+        else:
+            data = batches
+        with tempfile.TemporaryDirectory() as cd:
+            drv = ResilientFit(net, ResilienceConfig(
+                checkpoint_dir=cd, checkpoint_every=10 ** 9,
+                patience=10 ** 6, data_service=use_service), mesh=mesh)
+            t0 = time.perf_counter()
+            drv.fit(batches if not use_service else data,
+                    num_epochs=num_epochs, seed=1)
+            jax.block_until_ready(jax.tree.leaves(net.params)[0])
+            wall = time.perf_counter() - t0
+        return net, wall, sum(waits)
+
+    one_fit(True)                       # warm the service-staged step
+    one_fit(False)                      # warm the legacy-staged step
+    net_l, t_legacy, _ = one_fit(False)
+    before = compile_metrics.snapshot()["compile_count"]
+    ingest_metrics.reset()
+    net_s, t_service, consumer_wait_s = one_fit(True)
+    compile_delta = compile_metrics.snapshot()["compile_count"] - before
+    ing = ingest_metrics.snapshot()
+    # staging cost paid on the producer thread vs what the training
+    # thread actually waited at staged(): the hidden share is overlap
+    stage_s = ing["stage_ms"] / 1e3
+    overlap_frac = (max(stage_s - consumer_wait_s, 0.0) / stage_s
+                    if stage_s > 0 else 1.0)
+    bit_exact = bool(np.array_equal(np.asarray(net_l.params_flat()),
+                                    np.asarray(net_s.params_flat())))
+
+    # per-host IO contract at the store layer: a 2-host plan's slice
+    # reads vs the global fetch over the same row-block layout
+    class _CountingStore:
+        def __init__(self, inner):
+            self.inner, self.bytes = inner, 0
+
+        def get(self, key):
+            blob = self.inner.get(key)
+            self.bytes += len(blob)
+            return blob
+
+        def put(self, key, blob):
+            self.inner.put(key, blob)
+
+        def list(self, prefix):
+            return self.inner.list(prefix)
+
+    with tempfile.TemporaryDirectory() as root:
+        counting = _CountingStore(LocalArtifactStore(root))
+        write_sharded_batches(counting, "bench",
+                              [DataSet(x, y) for x, y in raw])
+        src = StoreShardSource(counting, "bench")
+        plan = ReadPlan(rank=0, n_hosts=2)
+        counting.bytes = 0
+        for i in range(n_batches):
+            lo, hi = plan.local_slice(src.rows(i))
+            src.read(i, lo, hi)
+        per_host_bytes = counting.bytes
+        counting.bytes = 0
+        for i in range(n_batches):
+            src.read(i, 0, src.rows(i))
+        global_bytes = counting.bytes
+
+    steps = n_batches * num_epochs
+    return {
+        "metric": "data_service_steps_per_sec",
+        "value": round(steps / t_service, 1),
+        "unit": "steps/sec",
+        "platform": platform,
+        "n_devices": n_dev,
+        "config_sig": f"b{batch_size}_nb{n_batches}_e{num_epochs}",
+        "samples_per_sec": round(steps * batch_size / t_service, 1),
+        "steps_per_sec_legacy": round(steps / t_legacy, 1),
+        "bit_exact_vs_legacy": bit_exact,
+        "ingest_overlap_frac": round(overlap_frac, 3),
+        "ingest_stage_ms": ing["stage_ms"],
+        "consumer_wait_ms": round(consumer_wait_s * 1e3, 3),
+        "batches_staged": ing["batches_staged"],
+        "prefetch_depth_hw": ing["depth_hw"],
+        "per_host_read_bytes": per_host_bytes,
+        "global_read_bytes": global_bytes,
+        "per_host_read_frac": round(per_host_bytes / global_bytes, 3),
+        "compile_delta": compile_delta,
+    }
+
+
 def bench_serving(n_requests: int = 400, n_clients: int = 8,
                   max_batch: int = 64):
     """Inference serving row (serving/engine.py + serving/batcher.py):
@@ -2368,6 +2513,10 @@ INNER = {"probe": bench_probe, "bert": bench_bert, "gpt": bench_gpt,
          "resnet_s2d": lambda: bench_resnet(stem_s2d=True),
          # self-healing row: guarded-step rate + skip/ckpt evidence
          "resilience": bench_resilience,
+         # distributed data service: service-vs-legacy step rate,
+         # ingest/compute overlap, per-host 1/n read bytes,
+         # compile_delta == 0
+         "data_service": bench_data_service,
          # inference serving row: eager-vs-engine throughput, p50/p99
          # under concurrent load, steady-state compile_delta == 0
          "serving": bench_serving,
@@ -2406,6 +2555,7 @@ TIMEOUTS = {"probe": (240, 120), "bert": (900, 420),
             "bert_b64": (1200, 0), "bert_b128": (1200, 0),
             "bert_b256": (1200, 0), "bert_T512b32": (1500, 0),
             "resnet_s2d": (1800, 0), "resilience": (300, 240),
+            "data_service": (300, 240),
             # decode_serving grew the tier-2 (int8, prefix, autoscale)
             # and tier-3 (paged, speculative + its brief corpus
             # training, hot swap) sections on top of the fp32 drill

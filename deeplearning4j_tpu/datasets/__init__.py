@@ -2,3 +2,5 @@
 reference's org.nd4j.linalg.dataset.DataSet + Canova RecordReader bridge)."""
 
 from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.data_service import (  # noqa: F401
+    DataService, ReadPlan)

@@ -1,0 +1,572 @@
+"""Distributed data service: per-host shard readers over a cluster.
+
+ROADMAP item 4 (tf.data-service-shaped ingest; PAPERS.md: arxiv
+1605.08695's input-pipeline design, arxiv 2309.08918 on keeping the
+accelerators fed).  The mesh-spanning fit path used to stage the SAME
+global batch on every process — per-host ingest cost O(total) instead
+of O(1/hosts), so host bandwidth bounded step time at fleet scale.
+This module gives every process a 1/n_hosts read plan instead:
+
+- **Read plan** (:class:`ReadPlan`): shard assignment by dense member
+  rank over the CURRENT cluster generation.  Each process reads only
+  its row slice of every batch; the padded global row count is an
+  exact multiple of ``lcm(pad_chunk, n_hosts)`` so the per-host slice
+  boundary never splits a device shard, and rows past the real count
+  zero-pad + mask through the existing ``n_valid`` path.
+- **Shuffle/epoch protocol**: one agreed epoch seed per epoch over the
+  ``Cluster`` KV store (coordinator broadcast, every member verifies
+  the digest — drift raises :class:`ShuffleDesyncError` instead of
+  silently forking the sample stream).  The permutation is derived
+  membership-independently (``np.random.SeedSequence([seed, epoch])``)
+  so the global sample order is identical to single-host at ANY fleet
+  size, including across an elastic shrink.
+- **DCN-tuned prefetch**: depth-k staging on the shared
+  :class:`~deeplearning4j_tpu.datasets.iterator.PrefetchIterator`
+  producer thread; batches land PRE-SHARDED via
+  ``jax.make_array_from_process_local_data`` — the device_put IS the
+  scatter, each host transfers only its slice.
+- **Elastic re-sharding with zero replay**: reader state (epoch,
+  permutation cursor, seed, generation) rides every checkpoint's meta
+  AND the manifest (``CheckpointManager.ingest_state``).  On an
+  ``elastic_remesh`` shrink the read plan is recomputed for the
+  surviving generation and the stream resumes at the exact committed
+  cursor — a host loss never replays or skips a sample, and resume is
+  bit-exact vs an uninterrupted run (tested; multihost gate phase D).
+
+Wired as the default ingest for ``ResilientFit(cluster=)`` when the
+mesh spans hosts (``ResilienceConfig.data_service``); standalone use::
+
+    service = DataService.from_batches(batches, cluster=cluster)
+    order = service.epoch_order(epoch)
+    ds = service.staged(epoch, pos, order)   # staged, pre-sharded
+
+Every staged batch books the "ingest" telemetry family
+(``runtime.metrics.ingest_metrics``): per-host bytes, stage latency,
+prefetch depth high-water, shard reassignments, reader-state
+round-trips, seed agreements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (DataSetIterator,
+                                                  PrefetchIterator)
+
+
+class ShuffleDesyncError(RuntimeError):
+    """A member's epoch permutation disagrees with the coordinator's —
+    the sample streams would silently fork (each host training on a
+    different global order) if this dispatched."""
+
+    def __init__(self, epoch: int, member: int, mine: str, agreed: str):
+        self.epoch = epoch
+        super().__init__(
+            f"epoch {epoch} shuffle desync: member {member} derived "
+            f"order digest {mine} but the cluster agreed on {agreed} — "
+            "mismatched seed/rollback state between hosts")
+
+
+class ReaderStateError(RuntimeError):
+    """Checkpointed reader state inconsistent with the resume step —
+    honoring it would replay or skip samples."""
+
+
+def _lcm(a: int, b: int) -> int:
+    return a * b // math.gcd(a, b) if a and b else max(a, b, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadPlan:
+    """Which rows of every padded global batch THIS process reads:
+    the contiguous 1/n_hosts slice at its dense member rank, pinned to
+    a cluster generation so a shrink visibly invalidates the plan."""
+
+    rank: int = 0
+    n_hosts: int = 1
+    generation: int = 0
+
+    @classmethod
+    def for_cluster(cls, cluster) -> "ReadPlan":
+        if cluster is None or cluster.process_count == 1:
+            return cls()
+        return cls(rank=cluster.member_rank,
+                   n_hosts=cluster.process_count,
+                   generation=int(getattr(cluster, "generation", 0)))
+
+    def local_slice(self, padded_rows: int) -> Tuple[int, int]:
+        """[lo, hi) of the padded global batch this process stages.
+        ``padded_rows`` must be a multiple of ``n_hosts`` (the service
+        pads to ``lcm(pad_chunk, n_hosts)``)."""
+        if padded_rows % self.n_hosts:
+            raise ValueError(
+                f"padded batch of {padded_rows} rows does not divide "
+                f"across {self.n_hosts} hosts")
+        per = padded_rows // self.n_hosts
+        return self.rank * per, (self.rank + 1) * per
+
+
+# -- sources -----------------------------------------------------------------
+
+class BatchSource:
+    """Random-access row reads over an ordered list of global batches —
+    the contract a shard reader needs: ``read(i, lo, hi)`` must fetch
+    ONLY the requested rows (that is the 1/n_hosts IO win)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def rows(self, index: int) -> int:
+        """Real (unpadded) row count of global batch ``index``."""
+        raise NotImplementedError
+
+    def read(self, index: int, lo: int, hi: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """(features, labels) rows [lo, hi) of batch ``index``; an
+        empty range returns zero-row arrays with the right trailing
+        dims."""
+        raise NotImplementedError
+
+
+class ListBatchSource(BatchSource):
+    """In-memory batches (the ``ResilientFit(list-of-DataSet)`` shape).
+    Reads slice without copying the full batch — host->device bytes are
+    still 1/n_hosts even though host RAM holds everything."""
+
+    def __init__(self, batches: Sequence[DataSet]):
+        if not batches:
+            raise ValueError("ListBatchSource needs at least one batch")
+        self._x = [np.asarray(b.features) for b in batches]
+        self._y = [np.asarray(b.labels) for b in batches]
+
+    def __len__(self) -> int:
+        return len(self._x)
+
+    def rows(self, index: int) -> int:
+        return int(self._x[index].shape[0])
+
+    def read(self, index: int, lo: int, hi: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        return self._x[index][lo:hi], self._y[index][lo:hi]
+
+
+def write_sharded_batches(store, prefix: str, batches: Sequence[DataSet],
+                          block_rows: int = 0) -> List[str]:
+    """Persist batches as ROW BLOCKS — one store key per block per
+    batch (``{prefix}/b{i}/r{lo}_{hi}.npz``) — so a shard reader
+    fetches only the blocks overlapping its slice: the store-layer half
+    of per-host 1/n reads (``store_iterator.write_batches_to_store``
+    keeps the whole-batch layout for single-host streams).  Default
+    block size is 1/8 of the batch.  Returns the keys."""
+    keys = []
+    for i, ds in enumerate(batches):
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        n = x.shape[0]
+        blk = block_rows if block_rows > 0 else max(1, -(-n // 8))
+        for lo in range(0, n, blk):
+            hi = min(lo + blk, n)
+            buf = io.BytesIO()
+            np.savez(buf, features=x[lo:hi], labels=y[lo:hi])
+            key = (f"{prefix.rstrip('/')}/b{i:05d}/"
+                   f"r{lo:08d}_{hi:08d}.npz")
+            store.put(key, buf.getvalue())
+            keys.append(key)
+    return keys
+
+
+class StoreShardSource(BatchSource):
+    """Row-block reads out of an ``ArtifactStore`` written by
+    :func:`write_sharded_batches` — ``read`` fetches only overlapping
+    blocks, so per-host store IO is proportional to the slice, not the
+    batch."""
+
+    def __init__(self, store, prefix: str):
+        self.store = store
+        # {batch index: sorted [(lo, hi, key)]}
+        self._blocks: Dict[int, List[Tuple[int, int, str]]] = {}
+        for key in store.list(prefix.rstrip("/") + "/"):
+            tail = key.rsplit("/", 2)
+            if len(tail) != 3 or not tail[1].startswith("b"):
+                continue
+            try:
+                idx = int(tail[1][1:])
+                lo_s, hi_s = tail[2][1:].split(".", 1)[0].split("_")
+                self._blocks.setdefault(idx, []).append(
+                    (int(lo_s), int(hi_s), key))
+            except ValueError:
+                continue
+        if not self._blocks:
+            raise ValueError(f"no row-block batches under {prefix!r} "
+                             "(write_sharded_batches layout)")
+        for blocks in self._blocks.values():
+            blocks.sort()
+        # one block fetch serves trailing-dim metadata for empty reads
+        first = self._fetch(self._blocks[min(self._blocks)][0][2])
+        self._dims = (first[0].shape[1:], first[1].shape[1:],
+                      first[0].dtype, first[1].dtype)
+
+    def _fetch(self, key: str) -> Tuple[np.ndarray, np.ndarray]:
+        with np.load(io.BytesIO(self.store.get(key)),
+                     allow_pickle=False) as z:
+            return z["features"], z["labels"]
+
+    def __len__(self) -> int:
+        return max(self._blocks) + 1
+
+    def rows(self, index: int) -> int:
+        return self._blocks[index][-1][1]
+
+    def read(self, index: int, lo: int, hi: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        xd, yd, xt, yt = self._dims
+        if hi <= lo:
+            return (np.zeros((0,) + xd, xt), np.zeros((0,) + yd, yt))
+        xs, ys = [], []
+        for blo, bhi, key in self._blocks[index]:
+            if bhi <= lo or blo >= hi:
+                continue
+            x, y = self._fetch(key)
+            xs.append(x[max(lo - blo, 0):hi - blo])
+            ys.append(y[max(lo - blo, 0):hi - blo])
+        return np.concatenate(xs), np.concatenate(ys)
+
+
+# -- the service -------------------------------------------------------------
+
+class _ShardReader(DataSetIterator):
+    """Producer-side core: walks epoch-order positions from a cursor
+    and materializes this host's staged slice of each batch.  Runs on
+    the PrefetchIterator producer thread — the read + pad + H2D submit
+    all overlap device compute."""
+
+    def __init__(self, service: "DataService", epoch: int, start: int,
+                 order: Sequence[int]):
+        super().__init__(0)
+        self._service = service
+        self._epoch = epoch
+        self._order = list(order)
+        self._pos = start
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._order)
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        ds = self._service._materialize(self._order[self._pos])
+        self._pos += 1
+        return ds
+
+    def reset(self) -> None:   # stagers are replaced, never rewound
+        raise RuntimeError("_ShardReader does not reset; the service "
+                           "restarts staging at an explicit cursor")
+
+    def total_examples(self) -> int:
+        return sum(self._service.source.rows(i) for i in self._order)
+
+    def input_columns(self) -> int:
+        x, _ = self._service.source.read(self._order[0], 0, 1)
+        return int(x.shape[-1])
+
+    def total_outcomes(self) -> int:
+        _, y = self._service.source.read(self._order[0], 0, 1)
+        return int(y.shape[-1])
+
+
+class DataService:
+    """Per-host shard reader + cluster-coordinated shuffle + elastic
+    re-sharding (module docstring).  One instance per process; hand it
+    to ``ResilientFit.fit`` in place of the batch list (or let the
+    driver auto-wrap when the mesh spans hosts).
+
+    ``staged(epoch, pos, order)`` is self-correcting: if ``(epoch,
+    pos, order)`` is not the next expected position — a resume, a
+    rollback's reshuffle, a shrink — the internal prefetch stager is
+    restarted at exactly that cursor, so the caller never reasons about
+    stream state."""
+
+    def __init__(self, source: BatchSource, cluster=None, seed: int = 0,
+                 depth: int = 4):
+        self.source = source
+        self.cluster = cluster
+        self.seed = int(seed)
+        self.depth = depth
+        self._plan = ReadPlan.for_cluster(cluster)
+        self._mesh = None
+        self._pad_chunk = 1
+        self._dp_mode = False
+        self._spans = False
+        self._stager: Optional[PrefetchIterator] = None
+        self._sig: Optional[Tuple[int, Tuple[int, ...]]] = None
+        self._next_pos = -1
+        self._agreed: Optional[Tuple[int, Tuple[int, ...]]] = None
+        self._stride: Optional[int] = None
+
+    @classmethod
+    def from_batches(cls, batches: Sequence[DataSet], cluster=None,
+                     **kw) -> "DataService":
+        return cls(ListBatchSource(batches), cluster=cluster, **kw)
+
+    @classmethod
+    def from_store(cls, store, prefix: str, cluster=None,
+                   **kw) -> "DataService":
+        """Service over a :func:`write_sharded_batches` row-block
+        layout — the multi-host successor to
+        ``multihost.worker_store_iterator`` (which shards by KEY and so
+        cannot keep a mesh-spanning global batch identical across
+        hosts)."""
+        return cls(StoreShardSource(store, prefix), cluster=cluster,
+                   **kw)
+
+    def __len__(self) -> int:
+        return len(self.source)
+
+    @property
+    def plan(self) -> ReadPlan:
+        return self._plan
+
+    # -- geometry ----------------------------------------------------------
+    def configure(self, mesh=None, cluster=None, pad_chunk: int = 1,
+                  dp_mode: bool = False, spans: bool = False) -> None:
+        """Bind the service to the CURRENT dispatch geometry (called by
+        ResilientFit after every ``_build_dispatch``, including the
+        elastic-resume rebuild).  A changed read plan — new cluster
+        generation or fleet size — books a shard reassignment and
+        restarts staging under the new plan."""
+        from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+
+        self.cluster = cluster
+        new_plan = ReadPlan.for_cluster(cluster)
+        replanned = new_plan != self._plan
+        changed = (replanned or mesh is not self._mesh
+                   or pad_chunk != self._pad_chunk
+                   or dp_mode != self._dp_mode or spans != self._spans)
+        if replanned:
+            ingest_metrics.note("reassignments")
+        self._plan = new_plan
+        self._mesh = mesh
+        self._pad_chunk = max(int(pad_chunk), 1)
+        self._dp_mode = bool(dp_mode)
+        self._spans = bool(spans)
+        if changed:
+            self._invalidate()
+
+    def _invalidate(self) -> None:
+        if self._stager is not None:
+            self._stager.close()
+        self._stager = None
+        self._sig = None
+        self._next_pos = -1
+
+    def close(self) -> None:
+        self._invalidate()
+
+    def __enter__(self) -> "DataService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- shuffle/epoch protocol --------------------------------------------
+    def epoch_order(self, epoch: int) -> List[int]:
+        """Deterministic permutation of batch indices for ``epoch`` —
+        a membership-independent function of (seed, epoch), so every
+        fleet size (and every post-shrink generation) derives the SAME
+        global order."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, int(epoch)]))
+        return [int(i) for i in rng.permutation(len(self.source))]
+
+    def _agree_epoch(self, epoch: int,
+                     order: Sequence[int]) -> None:
+        """One KV agreement round per (epoch, order): the coordinator
+        broadcasts its order digest; a member whose digest differs
+        raises :class:`ShuffleDesyncError` BEFORE any sample of the
+        epoch dispatches."""
+        from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+
+        key = (int(epoch), tuple(int(i) for i in order))
+        if self._agreed == key:
+            return
+        cl = self.cluster
+        if cl is not None and cl.process_count > 1:
+            digest = hashlib.blake2s(
+                json.dumps([key[0], list(key[1])]).encode(),
+                digest_size=8).hexdigest()
+            agreed = json.loads(cl.broadcast(
+                json.dumps({"epoch": int(epoch), "digest": digest}),
+                "ingest_epoch"))
+            if agreed["digest"] != digest or agreed["epoch"] != epoch:
+                raise ShuffleDesyncError(
+                    epoch, cl.process_id, digest,
+                    f"{agreed['digest']} (epoch {agreed['epoch']})")
+        ingest_metrics.note("seed_agreements")
+        self._agreed = key
+
+    # -- staging -----------------------------------------------------------
+    def _chunk(self) -> int:
+        chunk = self._pad_chunk
+        if self._spans:
+            chunk = _lcm(chunk, self._plan.n_hosts)
+        return chunk
+
+    def _materialize(self, index: int) -> DataSet:
+        """Read this host's slice of global batch ``index``, pad, and
+        land it on the mesh (producer thread).  Spanning meshes stage
+        via ``make_array_from_process_local_data`` — each host
+        transfers ONLY its rows; the staged global batch is
+        bit-identical to the legacy stage-everything path."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.runtime import telemetry
+        from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+
+        n_valid = self.source.rows(index)
+        chunk = self._chunk()
+        target = -(-n_valid // chunk) * chunk
+        if target != n_valid and not self._dp_mode:
+            raise ValueError(
+                f"batch {index} has {n_valid} rows but the dispatch "
+                f"cannot mask padding (needs a multiple of {chunk})")
+        if self._spans:
+            lo, hi = self._plan.local_slice(target)
+            x, y = self.source.read(index, lo, min(hi, n_valid))
+            x = _pad_np(x, hi - lo)
+            y = _pad_np(y, hi - lo)
+        else:
+            x, y = self.source.read(index, 0, n_valid)
+            x = _pad_np(x, target)
+            y = _pad_np(y, target)
+        local_bytes = int(x.nbytes + y.nbytes)
+        t0 = time.perf_counter()
+        if self._spans:
+            from deeplearning4j_tpu.parallel.sharded_fit import \
+                batch_sharding
+            sharding = batch_sharding(self._mesh)
+            xg = jax.make_array_from_process_local_data(sharding, x)
+            yg = jax.make_array_from_process_local_data(sharding, y)
+        elif self._mesh is not None:
+            from deeplearning4j_tpu.parallel.sharded_fit import \
+                batch_sharding
+            sharding = batch_sharding(self._mesh)
+            xg = jax.device_put(x, sharding)
+            yg = jax.device_put(y, sharding)
+        else:
+            xg, yg = jnp.asarray(x), jnp.asarray(y)
+        stage_ms = (time.perf_counter() - t0) * 1e3
+        ingest_metrics.note_staged(local_bytes, stage_ms)
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            tr.event("ingest.shard_stage", batch=int(index),
+                     bytes=local_bytes, rows=int(n_valid),
+                     stage_ms=round(stage_ms, 3),
+                     rank=self._plan.rank, n_hosts=self._plan.n_hosts)
+        ds = DataSet(xg, yg)
+        ds.n_valid = n_valid
+        ds.staged_global = True
+        return ds
+
+    def staged(self, epoch: int, pos: int,
+               order: Sequence[int]) -> DataSet:
+        """The staged batch for position ``pos`` of ``order`` in
+        ``epoch`` (order as produced by :meth:`epoch_order` or the
+        driver's own deterministic schedule).  Consecutive calls stream
+        off the depth-k prefetch; any discontinuity restarts the stager
+        at the requested cursor."""
+        from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+
+        sig = (int(epoch), tuple(int(i) for i in order))
+        if self._stager is None or sig != self._sig \
+                or pos != self._next_pos:
+            self._invalidate()
+            self._agree_epoch(epoch, order)
+            self._sig = sig
+            self._stager = PrefetchIterator(
+                _ShardReader(self, epoch, pos, order), depth=self.depth)
+        q = self._stager._queue
+        if q is not None:
+            ingest_metrics.note_depth(q.qsize())
+        ds = self._stager.next()
+        self._next_pos = pos + 1
+        return ds
+
+    # -- reader state (checkpoint manifest protocol) -----------------------
+    def state(self, step: int) -> Dict:
+        """Reader state to commit WITH ``step``'s checkpoint: the exact
+        resume cursor (epoch + position), the shuffle seed, and the
+        plan generation it was taken under.  Rides the checkpoint meta
+        and the manifest (``CheckpointManager.ingest_state``)."""
+        n = max(len(self.source), 1)
+        epoch, cursor = divmod(int(step), n)
+        return {"epoch": epoch, "cursor": cursor, "seed": self.seed,
+                "generation": self._plan.generation,
+                "n_hosts": self._plan.n_hosts, "n_batches": n}
+
+    def restore_state(self, state: Optional[Dict], step: int) -> None:
+        """Adopt checkpointed reader state for a resume at ``step``.
+        Validates zero-replay/zero-skip: the committed cursor must be
+        exactly ``divmod(step, n_batches)`` — anything else means the
+        stream and the params disagree, and honoring either would
+        replay or skip samples.  A changed generation (resume after a
+        shrink) books a reassignment; staging restarts at the cursor on
+        the next ``staged()``."""
+        from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+
+        ingest_metrics.note("state_roundtrips")
+        self._invalidate()
+        if state is None:
+            return      # pre-service checkpoint: cursor derives from step
+        n = max(int(state.get("n_batches", len(self.source))), 1)
+        if n != max(len(self.source), 1):
+            raise ReaderStateError(
+                f"checkpoint reader state covers {n} batches but the "
+                f"service holds {len(self.source)}")
+        epoch, cursor = divmod(int(step), n)
+        got = (int(state["epoch"]), int(state["cursor"]))
+        if got != (epoch, cursor):
+            delta = (got[0] * n + got[1]) - (epoch * n + cursor)
+            what = "replay" if delta > 0 else "skip"
+            raise ReaderStateError(
+                f"reader state at epoch {got[0]} cursor {got[1]} but "
+                f"step {step} resumes at epoch {epoch} cursor {cursor}"
+                f" — honoring it would {what} {abs(delta)} batch(es)")
+        if int(state.get("seed", self.seed)) != self.seed:
+            raise ReaderStateError(
+                f"checkpoint shuffle seed {state['seed']} != service "
+                f"seed {self.seed} — the resumed order would diverge")
+        if int(state.get("generation", 0)) != self._plan.generation:
+            ingest_metrics.note("reassignments")
+
+    # -- audit -------------------------------------------------------------
+    def sample_ids(self, epoch: int, pos: int,
+                   order: Sequence[int]) -> List[int]:
+        """Stable global ids of the samples consumed at (epoch, pos) —
+        ``batch_index * stride + row`` for the real (unpadded) rows.
+        The zero-replay drills collect these across a kill/resume and
+        compare against an uninterrupted run."""
+        if self._stride is None:
+            self._stride = max(self.source.rows(i)
+                               for i in range(len(self.source)))
+        i = int(order[int(pos)])
+        return [i * self._stride + r for r in range(self.source.rows(i))]
+
+
+def _pad_np(arr: np.ndarray, target: int) -> np.ndarray:
+    """Zero-pad the leading axis up to ``target`` rows (host side —
+    padding must happen BEFORE staging so the H2D transfer is one
+    shot; ``parallel.mesh.pad_rows`` is the device-side twin)."""
+    b = arr.shape[0]
+    if b == target:
+        return np.ascontiguousarray(arr)
+    out = np.zeros((target,) + arr.shape[1:], dtype=arr.dtype)
+    out[:b] = arr
+    return out

@@ -241,7 +241,15 @@ class PrefetchIterator(DataSetIterator):
     count rides along as ``DataSet.n_valid`` for the masked-loss
     contract (``parallel/mesh.pad_global_batch``).  Every staged batch
     books bytes + submission wall-ms into
-    ``runtime.metrics.dp_metrics``."""
+    ``runtime.metrics.dp_metrics``.
+
+    Lifecycle: the iterator is a context manager — ``close()`` (or
+    leaving a ``with`` block, normally OR through an exception) stops
+    the producer, drains whatever it already queued, and joins the
+    staging thread, so an abandoned or erroring fit can never leak it.
+    A producer-side error surfaced through ``next()`` performs the same
+    drain before raising.  ``close()`` is idempotent and terminal for
+    the current pass; ``reset()`` still rewinds for another epoch."""
 
     _STOP = object()
 
@@ -347,12 +355,17 @@ class PrefetchIterator(DataSetIterator):
             raise StopIteration
         ds, self._peeked = self._peeked, None
         if isinstance(ds, Exception):
-            # producer died on this batch; the epoch is over (has_next
-            # -> False after the trailing STOP) — callers never hang
+            # producer died on this batch: drain + join it BEFORE
+            # surfacing the error, so an erroring fit that never calls
+            # close()/reset() afterwards still leaks no staging thread
+            self._shutdown()
+            self._done = True
             raise RuntimeError("prefetch producer failed") from ds
         return self._post(ds)
 
-    def reset(self) -> None:
+    def _shutdown(self) -> None:
+        """Stop the producer, discard its queue, join the thread.
+        Idempotent — the shared teardown of close()/reset()/error."""
         if self._thread is not None:
             # signal the producer to stop FETCHING (a naive drain would
             # make it read + deserialize every remaining inner batch just
@@ -370,6 +383,25 @@ class PrefetchIterator(DataSetIterator):
         self._queue = None
         self._stop = None
         self._peeked = None
+
+    def close(self) -> None:
+        """Terminal drain for an ABANDONED pass (the fit errored, or the
+        caller is done mid-epoch): producer stopped, queue discarded,
+        thread joined.  Unlike ``reset()`` it never touches the inner
+        iterator, and ``has_next()`` afterwards is False without
+        restarting the producer.  Idempotent."""
+        self._shutdown()
+        self._done = True
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def reset(self) -> None:
+        self._shutdown()
         self._done = False
         self.inner.reset()
 

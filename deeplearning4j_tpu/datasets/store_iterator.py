@@ -139,7 +139,3 @@ class StoreDataSetIterator(PrefetchIterator):
     @property
     def store(self) -> ArtifactStore:
         return self.inner.store
-
-    def close(self) -> None:
-        """Stop the producer and drop queued batches (reset's drain)."""
-        self.reset()

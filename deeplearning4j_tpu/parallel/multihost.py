@@ -484,6 +484,36 @@ class Cluster:
                 out[m] = self.kv.get(self._key(tag, rnd, m), timeout)
         return out
 
+    def exchange(self, value: str, tag: str,
+                 timeout_s: Optional[float] = None) -> Dict[int, str]:
+        """Every member publishes a blob and reads EVERY member's —
+        the symmetric form of :meth:`gather` (same key layout, same
+        two-round-lag GC safety: a member only starts round ``rnd``
+        after fully completing ``rnd - 1``'s reads).  The substrate of
+        the data service's staging row-count agreement.  Named
+        ``exchange`` rather than the SPMD spelling ``all_gather``: this
+        is a host-side KV rendezvous, not a device collective over a
+        mesh axis."""
+        if self.process_count == 1:
+            return {self.process_id: value}
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        rnd = self._next_round(tag)
+        self._publish(tag, rnd, value)
+        out = {self.process_id: value}
+        for m in self.members:
+            if m != self.process_id:
+                out[m] = self.kv.get(self._key(tag, rnd, m), timeout)
+        return out
+
+    def broadcast(self, value: str, tag: str,
+                  timeout_s: Optional[float] = None) -> str:
+        """The COORDINATOR's blob becomes every member's return value —
+        one agreed value per round (the data service's epoch-seed
+        primitive).  Implemented as an :meth:`exchange` so every
+        member still acknowledges the round: the coordinator never runs
+        ahead of a slow reader, which keeps the per-tag key GC safe."""
+        return self.exchange(value, tag, timeout_s)[self.coordinator]
+
     # -- device topology ---------------------------------------------------
     def devices_of(self, member: int) -> Tuple[int, ...]:
         """Global device ids a member owns (explicit ``device_map`` for
@@ -576,11 +606,12 @@ def worker_store_iterator(store, prefix: str, cluster: Cluster,
     Data contract: a worker split feeds PER-HOST pipelines (streaming
     ``fit_iterator`` on a host-local mesh, per-host preprocessing).
     It is NOT the input to ``ResilientFit`` on a mesh that SPANS
-    hosts — that path requires every process to pass the IDENTICAL
-    global batch list (``stage_global_batch`` then slices each
-    process's own rows out of it); feeding disjoint shards there would
-    silently train on a rank-slice of a shard and desynchronize the
-    members' step counts."""
+    hosts — feeding disjoint shards there would silently train on a
+    rank-slice of a shard and desynchronize the members' step counts.
+    For spanning meshes use ``datasets.data_service.DataService`` (the
+    default ingest ``ResilientFit`` wires for a multi-host cluster):
+    it keeps the global sample order single-host-identical while each
+    process reads and stages only its own row slice."""
     from deeplearning4j_tpu.datasets.store_iterator import \
         StoreDataSetIterator
 
@@ -588,6 +619,54 @@ def worker_store_iterator(store, prefix: str, cluster: Cluster,
                                 shard_index=cluster.member_rank,
                                 num_shards=cluster.process_count,
                                 **kwargs)
+
+
+class StagingMismatchError(RuntimeError):
+    """The processes of a cluster tried to stage DIFFERENT global
+    batches: their row counts disagree.  Raised by
+    :func:`stage_global_batch`'s KV-store agreement check — naming the
+    disagreeing ranks — instead of letting the mismatch surface as an
+    opaque XLA shape error mid-dispatch (or worse, as silently
+    divergent training).  ``counts`` maps member id -> (rows_x,
+    rows_y) as published."""
+
+    def __init__(self, counts: Dict[int, Tuple[int, int]]):
+        self.counts = dict(counts)
+        majority = max(set(self.counts.values()),
+                       key=list(self.counts.values()).count)
+        outliers = sorted(m for m, c in self.counts.items()
+                          if c != majority)
+        super().__init__(
+            f"staging row-count disagreement across the cluster: "
+            f"member(s) {outliers} staged "
+            f"{ {m: self.counts[m] for m in outliers} } rows while the "
+            f"majority staged {majority} — every process must pass the "
+            f"same logical global batch to stage_global_batch")
+        self.outliers = tuple(outliers)
+
+
+def _agree_staging_rows(cluster: Cluster, rows_x: int,
+                        rows_y: int) -> None:
+    """One KV agreement round per DISTINCT (rows_x, rows_y) this
+    cluster generation stages: every member publishes its counts and
+    every member checks the full map, so all of them raise the same
+    typed :class:`StagingMismatchError` at the same call site (a
+    divergent raise would strand the agreeing members at their next
+    rendezvous).  Memoized on the cluster handle — steady-state
+    training re-stages one shape forever and must not pay a KV round
+    per step."""
+    seen = getattr(cluster, "_staging_rows_ok", None)
+    if seen is None:
+        seen = cluster._staging_rows_ok = set()
+    if (rows_x, rows_y) in seen:
+        return
+    counts = {
+        m: tuple(json.loads(blob)) for m, blob in cluster.exchange(
+            json.dumps([int(rows_x), int(rows_y)]),
+            "stage_rows").items()}
+    if len(set(counts.values())) > 1:
+        raise StagingMismatchError(counts)
+    seen.add((rows_x, rows_y))
 
 
 def local_rows(arr, cluster: Cluster):
@@ -613,7 +692,11 @@ def stage_global_batch(x, y, mesh, cluster: Optional[Cluster] = None):
     Contract: every process must pass the SAME logical global ``x``/
     ``y`` (same values, same row order, rows divisible by the member
     count) — this function slices rank-local rows out of it, it does
-    not gather disjoint per-host shards into a global batch."""
+    not gather disjoint per-host shards into a global batch.  The row
+    counts are AGREED over the cluster KV store once per distinct
+    shape: a process staging a different global batch raises a typed
+    :class:`StagingMismatchError` naming the disagreeing ranks, on
+    every member, before anything is dispatched."""
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.parallel.sharded_fit import batch_sharding
@@ -624,6 +707,8 @@ def stage_global_batch(x, y, mesh, cluster: Optional[Cluster] = None):
                 jax.device_put(jnp.asarray(y), sharding))
     import numpy as np
 
+    x, y = np.asarray(x), np.asarray(y)
+    _agree_staging_rows(cluster, x.shape[0], y.shape[0])
     return (jax.make_array_from_process_local_data(
                 sharding, np.asarray(local_rows(x, cluster))),
             jax.make_array_from_process_local_data(

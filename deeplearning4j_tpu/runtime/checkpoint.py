@@ -513,12 +513,17 @@ class CheckpointManager:
         t0 = time.perf_counter()
         meta = dict(meta or {})
         meta.update({"step": step, "time": time.time()})
+        # data-service reader state (datasets/data_service.py) rides the
+        # sidecar like any meta AND is mirrored into the manifest, so
+        # ingest tooling (multihost gate, ops) can read the resume
+        # cursor without deserializing the tree
+        ingest = meta.get("data_service")
         if self._multi:
-            files = self._save_cluster(step, tree, meta)
+            files = self._save_cluster(step, tree, meta, ingest=ingest)
         else:
             path = self._path(step)
             files = save_pytree(path, tree, meta)
-            self._commit_manifest(step, files)
+            self._commit_manifest(step, files, ingest=ingest)
             self._gc()
         now = time.perf_counter()
         if not _was_async:
@@ -531,14 +536,35 @@ class CheckpointManager:
         return self._path(step)
 
     def _commit_manifest(self, step: int, files: Dict[str, Dict],
-                         cluster_info: Optional[Dict] = None) -> None:
+                         cluster_info: Optional[Dict] = None,
+                         ingest: Optional[Dict] = None) -> None:
         manifest = {"format": 1, "step": step, "files": files}
         if cluster_info:
             manifest["cluster"] = cluster_info
+        if ingest:
+            manifest["ingest"] = ingest
         man_tmp = self._manifest_path(step) + ".tmp"
         with open(man_tmp, "w") as f:
             json.dump(manifest, f, indent=1)
         _replace_with_fsync(man_tmp, self._manifest_path(step))
+
+    def ingest_state(self, step: Optional[int] = None) -> Optional[Dict]:
+        """Data-service reader state committed with ``step`` (newest
+        committed step when None): the resume cursor the distributed
+        data service restores from — readable without deserializing the
+        tree.  None when the step carries no ingest state (pre-service
+        runs) or nothing is committed."""
+        if step is None:
+            committed = [s for s in self.all_steps()[::-1]
+                         if os.path.exists(self._manifest_path(s))]
+            if not committed:
+                return None
+            step = committed[0]
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f).get("ingest")
+        except (OSError, ValueError):
+            return None
 
     @staticmethod
     def _needs_shards(tree: PyTree) -> bool:
@@ -551,8 +577,8 @@ class CheckpointManager:
                 return True
         return False
 
-    def _save_cluster(self, step: int, tree: PyTree,
-                      meta: Dict) -> Dict[str, Dict]:
+    def _save_cluster(self, step: int, tree: PyTree, meta: Dict,
+                      ingest: Optional[Dict] = None) -> Dict[str, Dict]:
         """The cluster-commit protocol (class docstring).  Ordering is
         the whole point: data files first on every member, ONE barrier
         proving all of them durable, manifest LAST by the coordinator,
@@ -591,7 +617,7 @@ class CheckpointManager:
         if cl.is_coordinator:
             self._commit_manifest(step, files, cluster_info={
                 "layout": layout, "members": list(cl.members),
-                "coordinator": cl.coordinator})
+                "coordinator": cl.coordinator}, ingest=ingest)
         cl.barrier(f"ckpt_commit_{seq}")
         multihost_metrics.note("cluster_commits")
         if cl.is_coordinator:

@@ -887,6 +887,75 @@ class MultihostMetrics:
 multihost_metrics = MultihostMetrics()
 
 
+class IngestMetrics:
+    """Process-wide counters for the distributed data service
+    (``datasets/data_service.py`` — per-host shard readers feeding the
+    mesh over DCN):
+
+    - ``bytes_staged`` / ``batches_staged`` / ``stage_ms``: host->HBM
+      bytes THIS process staged (per-host cost — under the read plan
+      each host stages only its 1/n_hosts row slice, so this is the
+      number the O(1/host) ingest contract is measured by) and the
+      submission wall time the training loop actually paid;
+    - ``depth_hw``: prefetch queue high-water mark (how deep the
+      DCN-tuned staging pipeline actually ran);
+    - ``reassignments``: read-plan recomputes — elastic re-shards after
+      a cluster shrink plus explicit ``reshard()`` calls;
+    - ``state_roundtrips``: reader-state trips through the checkpoint
+      manifest (exports into a snapshot's meta + restores out of one);
+    - ``seed_agreements``: per-epoch shuffle-seed agreement rounds over
+      the cluster KV store.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.bytes_staged = 0
+            self.batches_staged = 0
+            self.stage_ms = 0.0
+            self.depth_hw = 0
+            self.reassignments = 0
+            self.state_roundtrips = 0
+            self.seed_agreements = 0
+
+    def note(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, key, getattr(self, key) + by)
+
+    def note_staged(self, nbytes: int, ms: float, batches: int = 1) -> None:
+        with self._lock:
+            self.bytes_staged += int(nbytes)
+            self.batches_staged += batches
+            self.stage_ms += ms
+
+    def note_depth(self, depth: int) -> None:
+        with self._lock:
+            self.depth_hw = max(self.depth_hw, int(depth))
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return getattr(self, key)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "bytes_staged": self.bytes_staged,
+                "batches_staged": self.batches_staged,
+                "stage_ms": round(self.stage_ms, 3),
+                "depth_hw": self.depth_hw,
+                "reassignments": self.reassignments,
+                "state_roundtrips": self.state_roundtrips,
+                "seed_agreements": self.seed_agreements,
+            }
+
+
+#: process-wide singleton the distributed data service reports into
+ingest_metrics = IngestMetrics()
+
+
 def device_memory_stats() -> Dict[str, Any]:
     """Per-device HBM usage where the backend reports it.
 

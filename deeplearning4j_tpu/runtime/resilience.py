@@ -457,6 +457,13 @@ class ResilienceConfig:
     cluster_timeout_s: float = 120.0
     hb_interval_s: float = 2.0
     hb_timeout_s: float = 20.0
+    #: distributed data service (``datasets.data_service``): None =
+    #: auto (on when the mesh spans processes — each host then reads
+    #: and stages only its 1/n_hosts slice instead of the whole global
+    #: batch); True forces it (e.g. thread-"host" drills with
+    #: mesh=None); False keeps the legacy identical-global-batch
+    #: staging (MIGRATION.md — deprecated on spanning meshes)
+    data_service: Optional[bool] = None
 
     def __post_init__(self) -> None:
         # fail at construction, not one `step % checkpoint_every` into
@@ -702,8 +709,26 @@ class ResilientFit:
         spans_hosts = (self.mesh is not None and self._multi
                        and len({d.process_index
                                 for d in self.mesh.devices.flat}) > 1)
+        # geometry the data service binds to (``_configure_service``):
+        # its pre-sharded staging must pad to the SAME target the
+        # legacy path below computes, or the compiled step would see a
+        # second shape (compile_delta != 0) and lose bit-exactness
+        self._dispatch_dp_mode = dp_mode
+        self._dispatch_pad_chunk = pad_chunk
+        self._dispatch_spans = spans_hosts
 
         def dispatch(params, ustate, batch, key, at_step):
+            if getattr(batch, "staged_global", False):
+                # data-service batch: already padded + landed on the
+                # mesh (pre-sharded across hosts when spanning) by the
+                # prefetch producer — dispatch is a pure step call
+                if not dp_mode:
+                    return train_step(params, ustate, batch.features,
+                                      batch.labels, key, at_step)
+                return train_step(
+                    params, ustate, (batch.features, batch.labels,
+                                     jnp.int32(batch.n_valid)),
+                    key, at_step)
             if not dp_mode:
                 return train_step(params, ustate, batch.features,
                                   batch.labels, key, at_step)
@@ -738,7 +763,23 @@ class ResilientFit:
         tpl_u = self._make_ustate(updaters, tpl_p)
         (params, ustate), meta = self.manager.restore(like=(tpl_p, tpl_u))
         self._check_restored(params, meta.get("step"))
+        # elastic resume reads the data-service reader state out of the
+        # restored meta AFTER _elastic_resume returns — stash it here
+        # (the one restore chokepoint) rather than widening every
+        # return signature
+        self._last_restore_meta = meta
         return params, ustate, meta
+
+    def _configure_service(self, service) -> None:
+        """Bind the data service to the CURRENT dispatch geometry
+        (fresh build or elastic-resume rebuild): read plan for the
+        current cluster generation, the dispatch's pad chunk so staged
+        shapes match the legacy path bit-for-bit, and whether staging
+        must pre-shard across processes."""
+        service.configure(mesh=self.mesh, cluster=self.cluster,
+                          pad_chunk=self._dispatch_pad_chunk,
+                          dp_mode=self._dispatch_dp_mode,
+                          spans=self._dispatch_spans)
 
     def _translate_sync_timeout(self, err) -> DeviceLossError:
         """A control-plane timeout on a LIVE cluster means a peer went
@@ -949,11 +990,27 @@ class ResilientFit:
         notice), healing as it goes.  Returns the network with trained
         params set; ``self.preempted`` reports a preemption stop."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.data_service import DataService
 
         cfg = self.config
         net = self.net
-        batches = [data] if isinstance(data, DataSet) else list(data)
-        n_batches = len(batches)
+        service: Optional[DataService] = None
+        if isinstance(data, DataService):
+            service = data
+            batches: List[DataSet] = []
+            n_batches = len(service)
+        else:
+            batches = [data] if isinstance(data, DataSet) else list(data)
+            n_batches = len(batches)
+            spans = (self.mesh is not None and self._multi
+                     and len({d.process_index
+                              for d in self.mesh.devices.flat}) > 1)
+            if cfg.data_service or (cfg.data_service is None and spans):
+                # default ingest for spanning meshes: each host reads
+                # and stages only its 1/n_hosts slice (ROADMAP item 4;
+                # MIGRATION.md deprecates whole-batch staging here)
+                service = DataService.from_batches(
+                    batches, cluster=self.cluster, seed=seed)
         total_steps = num_epochs * n_batches
         # fit-entry listener hook — reuse the model's own dispatch when
         # it has one (MultiLayerNetwork._notify_fit_start) so the hook
@@ -973,6 +1030,8 @@ class ResilientFit:
         # fit_backprop)
         params = jax.tree.map(jnp.copy, net._require_params())
         dispatch, updaters = self._build_dispatch(net)
+        if service is not None:
+            self._configure_service(service)
         ustate = self._make_ustate(updaters, params)
         run_key = jax.random.key(seed)
 
@@ -1014,6 +1073,11 @@ class ResilientFit:
                 params, ustate, meta = self._restore_latest(net, updaters)
                 step = int(meta["step"])
                 rollbacks = int(meta.get("rollbacks", 0))
+                if service is not None:
+                    # committed reader cursor must equal the resume
+                    # step's — zero replayed, zero skipped samples
+                    service.restore_state(
+                        meta.get("data_service"), step)
                 restored = True
                 telemetry.event("resilience.resume", step=step,
                                 rollbacks=rollbacks)
@@ -1025,16 +1089,21 @@ class ResilientFit:
             for serialization/fsync), synchronous for the preemption/
             bounded-slice final snapshot where the commit must be on
             disk before fit returns anyway."""
+            meta = {"rollbacks": rollbacks}
+            if service is not None:
+                # reader state commits WITH the params: the manifest's
+                # resume cursor can never disagree with the step
+                meta["data_service"] = service.state(at_step)
             if self.async_ckpt is None or sync:
                 with telemetry.span("resilience.checkpoint",
                                     step=at_step, mode="sync"):
                     self.manager.save(at_step, (params, ustate),
-                                      meta={"rollbacks": rollbacks})
+                                      meta=meta)
             else:
                 with telemetry.span("resilience.checkpoint",
                                     step=at_step, mode="async"):
                     self.async_ckpt.save(at_step, (params, ustate),
-                                         meta={"rollbacks": rollbacks})
+                                         meta=meta)
             resilience_metrics.note("checkpoints_saved")
 
         if not restored:
@@ -1089,6 +1158,13 @@ class ResilientFit:
             if resumed is None:
                 return False
             dispatch, updaters, params, ustate, step = resumed
+            if service is not None:
+                # re-shard for the surviving generation (the plan
+                # change books a reassignment) and restart the stream
+                # at the committed cursor — zero replay, zero skip
+                self._configure_service(service)
+                service.restore_state(
+                    self._last_restore_meta.get("data_service"), step)
             # the restore may have fallen back below the newest
             # requested save (corrupt-latest case) — re-anchor
             # the rollback target to what is actually good
@@ -1100,7 +1176,8 @@ class ResilientFit:
             return True
 
         with self._writer_guard(), guard, \
-                (self._heartbeat or contextlib.nullcontext()):
+                (self._heartbeat or contextlib.nullcontext()), \
+                (service or contextlib.nullcontext()):
             while step < total_steps:
                 try:
                     # cluster-wide OR: one host's SIGTERM is every
@@ -1134,7 +1211,8 @@ class ResilientFit:
                 epoch, pos = divmod(step, n_batches)
                 order = self._epoch_order(run_key, seed, rollbacks, epoch,
                                           n_batches)
-                batch = batches[order[pos]]
+                batch = (service.staged(epoch, pos, order)
+                         if service is not None else batches[order[pos]])
                 # re-folded key: rollback bumps `rollbacks`, giving the
                 # retry a fresh noise stream on top of the reshuffled
                 # batch order
@@ -1193,6 +1271,12 @@ class ResilientFit:
                         params, ustate, meta = self._restore_latest(
                             net, updaters)
                     step = int(meta["step"])
+                    if service is not None:
+                        # the retry's bumped `rollbacks` reshuffles the
+                        # order — staged() restarts the stream at the
+                        # rollback cursor under the new permutation
+                        service.restore_state(
+                            meta.get("data_service"), step)
                     last_good = step
                     self.detector.reset()
                     continue

@@ -54,6 +54,7 @@ from deeplearning4j_tpu.runtime.metrics import (checkpoint_metrics,
                                                 decode_metrics,
                                                 device_memory_stats,
                                                 dp_metrics,
+                                                ingest_metrics,
                                                 mfu_metrics,
                                                 multihost_metrics,
                                                 peak_bytes_in_use,
@@ -510,6 +511,7 @@ registry.register("dp", dp_metrics)
 registry.register("checkpoint", checkpoint_metrics)
 registry.register("mfu", mfu_metrics)
 registry.register("multihost", multihost_metrics)
+registry.register("ingest", ingest_metrics)
 
 
 # ---------------------------------------------------------------------------

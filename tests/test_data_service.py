@@ -1,0 +1,430 @@
+"""Distributed data service (ISSUE 20): read-plan sharding, the
+cluster-agreed shuffle protocol, elastic re-sharding with zero replay,
+and the satellite hardening (PrefetchIterator lifecycle,
+StagingMismatchError, ragged shards through the ``n_valid`` path).
+
+All TIER-1: thread-"hosts" over an ``InProcessKV`` exercise the real
+protocol code paths single-process (the pattern of
+test_multihost_runtime.py); the REAL 2-process drill — per-host staged
+bytes ≤ 0.6× global, SIGKILL + shrink + zero-replay resume — runs in
+``tools/multihost_gate.py`` phase D.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.cloud.artifacts import LocalArtifactStore
+from deeplearning4j_tpu.datasets.data_service import (
+    DataService, ListBatchSource, ReaderStateError, ReadPlan,
+    ShuffleDesyncError, StoreShardSource, write_sharded_batches)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (ListDataSetIterator,
+                                                  PrefetchIterator)
+from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import multihost as mh
+from deeplearning4j_tpu.parallel.chaos import HostLossChaos
+from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                   ResilientFit)
+
+
+def _mlp_conf():
+    return (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).momentum(0.5).use_adagrad(False)
+            .num_iterations(5).activation("tanh")
+            .list(3).hidden_layer_sizes(8, 6)
+            .override(2, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent",
+                      dropout=0.0)
+            .pretrain(False).backward(True).build())
+
+
+def _batches(n_batches=4, n=16):
+    rng = np.random.RandomState(0)
+    return [DataSet(jnp.asarray(rng.randn(n, 4).astype(np.float32)),
+                    jnp.asarray(np.eye(3, dtype=np.float32)[
+                        rng.randint(0, 3, n)]))
+            for _ in range(n_batches)]
+
+
+def _host_map():
+    devs = jax.devices()
+    return {0: tuple(int(d.id) for d in devs[:4]),
+            1: tuple(int(d.id) for d in devs[4:])}
+
+
+def _cluster_pair(timeout_s=30):
+    kv = mh.InProcessKV()
+    return [mh.Cluster(p, (0, 1), kv, timeout_s=timeout_s,
+                       device_map=_host_map()) for p in (0, 1)]
+
+
+def _threads(fn, n):
+    errs = []
+
+    def wrap(i):
+        try:
+            fn(i)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts), "cluster op hung"
+    if errs:
+        raise errs[0]
+
+
+# -- read plan ---------------------------------------------------------------
+
+def test_read_plan_slices_cover_disjointly_and_reject_ragged():
+    plans = [ReadPlan(rank=r, n_hosts=4, generation=0) for r in range(4)]
+    slices = [p.local_slice(32) for p in plans]
+    assert slices == [(0, 8), (8, 16), (16, 24), (24, 32)]
+    # non-divisible padded count is a caller bug, not silent skew
+    with pytest.raises(ValueError):
+        plans[0].local_slice(30)
+    # no cluster = the trivial plan
+    assert ReadPlan.for_cluster(None) == ReadPlan(0, 1, 0)
+
+
+def test_ragged_batch_pads_to_lcm_and_masks_via_n_valid():
+    """n_rows not divisible by n_hosts: the padded target is the lcm of
+    pad_chunk and host count, trailing rows are zeros, and the REAL
+    count rides ``n_valid`` for the masked-loss path.  The trailing
+    host's slice is entirely padding — read() returns zero rows and the
+    stage still lands a full-shape slice."""
+    src = ListBatchSource([DataSet(np.arange(12 * 4, dtype=np.float32)
+                                   .reshape(12, 4),
+                                   np.ones((12, 3), np.float32))])
+    svc = DataService(src)
+    svc.configure(mesh=None, cluster=None, pad_chunk=8, dp_mode=True,
+                  spans=False)
+    ds = svc.staged(0, 0, [0])
+    assert ds.features.shape[0] == 16 and ds.n_valid == 12
+    np.testing.assert_array_equal(np.asarray(ds.features[12:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(ds.features[:12]),
+                                  src.read(0, 0, 12)[0])
+    svc.close()
+    # the spanning chunk math: lcm(pad_chunk, n_hosts) — and the
+    # trailing rank's slice can be pure padding
+    svc2 = DataService(src)
+    svc2._plan = ReadPlan(rank=3, n_hosts=4, generation=0)
+    svc2._pad_chunk, svc2._dp_mode, svc2._spans = 3, True, True
+    assert svc2._chunk() == 12
+    lo, hi = svc2._plan.local_slice(12)
+    assert (lo, hi) == (9, 12)
+    x, y = src.read(0, lo, min(hi, 12))
+    assert x.shape[0] == 3      # real rows for rank 3 of the 12 valid
+    x2, _ = src.read(0, 12, 12)
+    assert x2.shape == (0, 4)   # fully-padded slice reads zero rows
+    # dispatch that cannot mask refuses padding instead of training on
+    # phantom zero rows
+    svc3 = DataService(src)
+    svc3.configure(mesh=None, cluster=None, pad_chunk=8, dp_mode=False,
+                   spans=False)
+    with pytest.raises(RuntimeError) as ei:   # surfaced off the
+        svc3.staged(0, 0, [0])                # producer thread
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert "cannot mask" in str(ei.value.__cause__)
+
+
+# -- shuffle/epoch protocol --------------------------------------------------
+
+def test_epoch_order_is_membership_independent():
+    """The permutation is a pure function of (seed, epoch) — the global
+    sample order is identical at any fleet size, so a post-shrink
+    generation rederives the SAME epoch order."""
+    batches = _batches(8)
+    solo = DataService.from_batches(batches, seed=11)
+    cls = _cluster_pair()
+    duo = DataService.from_batches(batches, cluster=cls[1], seed=11)
+    for epoch in range(3):
+        assert solo.epoch_order(epoch) == duo.epoch_order(epoch)
+    assert solo.epoch_order(0) != solo.epoch_order(1)
+
+
+def test_epoch_agreement_books_metric_and_desync_raises():
+    batches = _batches(4)
+    cls = _cluster_pair()
+    before = ingest_metrics.count("seed_agreements")
+    got = [None, None]
+
+    def agree(i):
+        svc = DataService.from_batches(batches, cluster=cls[i], seed=5)
+        got[i] = svc.staged(0, 0, svc.epoch_order(0))
+        svc.close()
+
+    _threads(agree, 2)
+    assert ingest_metrics.count("seed_agreements") == before + 2
+    np.testing.assert_array_equal(np.asarray(got[0].features),
+                                  np.asarray(got[1].features))
+
+    # a member deriving a DIFFERENT order must fail loudly before any
+    # sample of the epoch dispatches — not silently fork the stream
+    cls2 = _cluster_pair()
+    errs = [None, None]
+
+    def desync(i):
+        svc = DataService.from_batches(batches, cluster=cls2[i], seed=5)
+        order = svc.epoch_order(0)
+        if i == 1:
+            order = list(reversed(order))
+        try:
+            svc.staged(0, 0, order)
+        except ShuffleDesyncError as e:
+            errs[i] = e
+        finally:
+            svc.close()
+
+    _threads(desync, 2)
+    assert errs[0] is None and isinstance(errs[1], ShuffleDesyncError)
+    assert "desync" in str(errs[1])
+
+
+# -- reader state (zero replay / zero skip) ----------------------------------
+
+def test_reader_state_roundtrip_and_replay_skip_guard():
+    svc = DataService.from_batches(_batches(4), seed=7)
+    state = svc.state(9)
+    assert state == {"epoch": 2, "cursor": 1, "seed": 7, "generation": 0,
+                     "n_hosts": 1, "n_batches": 4}
+    before = ingest_metrics.count("state_roundtrips")
+    svc.restore_state(state, 9)             # exact cursor: accepted
+    svc.restore_state(None, 9)              # pre-service meta: derive
+    assert ingest_metrics.count("state_roundtrips") == before + 2
+    with pytest.raises(ReaderStateError) as ei:
+        svc.restore_state(state, 8)         # one behind -> would replay
+    assert "replay" in str(ei.value)
+    with pytest.raises(ReaderStateError) as ei:
+        svc.restore_state(state, 11)        # ahead -> would skip
+    assert "skip" in str(ei.value)
+    with pytest.raises(ReaderStateError):
+        svc.restore_state({**state, "seed": 99}, 9)
+    with pytest.raises(ReaderStateError):
+        svc.restore_state({**state, "n_batches": 3}, 9)
+
+
+def test_sample_ids_are_stable_and_disjoint():
+    svc = DataService.from_batches(_batches(3, n=8), seed=0)
+    order = [2, 0, 1]
+    ids = [svc.sample_ids(0, p, order) for p in range(3)]
+    flat = [i for chunk in ids for i in chunk]
+    assert len(set(flat)) == 24             # disjoint across positions
+    # same (epoch, pos, order) on another instance = same ids
+    svc2 = DataService.from_batches(_batches(3, n=8), seed=0)
+    assert svc2.sample_ids(0, 1, order) == ids[1]
+
+
+# -- store row-block source --------------------------------------------------
+
+def test_store_shard_source_fetches_only_overlapping_blocks(tmp_path):
+    store = LocalArtifactStore(str(tmp_path))
+    batches = _batches(2, n=16)
+    keys = write_sharded_batches(store, "svc/train", batches,
+                                 block_rows=4)
+    assert len(keys) == 8                   # 2 batches x 4 row blocks
+    fetched = []
+    real_get = store.get
+    store.get = lambda k: (fetched.append(k), real_get(k))[1]
+    src = StoreShardSource(store, "svc/train")
+    assert len(src) == 2 and src.rows(0) == 16
+    fetched.clear()
+    x, y = src.read(1, 4, 12)               # rows 4..12 = blocks 1+2
+    assert x.shape == (8, 4)
+    np.testing.assert_array_equal(x, np.asarray(batches[1].features)[4:12])
+    assert len(fetched) == 2 and all("/b00001/" in k for k in fetched)
+    # empty range: zero rows, right trailing dims, zero fetches
+    fetched.clear()
+    x, y = src.read(0, 16, 16)
+    assert x.shape == (0, 4) and y.shape == (0, 3) and not fetched
+
+
+# -- service-driven ResilientFit ---------------------------------------------
+
+def test_service_fit_bit_exact_vs_legacy_with_manifest_state(tmp_path):
+    """data_service=True must reproduce the legacy list-ingest fit
+    bit-for-bit (same schedule, same staged values), and every
+    committed checkpoint's manifest must carry the reader cursor."""
+    batches = _batches()
+    ref = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+    ResilientFit(ref, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "ref"), checkpoint_every=3)).fit(
+        batches, num_epochs=3, seed=7)
+
+    net = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+    drv = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "svc"), checkpoint_every=3,
+        data_service=True))
+    drv.fit(batches, num_epochs=3, seed=7)
+    np.testing.assert_array_equal(np.asarray(ref.params_flat()),
+                                  np.asarray(net.params_flat()))
+    latest = drv.manager.latest_step()
+    state = drv.manager.ingest_state(latest)
+    assert state["n_batches"] == 4
+    assert (state["epoch"], state["cursor"]) == divmod(latest, 4)
+    man = json.load(open(
+        str(tmp_path / "svc" / f"ckpt_{latest}.npz.manifest.json")))
+    assert man["ingest"] == state
+
+
+def test_service_fit_on_data_mesh_with_ragged_final_batch(tmp_path,
+                                                          devices):
+    """Sharded dp fit through the service with a ragged batch (12 rows
+    on an 8-way data mesh): staging pads + masks via ``n_valid``
+    exactly like the legacy pad path — bit-exact params."""
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    batches = _batches(3) + [DataSet(
+        jnp.asarray(np.random.RandomState(1).randn(12, 4)
+                    .astype(np.float32)),
+        jnp.asarray(np.eye(3, dtype=np.float32)[
+            np.random.RandomState(2).randint(0, 3, 12)]))]
+
+    def run(sub, **cfg):
+        net = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+        ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=str(tmp_path / sub), checkpoint_every=4,
+            **cfg), mesh=make_mesh(MeshSpec(data=8))).fit(
+            batches, num_epochs=2, seed=7)
+        return net
+
+    ref = run("ref", data_service=False)
+    svc = run("svc", data_service=True)
+    np.testing.assert_array_equal(np.asarray(ref.params_flat()),
+                                  np.asarray(svc.params_flat()))
+
+
+def test_epoch_boundary_shrink_resumes_zero_replay_bit_exact(tmp_path):
+    """THE elastic drill (thread-hosts): host 1 dies at step 7 — inside
+    epoch 1 — the survivor shrinks to generation 1, re-derives its read
+    plan (one shard reassignment), restores the committed reader cursor
+    (one state round-trip, zero replayed/skipped batches), and finishes
+    bit-exact vs an uninterrupted run."""
+    batches = _batches()
+    ref = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+    ResilientFit(ref, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "ref"), checkpoint_every=3,
+        data_service=True)).fit(batches, num_epochs=3, seed=7)
+
+    cls = _cluster_pair()
+    drvs = [None, None]
+    before_re = ingest_metrics.count("reassignments")
+    before_rt = ingest_metrics.count("state_roundtrips")
+
+    def run(i):
+        net = MultiLayerNetwork(_mlp_conf()).init(seed=9)
+        drv = ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=str(tmp_path / "c"), checkpoint_every=3,
+            cluster_timeout_s=30, hb_interval_s=0.2, hb_timeout_s=5.0,
+            data_service=True), cluster=cls[i],
+            fault_hook=HostLossChaos(at_step=7, host_index=1,
+                                     n_hosts=2))
+        drvs[i] = drv
+        drv.fit(batches, num_epochs=3, seed=7)
+
+    _threads(run, 2)
+    assert drvs[1].evicted and not drvs[0].evicted
+    assert drvs[0].cluster.generation == 1
+    assert ingest_metrics.count("reassignments") >= before_re + 1
+    assert ingest_metrics.count("state_roundtrips") >= before_rt + 1
+    np.testing.assert_array_equal(
+        np.asarray(ref.params_flat()),
+        np.asarray(drvs[0].net.params_flat()))
+    # the survivor's manifest carries the surviving generation's cursor
+    state = drvs[0].manager.ingest_state()
+    assert state is not None and state["n_batches"] == 4
+
+
+# -- satellite: PrefetchIterator lifecycle -----------------------------------
+
+def test_prefetch_iterator_close_joins_abandoned_producer():
+    """An iterator abandoned mid-epoch (satellite regression): close()
+    — or leaving the with-block — stops the producer, drains the queue,
+    and joins the staging thread; has_next() afterwards is False."""
+    it = PrefetchIterator(ListDataSetIterator(_batches(16)), depth=2)
+    assert it.has_next()
+    it.next()                               # abandon mid-epoch
+    producer = it._thread
+    assert producer is not None
+    it.close()
+    assert it._thread is None and not it.has_next()
+    assert not producer.is_alive()          # joined, not leaked
+    it.close()                              # idempotent
+    # context-manager form, abandoned THROUGH an exception
+    with pytest.raises(RuntimeError, match="boom"):
+        with PrefetchIterator(ListDataSetIterator(_batches(16)),
+                              depth=2) as it2:
+            it2.next()
+            producer = it2._thread
+            raise RuntimeError("boom")
+    assert it2._thread is None and not it2.has_next()
+    assert not producer.is_alive()
+    # reset() still rewinds for another epoch after a close
+    it3 = PrefetchIterator(ListDataSetIterator(_batches(3)), depth=2)
+    it3.next()
+    it3.close()
+    it3.reset()
+    assert sum(1 for _ in it3) == 3
+
+
+def test_prefetch_producer_error_drains_before_raising():
+    class Exploding(ListDataSetIterator):
+        def next(self, num=None):
+            if self._i >= 2:
+                raise ValueError("bad shard")
+            return super().next(num)
+
+    it = PrefetchIterator(Exploding(_batches(8)), depth=2)
+    it.next()
+    producer = it._thread
+    it.next()
+    with pytest.raises(RuntimeError, match="prefetch producer failed"):
+        while it.has_next():
+            it.next()
+    assert it._thread is None               # joined, not leaked
+    assert not producer.is_alive()
+
+
+# -- satellite: typed staging mismatch ---------------------------------------
+
+def test_agree_staging_rows_raises_typed_mismatch_naming_ranks():
+    cls = _cluster_pair()
+    errs = [None, None]
+
+    def run(i):
+        rows = 16 if i == 0 else 12         # member 1 is the outlier
+        try:
+            mh._agree_staging_rows(cls[i], rows, rows)
+        except mh.StagingMismatchError as e:
+            errs[i] = e
+
+    _threads(run, 2)
+    # EVERY member raises (exchange gives each the full count map),
+    # and the error names the disagreeing rank
+    assert all(isinstance(e, mh.StagingMismatchError) for e in errs)
+    assert errs[0].outliers == errs[1].outliers
+    assert "member(s)" in str(errs[0])
+
+    # agreement memoizes per distinct shape: the second call for the
+    # same rows must not burn a KV round (no new keys published)
+    cls2 = _cluster_pair()
+
+    def ok(i):
+        mh._agree_staging_rows(cls2[i], 16, 16)
+        cls2[i].barrier("memo_sync")        # quiesce peer publishes
+        nkeys = len(cls2[i].kv._data)
+        mh._agree_staging_rows(cls2[i], 16, 16)
+        assert len(cls2[i].kv._data) == nkeys
+
+    _threads(ok, 2)

@@ -270,7 +270,7 @@ def test_process_registry_has_all_counter_families():
     snap = registry.snapshot()
     assert set(registry.sources()) == {"compile", "resilience", "serving",
                                        "decode", "dp", "checkpoint", "mfu",
-                                       "multihost"}
+                                       "multihost", "ingest"}
     assert "compile_count" in snap["counters"]["compile"]
     assert "requests" in snap["counters"]["serving"]
     assert "tokens_out" in snap["counters"]["decode"]
@@ -290,6 +290,10 @@ def test_process_registry_has_all_counter_families():
     assert "snapshots_committed" in snap["counters"]["checkpoint"]
     assert "estimates" in snap["counters"]["mfu"]
     assert "cluster_commits" in snap["counters"]["multihost"]
+    # PR 20 distributed data service counters: the "ingest" family
+    for key in ("bytes_staged", "batches_staged", "stage_ms", "depth_hw",
+                "reassignments", "state_roundtrips", "seed_agreements"):
+        assert key in snap["counters"]["ingest"], key
 
 
 def test_registry_reports_run_id_and_span_counts_when_enabled():

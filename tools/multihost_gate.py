@@ -1,6 +1,6 @@
 """CI gate for the multi-host training runtime (parallel/multihost.py).
 
-Two phases, both machine-checking the ISSUE-13 acceptance contract:
+Four phases machine-checking the ISSUE-13/18/20 acceptance contracts:
 
 **Phase A — virtual 2-host drill (always runs, single process).**  The
 8 forced CPU devices partitioned as 2 virtual hosts x 4:
@@ -35,7 +35,23 @@ form cross-process device computations:
 degree must be byte-identical, with ``compile_delta == 0`` on the
 warmed steady-state fit and no copy-on-donate warnings.
 
-Exits 0 with a SKIP note for phase B when 2-process bring-up is
+**Phase D — distributed data service, real 2-process drill (ISSUE 20,
+skip-aware).**  A fresh 2-process cluster whose 16 forced CPU devices
+DO form a real cross-process mesh for staging:
+
+7. per-host shard readers on the spanning mesh: each process's staged
+   bytes must be <= 0.6x the global-staging path at equal global
+   batch, and the staged global arrays must be bit-identical
+   shard-by-shard to ``multihost.stage_global_batch``;
+8. a data-service fit and a legacy whole-batch fit on the SAME cluster
+   must produce bit-identical params (the old path stays exact);
+9. SIGKILL one host mid-fit: the survivor shrinks, resumes from the
+   manifest's committed reader cursor, and the CONSUME beacon stream
+   must show exactly one rewind — to that cursor — then run gapless to
+   the end (zero replayed, zero skipped sample ids), finishing
+   bit-exact vs an uninterrupted data-service run.
+
+Exits 0 with a SKIP note for phases B/D when 2-process bring-up is
 unavailable or times out; any contract violation exits non-zero.
 """
 
@@ -308,6 +324,274 @@ def phase_b(tmp: str) -> bool:
     return True
 
 
+_WORKER_D = textwrap.dedent("""
+    import hashlib, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.data_service import DataService
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import multihost
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+    cluster = multihost.initialize(
+        multihost.ClusterConfig({coord!r}, 2, {pid}),
+        attempts=2, timeout_s=120)
+    cluster.barrier("gate_join_d")
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).momentum(0.5).use_adagrad(False)
+            .num_iterations(1).activation("tanh")
+            .list(3).hidden_layer_sizes(8, 6)
+            .override(2, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(16, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[
+                           rng.randint(0, 3, 16)])
+               for _ in range(4)]
+
+    # D1: per-host shard reads on the REAL spanning mesh (16 devices
+    # across both processes).  The service must stage <= 0.6x the
+    # global-staging bytes, and land arrays bit-identical shard-by-
+    # shard to multihost.stage_global_batch (so the training math
+    # cannot differ from the old path).
+    mesh = make_mesh(MeshSpec(data=16))
+    assert len(set(d.process_index
+                   for d in mesh.devices.flat)) == 2, mesh
+    svc = DataService.from_batches(batches, cluster=cluster, seed=7)
+    svc.configure(mesh=mesh, cluster=cluster, pad_chunk=16,
+                  dp_mode=True, spans=True)
+    order = list(range(len(batches)))
+    base = ingest_metrics.snapshot()["bytes_staged"]
+    staged = [svc.staged(0, p, order) for p in order]
+    per_host = ingest_metrics.snapshot()["bytes_staged"] - base
+    svc.close()
+    glob, equal = 0, True
+    for ds, sg in zip(batches, staged):
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        glob += x.nbytes + y.nbytes
+        xg, yg = multihost.stage_global_batch(x, y, mesh,
+                                              cluster=cluster)
+        for a, b in ((xg, sg.features), (yg, sg.labels)):
+            sa = sorted(a.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+            sb = sorted(b.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+            equal = equal and len(sa) == len(sb) and all(
+                np.array_equal(np.asarray(p.data), np.asarray(q.data))
+                for p, q in zip(sa, sb))
+    print("BYTES per_host=%d global=%d staged_equal=%d"
+          % (per_host, glob, int(equal)), flush=True)
+
+    # D2: data-service fit vs legacy whole-batch fit on the SAME live
+    # cluster — the trajectories must be bit-identical
+    def run(data, ckdir, **cfg_kw):
+        net = MultiLayerNetwork(conf).init(seed=9)
+        ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=ckdir, checkpoint_every=3,
+            cluster_timeout_s=90, hb_interval_s=0.2,
+            hb_timeout_s=10.0, **cfg_kw),
+            cluster=cluster).fit(data, num_epochs=2, seed=7)
+        return net, hashlib.md5(np.asarray(
+            net.params_flat()).tobytes()).hexdigest()
+    _, h_old = run(batches, {old!r}, data_service=False)
+    _, h_new = run(DataService.from_batches(batches, cluster=cluster,
+                                            seed=7), {new!r})
+    print("PATHS match=%d hash=%s" % (int(h_old == h_new), h_new),
+          flush=True)
+
+    # D3: SIGKILL drill through the service.  Every staged position
+    # emits a CONSUME beacon; the gate audits the stream across the
+    # shrink/resume for zero replayed / zero skipped sample ids.
+    svc3 = DataService.from_batches(batches, cluster=cluster, seed=7)
+    _staged = svc3.staged
+    def _audit(epoch, pos, order):
+        print("CONSUME %d %d %d" % (epoch, pos, int(order[int(pos)])),
+              flush=True)
+        return _staged(epoch, pos, order)
+    svc3.staged = _audit
+    net = MultiLayerNetwork(conf).init(seed=9)
+    drv = ResilientFit(net, ResilienceConfig(
+        checkpoint_dir={loss!r}, checkpoint_every=3,
+        cluster_timeout_s=5, hb_interval_s=0.2, hb_timeout_s=1.5),
+        cluster=cluster, fault_hook=lambda step: time.sleep(0.2))
+    class Beacon:
+        def iteration_done(self, model, it, score):
+            print("STEP", it, flush=True)
+    net.set_listeners([Beacon()])
+    drv.fit(svc3, num_epochs=4, seed=7)
+    rs = getattr(drv, "_last_restore_meta", None)
+    rs = rs.get("data_service") if rs else None
+    assert rs is not None, "survivor resumed without reader state"
+    print("RESTORED %d %d" % (rs["epoch"], rs["cursor"]), flush=True)
+    ing = drv.manager.ingest_state()
+    latest = drv.manager.latest_step()
+    assert ing is not None and (ing["epoch"], ing["cursor"]) == \\
+        divmod(latest, len(batches)), (ing, latest)
+    digest = hashlib.md5(np.asarray(
+        net.params_flat()).tobytes()).hexdigest()
+    print("DONE remeshes=%s members=%s hash=%s ingest=1" % (
+        drv.remeshes, drv.cluster.members, digest), flush=True)
+    sys.stdout.flush()
+    os._exit(0)   # peer is dead: skip the doomed distributed shutdown
+""")
+
+
+def phase_d(tmp: str) -> bool:
+    """ISSUE-20 acceptance drill (module docstring items 7-9).
+    Returns True when the drill RAN, False for a clean environment
+    skip (no 2-process bring-up)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    dirs = {k: os.path.join(tmp, "d_" + k)
+            for k in ("old", "new", "loss")}
+    err_paths = [os.path.join(tmp, f"worker{pid}.d.stderr")
+                 for pid in (0, 1)]
+    procs = []
+    for pid in (0, 1):
+        with open(err_paths[pid], "w") as err_f:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 _WORKER_D.format(repo=REPO, coord=coord, pid=pid,
+                                  old=dirs["old"], new=dirs["new"],
+                                  loss=dirs["loss"])],
+                stdout=subprocess.PIPE, stderr=err_f, text=True))
+
+    # wait until worker 1 is mid-fit in the kill drill, then kill it.
+    # A BYTES beacon means bring-up SUCCEEDED — dying after that is a
+    # real failure, not an environment skip.
+    deadline = time.time() + 300
+    seen = False
+    brought_up = False
+    while time.time() < deadline and not seen:
+        line = procs[1].stdout.readline()
+        if not line and procs[1].poll() is not None:
+            break
+        if line.startswith("BYTES"):
+            brought_up = True
+        if line.startswith("STEP"):
+            seen = int(line.split()[1]) >= 2
+    if not seen:
+        for p in procs:
+            p.kill()
+        procs[1].communicate(timeout=30)
+        err = open(err_paths[1]).read().strip()
+        tail = err.splitlines()[-1][:160] if err else "no steps produced"
+        if brought_up:
+            print(f"[multihost-gate] FAIL: data-service drill died "
+                  f"after cluster bring-up ({tail})")
+            sys.exit(1)
+        print("[multihost-gate] SKIP phase D: 2-process bring-up "
+              f"unavailable here ({tail})")
+        return False
+    procs[1].kill()
+    try:
+        out, _ = procs[0].communicate(timeout=300)
+        err = open(err_paths[0]).read()
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("[multihost-gate] FAIL: data-service survivor hung "
+              "after host kill")
+        sys.exit(1)
+    if procs[0].returncode != 0:
+        print(f"[multihost-gate] FAIL: data-service survivor exited "
+              f"{procs[0].returncode}:\n{err[-1500:]}")
+        sys.exit(1)
+    lines = out.splitlines()
+
+    def beacon(prefix):
+        hit = [ln for ln in lines if ln.startswith(prefix)]
+        if not hit:
+            print(f"[multihost-gate] FAIL: no {prefix} beacon from the "
+                  f"data-service survivor:\n{out[-500:]}")
+            sys.exit(1)
+        return hit[0]
+
+    kv = dict(f.split("=") for f in beacon("BYTES").split()[1:])
+    ratio = int(kv["per_host"]) / int(kv["global"])
+    if ratio > 0.6 or kv["staged_equal"] != "1":
+        print(f"[multihost-gate] FAIL: per-host staging contract "
+              f"(per_host/global={ratio:.3f}, "
+              f"staged_equal={kv['staged_equal']})")
+        sys.exit(1)
+    if "match=1" not in beacon("PATHS"):
+        print(f"[multihost-gate] FAIL: data-service fit diverged from "
+              f"the legacy staging path ({beacon('PATHS')})")
+        sys.exit(1)
+    done = beacon("DONE")
+    if "remeshes=1" not in done or "members=(0,)" not in done \
+            or "ingest=1" not in done:
+        print(f"[multihost-gate] FAIL: survivor recovery wrong: {done}")
+        sys.exit(1)
+
+    # uninterrupted data-service reference (single process): final
+    # params hash + the step -> batch schedule the CONSUME stream must
+    # reproduce
+    import hashlib
+
+    import numpy as np
+    from deeplearning4j_tpu.datasets.data_service import DataService
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+    conf, batches = _fixture()
+    n = len(batches)
+    svc = DataService.from_batches(batches, seed=7)
+    ref_cons = []
+    orig = svc.staged
+    svc.staged = lambda e, p, o: (
+        ref_cons.append((int(e) * n + int(p), int(o[int(p)]))),
+        orig(e, p, o))[1]
+    net = MultiLayerNetwork(conf).init(seed=9)
+    with svc:
+        ResilientFit(net, ResilienceConfig(
+            checkpoint_dir=os.path.join(tmp, "d_ref"),
+            checkpoint_every=3)).fit(svc, num_epochs=4, seed=7)
+    ref = hashlib.md5(np.asarray(
+        net.params_flat()).tobytes()).hexdigest()
+    if f"hash={ref}" not in done:
+        print(f"[multihost-gate] FAIL: data-service survivor not "
+              f"bit-exact ({done} vs ref {ref})")
+        sys.exit(1)
+
+    # zero replay / zero skip: exactly ONE rewind, to the manifest's
+    # committed cursor, then gapless to the end; every consumed batch
+    # matches the uninterrupted schedule
+    cons = [tuple(int(v) for v in ln.split()[1:4])
+            for ln in lines if ln.startswith("CONSUME")]
+    steps = [e * n + p for e, p, _ in cons]
+    re_, rc_ = (int(v) for v in beacon("RESTORED").split()[1:3])
+    rewinds = [i for i in range(1, len(steps))
+               if steps[i] <= steps[i - 1]]
+    refmap = dict(ref_cons)
+    ok = (len(rewinds) == 1
+          and steps[rewinds[0]] == re_ * n + rc_
+          and steps[:rewinds[0]] == list(range(rewinds[0]))
+          and steps[rewinds[0]:] == list(range(re_ * n + rc_, 4 * n))
+          and all(refmap[e * n + p] == b for e, p, b in cons))
+    if not ok:
+        print(f"[multihost-gate] FAIL: sample stream audit "
+              f"(restored=({re_},{rc_}), rewinds="
+              f"{[steps[i] for i in rewinds]}, steps={steps})")
+        sys.exit(1)
+    print(f"[multihost-gate] phase D ok: per-host staged bytes "
+          f"{ratio:.2f}x global (<=0.6) bit-identical shards, service "
+          f"vs legacy fit bit-exact, SIGKILLed host -> shrink resumed "
+          f"at committed cursor ({re_},{rc_}) zero replay/skip, "
+          f"bit-exact")
+    return True
+
+
 def phase_c() -> None:
     """Two-shape 4D drill (ISSUE 18 tentpole proof): the same CausalLM
     trained at two 3D mesh shapes differing ONLY in pipe degree —
@@ -378,7 +662,10 @@ def phase_c() -> None:
 def main() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         phase_a(tmp)
-        phase_b(tmp)
+        if phase_b(tmp):
+            phase_d(tmp)
+        else:
+            print("[multihost-gate] SKIP phase D: follows phase B skip")
     phase_c()
     print("[multihost-gate] ok")
     return 0

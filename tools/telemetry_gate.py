@@ -35,6 +35,12 @@ Machine-checks the tentpole's overhead contract on a real (tiny) fit:
    draft propose/verify rounds, prefix mounts — with a zero-downtime
    ``swap_weights`` in the MIDDLE of each pass: the swap drains,
    rebinds, and requantizes without tracing one new program;
+6d. the same off/on zero-compile contract for a warmed DATA-SERVICE
+   fit (``datasets/data_service.py``, the ISSUE 20 ingest layer) on an
+   8-way data mesh with a RAGGED final batch: the per-host shard
+   reads, prefetch staging, pad-to-chunk shapes, and reader-state
+   checkpointing must dispatch only cached programs — tracer off AND
+   on;
 7. the same off/on zero-compile contract for a warmed DATA×MODEL fit
    (``models/lm_fit.CausalLM`` on a 2×4 mesh through the sharded_fit
    GSPMD builders): the model-sharded scanned dispatch, its staging
@@ -135,6 +141,83 @@ def _checkpoint_gate(registry, telemetry, net, batches) -> int:
         return 1
     print(f"[telemetry-gate] ok: async-checkpoint loop compile_delta "
           f"off={delta_off} on={delta_on}")
+    return 0
+
+
+def _data_service_gate(registry, telemetry) -> int:
+    """Data-service loop gate (ISSUE 20): a WARMED ResilientFit fed by
+    the distributed data service on an 8-way data mesh — per-host shard
+    reads, depth-k prefetch staging, a ragged final batch padding to
+    the dispatch chunk, reader-state riding every snapshot — must
+    dispatch only cached programs with the tracer off AND on.  The
+    staged shapes must equal the legacy pad path's exactly; one extra
+    shape here IS the regression this gate exists to catch."""
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import (LayerKind,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.runtime.metrics import ingest_metrics
+    from deeplearning4j_tpu.runtime.resilience import (ResilienceConfig,
+                                                       ResilientFit)
+
+    conf = (NeuralNetConfiguration.builder()
+            .n_in(4).lr(0.1).num_iterations(1).activation("tanh")
+            .list(2).hidden_layer_sizes(8)
+            .override(1, kind=LayerKind.OUTPUT, n_out=3,
+                      activation="softmax", loss_function="mcxent")
+            .pretrain(False).backward(True).build())
+    rng = np.random.RandomState(3)
+
+    def batch(n):
+        return DataSet(rng.randn(n, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)])
+
+    batches = [batch(16) for _ in range(3)] + [batch(12)]   # ragged tail
+    net = MultiLayerNetwork(conf).init(seed=4)
+    mesh = make_mesh(MeshSpec(data=8))
+
+    def one_fit(seed):
+        with tempfile.TemporaryDirectory() as ckdir:
+            ResilientFit(net, ResilienceConfig(
+                checkpoint_dir=ckdir, checkpoint_every=3,
+                patience=10 ** 6, data_service=True),
+                mesh=mesh).fit(batches, num_epochs=2, seed=seed)
+
+    one_fit(0)              # warm (full + ragged staged shapes)
+    registry.mark()
+
+    assert not telemetry.enabled()
+    one_fit(1)
+    delta_off = registry.compile_delta_since_mark()
+    if delta_off != 0:
+        print(f"[telemetry-gate] FAIL: tracer-off data-service fit "
+              f"compiled {delta_off} new program(s)")
+        return 1
+
+    telemetry.enable("telemetry-gate-ingest")
+    registry.mark()
+    one_fit(2)
+    delta_on = registry.compile_delta_since_mark()
+    telemetry.disable()
+    if delta_on != 0:
+        print(f"[telemetry-gate] FAIL: tracer-on data-service fit "
+              f"compiled {delta_on} new program(s) — ingest "
+              "instrumentation leaked into a jitted region")
+        return 1
+    snap = ingest_metrics.snapshot()
+    if snap["batches_staged"] == 0 or snap["seed_agreements"] == 0:
+        print("[telemetry-gate] FAIL: data-service fit booked no ingest "
+              f"counters ({snap}) — the service was not in the loop")
+        return 1
+    print(f"[telemetry-gate] ok: data-service loop compile_delta "
+          f"off={delta_off} on={delta_on}, "
+          f"{snap['batches_staged']} batch(es) staged, depth_hw="
+          f"{snap['depth_hw']}")
     return 0
 
 
@@ -510,6 +593,9 @@ def main() -> int:
     print(f"[telemetry-gate] ok: compile_delta off={delta_off} "
           f"on={delta_on}, {len(records)} journal record(s)")
     rc = _checkpoint_gate(registry, telemetry, net, batches)
+    if rc:
+        return rc
+    rc = _data_service_gate(registry, telemetry)
     if rc:
         return rc
     rc = _mixed_precision_gate(registry, telemetry)
